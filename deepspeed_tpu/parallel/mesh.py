@@ -1,0 +1,113 @@
+"""Device-mesh bootstrap: the TPU-native replacement for process groups.
+
+The reference organizes parallelism with NCCL process groups
+(`runtime/engine.py:130`, `runtime/pipe/topology.py:252-364`). On TPU the
+equivalent structure is a named ``jax.sharding.Mesh``: the ``data`` axis
+replaces the dp group, ``model`` the mp/slice groups, ``pipe`` the pipeline
+stage pairs, ``seq`` sequence/context parallelism, and ``expert`` MoE expert
+parallelism. XLA collectives over these axes ride ICI within a slice and DCN
+across slices.
+"""
+
+from typing import Optional, Dict
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis order: collectives on inner (fastest-varying) axes stay on
+# ICI neighbors; `data` is outermost so cross-slice DCN traffic (if any) is
+# the infrequent gradient reduction.
+MESH_AXES = ("data", "pipe", "expert", "seq", "model")
+
+_initialized = False
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None):
+    """Multi-host rendezvous: analog of ``dist.init_process_group`` at
+    `runtime/engine.py:135`, via ``jax.distributed.initialize``.
+
+    Single-process (one host, or tests) is a no-op: JAX already sees all
+    local devices.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is not None or num_processes not in (None, 1):
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _initialized = True
+
+
+def normalize_mesh_shape(mesh_config: Optional[Dict[str, Optional[int]]],
+                         n_devices: Optional[int] = None) -> Dict[str, int]:
+    """Resolve a user mesh dict into a full {axis: size} over all devices.
+
+    Unspecified axes default to 1; a ``data`` axis of None (or omitted)
+    absorbs the remaining devices.
+    """
+    if n_devices is None:
+        n_devices = jax.device_count()
+    cfg = dict(mesh_config or {})
+    shape = {}
+    denom = 1
+    for axis in MESH_AXES:
+        if axis == "data":
+            continue
+        size = cfg.get(axis) or 1
+        shape[axis] = int(size)
+        denom *= int(size)
+    if n_devices % denom != 0:
+        raise ValueError(
+            f"mesh axes {cfg} (product {denom}) do not divide "
+            f"device count {n_devices}")
+    data = cfg.get("data")
+    if data is None:
+        data = n_devices // denom
+    if data * denom != n_devices:
+        raise ValueError(
+            f"mesh {cfg} with data={data} does not cover {n_devices} devices")
+    shape["data"] = int(data)
+    return shape
+
+
+def build_mesh(mesh_config: Optional[Dict[str, Optional[int]]] = None,
+               devices=None) -> Mesh:
+    """Create the named device mesh.
+
+    Uses ``jax.experimental.mesh_utils.create_device_mesh`` when possible so
+    the logical axes map onto the physical ICI torus; falls back to a plain
+    reshape (CPU test meshes).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    shape = normalize_mesh_shape(mesh_config, n)
+    dims = tuple(shape[a] for a in MESH_AXES)
+    try:
+        from jax.experimental import mesh_utils
+        device_array = mesh_utils.create_device_mesh(dims, devices=devices)
+    except Exception:
+        device_array = np.asarray(devices).reshape(dims)
+    return Mesh(device_array, MESH_AXES)
+
+
+def single_device_mesh() -> Mesh:
+    """1-device mesh with all named axes size 1 (single-chip runs)."""
+    return build_mesh({})
+
+
+def data_sharding(mesh: Mesh, *, batch_axes=("data",)) -> NamedSharding:
+    """Sharding for a [batch, ...] array split over the data axis."""
+    return NamedSharding(mesh, PartitionSpec(batch_axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]

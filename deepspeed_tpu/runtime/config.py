@@ -1,0 +1,464 @@
+"""DeepSpeed-compatible JSON/dict config → typed config object.
+
+TPU-native analog of the reference's ``DeepSpeedConfig``
+(`runtime/config.py:485`): same key surface, same batch-size triple solver
+(``train_batch_size = micro_batch * grad_accum * dp_world_size``,
+`runtime/config.py:586-632`), same error checks (`runtime/config.py:657`),
+plus a TPU ``mesh`` section describing the named device-mesh axes that
+replace the reference's process groups.
+"""
+
+import json
+import logging
+
+from deepspeed_tpu.runtime.constants import *  # noqa: F401,F403
+from deepspeed_tpu.runtime.config_utils import (
+    get_scalar_param,
+    dict_raise_error_on_duplicate_keys,
+)
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.constants import (
+    MAX_STAGE_ZERO_OPTIMIZATION,
+    ZERO_OPTIMIZATION,
+)
+from deepspeed_tpu.runtime.activation_checkpointing.config import (
+    DeepSpeedActivationCheckpointingConfig,
+)
+from deepspeed_tpu.utils.logging import logger
+
+TENSOR_CORE_ALIGN_SIZE = 8
+ADAM_OPTIMIZER = "adam"
+LAMB_OPTIMIZER = "lamb"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+DEEPSPEED_OPTIMIZERS = [ADAM_OPTIMIZER, LAMB_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER]
+
+
+def get_fp16_enabled(param_dict):
+    if FP16 in param_dict:
+        return get_scalar_param(param_dict[FP16], FP16_ENABLED, FP16_ENABLED_DEFAULT)
+    return False
+
+
+def get_bf16_enabled(param_dict):
+    if BF16 in param_dict:
+        return get_scalar_param(param_dict[BF16], BF16_ENABLED, BF16_ENABLED_DEFAULT)
+    return False
+
+
+def get_amp_enabled(param_dict):
+    if AMP in param_dict:
+        return get_scalar_param(param_dict[AMP], AMP_ENABLED, AMP_ENABLED_DEFAULT)
+    return False
+
+
+def get_amp_params(param_dict):
+    if AMP in param_dict:
+        amp_params = dict(param_dict[AMP])
+        amp_params.pop(AMP_ENABLED, None)
+        return amp_params
+    return False
+
+
+def get_loss_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        return get_scalar_param(param_dict[FP16], FP16_LOSS_SCALE,
+                                FP16_LOSS_SCALE_DEFAULT)
+    return FP16_LOSS_SCALE_DEFAULT
+
+
+def get_initial_dynamic_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        initial_scale_power = get_scalar_param(param_dict[FP16],
+                                               FP16_INITIAL_SCALE_POWER,
+                                               FP16_INITIAL_SCALE_POWER_DEFAULT)
+    else:
+        initial_scale_power = FP16_INITIAL_SCALE_POWER_DEFAULT
+    return 2 ** initial_scale_power
+
+
+def get_dynamic_loss_scale_args(param_dict):
+    loss_scale_args = None
+    if get_fp16_enabled(param_dict):
+        fp16_dict = param_dict[FP16]
+        dynamic_keys = (FP16_INITIAL_SCALE_POWER, FP16_LOSS_SCALE_WINDOW,
+                        FP16_MIN_LOSS_SCALE, FP16_HYSTERESIS)
+        if any(k in fp16_dict for k in dynamic_keys):
+            init_scale = get_scalar_param(fp16_dict,
+                                          FP16_INITIAL_SCALE_POWER,
+                                          FP16_INITIAL_SCALE_POWER_DEFAULT)
+            scale_window = get_scalar_param(fp16_dict,
+                                            FP16_LOSS_SCALE_WINDOW,
+                                            FP16_LOSS_SCALE_WINDOW_DEFAULT)
+            delayed_shift = get_scalar_param(fp16_dict,
+                                             FP16_HYSTERESIS,
+                                             FP16_HYSTERESIS_DEFAULT)
+            min_loss_scale = get_scalar_param(fp16_dict,
+                                              FP16_MIN_LOSS_SCALE,
+                                              FP16_MIN_LOSS_SCALE_DEFAULT)
+            loss_scale_args = {
+                "init_scale": 2 ** init_scale,
+                "scale_window": scale_window,
+                "delayed_shift": delayed_shift,
+                "min_scale": min_loss_scale,
+            }
+    return loss_scale_args
+
+
+def get_gradient_accumulation_steps(param_dict):
+    return get_scalar_param(param_dict, GRADIENT_ACCUMULATION_STEPS,
+                            GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+
+
+def get_sparse_gradients_enabled(param_dict):
+    return get_scalar_param(param_dict, SPARSE_GRADIENTS, SPARSE_GRADIENTS_DEFAULT)
+
+
+def get_zero_optimization(param_dict):
+    return ZERO_OPTIMIZATION in param_dict
+
+
+def get_optimizer_name(param_dict):
+    if OPTIMIZER in param_dict and TYPE in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][TYPE]
+    return OPTIMIZER_TYPE_DEFAULT
+
+
+def get_optimizer_params(param_dict):
+    if get_optimizer_name(param_dict) is not None and \
+            OPTIMIZER_PARAMS in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][OPTIMIZER_PARAMS]
+    return None
+
+
+def get_optimizer_legacy_fusion(param_dict):
+    if OPTIMIZER in param_dict and LEGACY_FUSION in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][LEGACY_FUSION]
+    return LEGACY_FUSION_DEFAULT
+
+
+def get_scheduler_name(param_dict):
+    if SCHEDULER in param_dict and TYPE in param_dict[SCHEDULER]:
+        return param_dict[SCHEDULER][TYPE]
+    return SCHEDULER_TYPE_DEFAULT
+
+
+def get_scheduler_params(param_dict):
+    if get_scheduler_name(param_dict) is not None and \
+            SCHEDULER_PARAMS in param_dict[SCHEDULER]:
+        return param_dict[SCHEDULER][SCHEDULER_PARAMS]
+    return None
+
+
+def get_pld_enabled(param_dict):
+    if PROGRESSIVE_LAYER_DROP in param_dict:
+        return get_scalar_param(param_dict[PROGRESSIVE_LAYER_DROP],
+                                PLD_ENABLED, PLD_ENABLED_DEFAULT)
+    return False
+
+
+def get_pld_params(param_dict):
+    if PROGRESSIVE_LAYER_DROP in param_dict:
+        pld_params = dict(param_dict[PROGRESSIVE_LAYER_DROP])
+        pld_params.pop(PLD_ENABLED, None)
+        return pld_params
+    return False
+
+
+def get_sparse_attention(param_dict):
+    """Parse the sparse_attention section into kwargs for a SparsityConfig.
+
+    Mirrors the mode dispatch of the reference (`runtime/config.py:177-345`).
+    """
+    if SPARSE_ATTENTION not in param_dict:
+        return None
+    sparsity = param_dict[SPARSE_ATTENTION]
+    mode = get_scalar_param(sparsity, SPARSE_MODE, SPARSE_MODE_DEFAULT)
+
+    common = {
+        SPARSE_MODE: mode,
+        SPARSE_BLOCK: get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT),
+        SPARSE_DIFFERENT_LAYOUT_PER_HEAD: get_scalar_param(
+            sparsity, SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+            SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT),
+    }
+    if mode == SPARSE_DENSE_MODE:
+        return common
+    if mode == SPARSE_FIXED_MODE:
+        extra_keys = [
+            (SPARSE_NUM_LOCAL_BLOCKS, SPARSE_NUM_LOCAL_BLOCKS_DEFAULT),
+            (SPARSE_NUM_GLOBAL_BLOCKS, SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+            (SPARSE_ATTENTION_TYPE, SPARSE_ATTENTION_TYPE_DEFAULT),
+            (SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+             SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
+            (SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS,
+             SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS_DEFAULT),
+        ]
+    elif mode == SPARSE_VARIABLE_MODE:
+        extra_keys = [
+            (SPARSE_NUM_RANDOM_BLOCKS, SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+            (SPARSE_LOCAL_WINDOW_BLOCKS, SPARSE_LOCAL_WINDOW_BLOCKS_DEFAULT),
+            (SPARSE_GLOBAL_BLOCK_INDICES, SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT),
+            (SPARSE_GLOBAL_BLOCK_END_INDICES,
+             SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT),
+            (SPARSE_ATTENTION_TYPE, SPARSE_ATTENTION_TYPE_DEFAULT),
+            (SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+             SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
+        ]
+    elif mode == SPARSE_BIGBIRD_MODE:
+        extra_keys = [
+            (SPARSE_NUM_RANDOM_BLOCKS, SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+            (SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+             SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT),
+            (SPARSE_NUM_GLOBAL_BLOCKS, SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+        ]
+    elif mode == SPARSE_BSLONGFORMER_MODE:
+        extra_keys = [
+            (SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+             SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT),
+            (SPARSE_GLOBAL_BLOCK_INDICES, SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT),
+            (SPARSE_GLOBAL_BLOCK_END_INDICES,
+             SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT),
+        ]
+    else:
+        raise NotImplementedError(
+            f"Given sparsity mode, {mode}, has not been implemented yet!")
+    for key, default in extra_keys:
+        common[key] = get_scalar_param(sparsity, key, default)
+    return common
+
+
+def get_pipeline_config(param_dict):
+    """Pipeline section with defaults (reference: `runtime/config.py:348`)."""
+    defaults = {
+        PIPELINE_STAGES: PIPELINE_STAGES_DEFAULT,
+        PIPELINE_PARTITION: PIPELINE_PARTITION_DEFAULT,
+        PIPELINE_SEED_LAYERS: PIPELINE_SEED_LAYERS_DEFAULT,
+        PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL:
+            PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT,
+    }
+    config = dict(defaults)
+    config.update(param_dict.get(PIPELINE, {}))
+    return config
+
+
+def get_mesh_config(param_dict):
+    """TPU mesh axes: {"data": N|None, "model": M, "pipe": P, "seq": S, "expert": E}."""
+    return param_dict.get(MESH, MESH_DEFAULT)
+
+
+class DeepSpeedConfig:
+    def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
+        if param_dict is None:
+            if isinstance(json_file_or_dict, dict):
+                param_dict = json_file_or_dict
+            else:
+                with open(json_file_or_dict, "r") as f:
+                    param_dict = json.load(
+                        f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        self._param_dict = param_dict
+
+        if world_size is not None:
+            self.world_size = world_size
+        elif mpu is not None:
+            self.world_size = mpu.get_data_parallel_world_size()
+        else:
+            self.world_size = self._infer_world_size(param_dict)
+
+        self._initialize_params(param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    def _infer_world_size(self, param_dict):
+        """Data-parallel world size = total devices / (model*pipe*seq*expert)."""
+        try:
+            import jax
+            n_devices = jax.device_count()
+        except Exception:
+            n_devices = 1
+        mesh = get_mesh_config(param_dict)
+        if mesh:
+            denom = 1
+            for axis, size in mesh.items():
+                if axis != "data" and size:
+                    denom *= size
+            data = mesh.get("data")
+            if data:
+                return data
+            return max(n_devices // denom, 1)
+        return n_devices
+
+    def _initialize_params(self, param_dict):
+        self.train_batch_size = get_scalar_param(param_dict, TRAIN_BATCH_SIZE,
+                                                 TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(
+            param_dict, TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+            TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = get_gradient_accumulation_steps(param_dict)
+        self.steps_per_print = get_scalar_param(param_dict, STEPS_PER_PRINT,
+                                                STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(param_dict, DUMP_STATE, DUMP_STATE_DEFAULT)
+        self.disable_allgather = get_scalar_param(param_dict, DISABLE_ALLGATHER,
+                                                  DISABLE_ALLGATHER_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(
+            param_dict, GRADIENT_PREDIVIDE_FACTOR, GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = get_sparse_gradients_enabled(param_dict)
+
+        self.zero_config = DeepSpeedZeroConfig(param_dict)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.activation_checkpointing_config = \
+            DeepSpeedActivationCheckpointingConfig(param_dict)
+
+        self.fp16_enabled = get_fp16_enabled(param_dict)
+        self.bf16_enabled = get_bf16_enabled(param_dict)
+        self.amp_enabled = get_amp_enabled(param_dict)
+        self.amp_params = get_amp_params(param_dict)
+        self.loss_scale = get_loss_scale(param_dict)
+        self.initial_dynamic_scale = get_initial_dynamic_scale(param_dict)
+        self.dynamic_loss_scale_args = get_dynamic_loss_scale_args(param_dict)
+
+        self.optimizer_name = get_optimizer_name(param_dict)
+        if self.optimizer_name is not None and \
+                self.optimizer_name.lower() in DEEPSPEED_OPTIMIZERS:
+            self.optimizer_name = self.optimizer_name.lower()
+        self.optimizer_params = get_optimizer_params(param_dict)
+        self.optimizer_legacy_fusion = get_optimizer_legacy_fusion(param_dict)
+        self.zero_allow_untested_optimizer = get_scalar_param(
+            param_dict, ZERO_ALLOW_UNTESTED_OPTIMIZER,
+            ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+
+        self.scheduler_name = get_scheduler_name(param_dict)
+        self.scheduler_params = get_scheduler_params(param_dict)
+
+        self.wall_clock_breakdown = get_scalar_param(
+            param_dict, WALL_CLOCK_BREAKDOWN, WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get_scalar_param(param_dict, MEMORY_BREAKDOWN,
+                                                 MEMORY_BREAKDOWN_DEFAULT)
+        if TENSORBOARD in param_dict:
+            tb = param_dict[TENSORBOARD]
+            self.tensorboard_enabled = get_scalar_param(tb, TENSORBOARD_ENABLED,
+                                                        TENSORBOARD_ENABLED_DEFAULT)
+            self.tensorboard_output_path = get_scalar_param(
+                tb, TENSORBOARD_OUTPUT_PATH, TENSORBOARD_OUTPUT_PATH_DEFAULT)
+            self.tensorboard_job_name = get_scalar_param(
+                tb, TENSORBOARD_JOB_NAME, TENSORBOARD_JOB_NAME_DEFAULT)
+        else:
+            self.tensorboard_enabled = TENSORBOARD_ENABLED_DEFAULT
+            self.tensorboard_output_path = TENSORBOARD_OUTPUT_PATH_DEFAULT
+            self.tensorboard_job_name = TENSORBOARD_JOB_NAME_DEFAULT
+
+        self.gradient_clipping = get_scalar_param(param_dict, GRADIENT_CLIPPING,
+                                                  GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = get_scalar_param(param_dict, PRESCALE_GRADIENTS,
+                                                   PRESCALE_GRADIENTS_DEFAULT)
+        self.fp32_allreduce = get_scalar_param(param_dict, FP32_ALLREDUCE,
+                                               FP32_ALLREDUCE_DEFAULT)
+        self.vocabulary_size = get_scalar_param(param_dict, VOCABULARY_SIZE,
+                                                VOCABULARY_SIZE_DEFAULT)
+
+        self.pld_enabled = get_pld_enabled(param_dict)
+        self.pld_params = get_pld_params(param_dict)
+
+        self.sparse_attention = get_sparse_attention(param_dict)
+        self.pipeline = get_pipeline_config(param_dict)
+        self.mesh_shape = get_mesh_config(param_dict)
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        assert train_batch > 0, \
+            f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, \
+            f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, \
+            f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal "
+            f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {grad_acc} * {self.world_size}")
+
+    def _set_batch_related_parameters(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        # All three provided → consistency-checked below.
+        if train_batch is not None and micro_batch is not None and grad_acc is not None:
+            pass
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= self.world_size
+            self.gradient_accumulation_steps = grad_acc
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // self.world_size
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        elif micro_batch is not None and grad_acc is not None:
+            self.train_batch_size = micro_batch * grad_acc * self.world_size
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * self.world_size
+            self.gradient_accumulation_steps = 1
+        else:
+            raise ValueError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu "
+                "needs to be provided")
+
+    def _configure_train_batch_size(self):
+        self._set_batch_related_parameters()
+        self._batch_assertion()
+
+    def _do_sanity_check(self):
+        self._do_error_check()
+        self._do_warning_check()
+
+    def _do_error_check(self):
+        if self.zero_enabled:
+            assert self.fp16_enabled or self.bf16_enabled, (
+                "DeepSpeedConfig: ZeRO is only supported with fp16 or bf16 enabled")
+            assert self.zero_optimization_stage <= MAX_STAGE_ZERO_OPTIMIZATION, (
+                f"DeepSpeedConfig: Maximum supported ZeRO stage is "
+                f"{MAX_STAGE_ZERO_OPTIMIZATION}")
+        assert self.train_micro_batch_size_per_gpu is not None, \
+            "DeepSpeedConfig: train_micro_batch_size_per_gpu is not defined"
+        assert self.gradient_accumulation_steps is not None, \
+            "DeepSpeedConfig: gradient_accumulation_steps is not defined"
+        if self.fp16_enabled and self.bf16_enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+
+    def _do_warning_check(self):
+        fp16_enabled = self.fp16_enabled
+        vocabulary_size = self.vocabulary_size
+        if vocabulary_size and vocabulary_size % TENSOR_CORE_ALIGN_SIZE != 0:
+            logger.warning(
+                "DeepSpeedConfig: vocabulary size {} is not aligned to {}, "
+                "may import tensor core utilization.".format(
+                    vocabulary_size, TENSOR_CORE_ALIGN_SIZE))
+        if self.optimizer_params is not None and \
+                MAX_GRAD_NORM in self.optimizer_params.keys() and \
+                self.optimizer_params[MAX_GRAD_NORM] > 0:
+            if fp16_enabled:
+                logger.warning(
+                    "DeepSpeedConfig: In FP16 mode, DeepSpeed will pass {}:{} "
+                    "to FP16 wrapper".format(MAX_GRAD_NORM,
+                                             self.optimizer_params[MAX_GRAD_NORM]))
+            else:
+                logger.warning(
+                    "DeepSpeedConfig: In FP32 mode, DeepSpeed does not permit "
+                    "MAX_GRAD_NORM ({}) > 0, setting to zero".format(
+                        self.optimizer_params[MAX_GRAD_NORM]))
+                self.optimizer_params[MAX_GRAD_NORM] = 0.0
+
+    def print(self, name):
+        logger.info("{}:".format(name))
+        for arg in sorted(vars(self)):
+            if arg != "_param_dict":
+                dots = "." * (29 - len(arg))
+                logger.info("  {} {} {}".format(arg, dots, getattr(self, arg)))
+        logger.info("  json = {}".format(
+            json.dumps(self._param_dict, sort_keys=True, indent=4,
+                       separators=(",", ":"))))

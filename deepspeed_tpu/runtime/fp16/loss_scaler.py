@@ -1,0 +1,164 @@
+"""Static and dynamic loss scaling.
+
+TPU-native analog of the reference's ``LossScaler``/``DynamicLossScaler``
+(`runtime/fp16/loss_scaler.py:56,79`). Semantics are identical (scale factor,
+scale window, min scale, delayed-shift hysteresis, consecutive hysteresis),
+but the state is an immutable pytree and ``update_scale`` is a pure function,
+so the overflow-driven skip/update decision can live inside the jitted train
+step as a ``jnp.where`` instead of host control flow.
+
+On TPU, fp16 dynamic loss scaling is mostly needed for strict parity runs;
+bf16 (the native TPU dtype) needs no scaling and maps to the static scaler
+with scale 1.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    """Immutable dynamic-loss-scale state (device-resident, jit-friendly)."""
+    cur_scale: jnp.ndarray        # f32 scalar
+    cur_iter: jnp.ndarray         # i32 scalar
+    last_overflow_iter: jnp.ndarray  # i32 scalar
+    cur_hysteresis: jnp.ndarray   # i32 scalar
+
+
+def init_loss_scale_state(init_scale=2 ** 32, delayed_shift=1):
+    return LossScaleState(
+        cur_scale=jnp.asarray(init_scale, jnp.float32),
+        cur_iter=jnp.asarray(0, jnp.int32),
+        last_overflow_iter=jnp.asarray(-1, jnp.int32),
+        cur_hysteresis=jnp.asarray(delayed_shift, jnp.int32),
+    )
+
+
+def update_loss_scale(state: LossScaleState,
+                      overflow,
+                      scale_factor=2.0,
+                      scale_window=1000,
+                      min_scale=1.0,
+                      delayed_shift=1,
+                      consecutive_hysteresis=False) -> LossScaleState:
+    """Pure version of DynamicLossScaler.update_scale (reference :151-166)."""
+    overflow = jnp.asarray(overflow)
+
+    # --- overflow branch ---
+    shift_now = jnp.logical_or(delayed_shift == 1, state.cur_hysteresis == 1)
+    scale_on_overflow = jnp.where(
+        shift_now,
+        jnp.maximum(state.cur_scale / scale_factor, min_scale),
+        state.cur_scale)
+    hysteresis_on_overflow = jnp.where(shift_now, state.cur_hysteresis,
+                                       state.cur_hysteresis - 1)
+
+    # --- no-overflow branch ---
+    window_hit = (state.cur_iter - state.last_overflow_iter) % scale_window == 0
+    scale_on_ok = jnp.where(window_hit, state.cur_scale * scale_factor,
+                            state.cur_scale)
+    if consecutive_hysteresis:
+        hysteresis_on_ok = jnp.asarray(delayed_shift, jnp.int32)
+    else:
+        hysteresis_on_ok = jnp.where(window_hit, delayed_shift,
+                                     state.cur_hysteresis).astype(jnp.int32)
+
+    return LossScaleState(
+        cur_scale=jnp.where(overflow, scale_on_overflow, scale_on_ok),
+        cur_iter=state.cur_iter + 1,
+        last_overflow_iter=jnp.where(overflow, state.cur_iter,
+                                     state.last_overflow_iter),
+        cur_hysteresis=jnp.where(overflow, hysteresis_on_overflow,
+                                 hysteresis_on_ok).astype(jnp.int32),
+    )
+
+
+class LossScalerBase:
+    def __init__(self, cur_scale):
+        self.cur_scale = cur_scale
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        import jax
+        return jax.tree_util.tree_map(lambda g: g * self.loss_scale, grads)
+
+    def update_scale(self, overflow):
+        pass
+
+    def backward(self, loss):
+        """The reference scales loss before autograd; in JAX, scale the loss
+        value that feeds jax.grad (or use engine's built-in scaled loss)."""
+        return loss * self.loss_scale
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scale (reference `loss_scaler.py:56`)."""
+
+    def __init__(self, scale=1):
+        super().__init__(scale)
+
+    def has_overflow(self, params):
+        return False
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Stateful wrapper with reference-identical semantics, backed by the
+    pure `update_loss_scale` transition above."""
+
+    def __init__(self,
+                 init_scale=2 ** 32,
+                 scale_factor=2.0,
+                 scale_window=1000,
+                 min_scale=1,
+                 delayed_shift=1,
+                 consecutive_hysteresis=False):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def _state(self):
+        return LossScaleState(
+            cur_scale=jnp.asarray(self.cur_scale, jnp.float32),
+            cur_iter=jnp.asarray(self.cur_iter, jnp.int32),
+            last_overflow_iter=jnp.asarray(self.last_overflow_iter, jnp.int32),
+            cur_hysteresis=jnp.asarray(self.cur_hysteresis, jnp.int32),
+        )
+
+    def update_scale(self, overflow):
+        new = update_loss_scale(self._state(),
+                                overflow,
+                                scale_factor=self.scale_factor,
+                                scale_window=self.scale_window,
+                                min_scale=self.min_scale,
+                                delayed_shift=self.delayed_shift,
+                                consecutive_hysteresis=self.consecutive_hysteresis)
+        self.cur_scale = float(new.cur_scale)
+        self.cur_iter = int(new.cur_iter)
+        self.last_overflow_iter = int(new.last_overflow_iter)
+        self.cur_hysteresis = int(new.cur_hysteresis)
+
+    def has_overflow(self, grads):
+        import jax
+        leaves = jax.tree_util.tree_leaves(grads)
+        if not leaves:
+            return False
+        total = sum(jnp.sum(jnp.logical_not(jnp.isfinite(g))) for g in leaves)
+        return bool(total > 0)
+
+
+def CreateLossScaler(static_loss_scale=None, dynamic_scale_args=None):
+    """Factory matching engine usage: static scale → LossScaler, else dynamic."""
+    if static_loss_scale is not None and static_loss_scale > 0:
+        return LossScaler(scale=static_loss_scale)
+    if dynamic_scale_args is not None:
+        return DynamicLossScaler(**dynamic_scale_args)
+    return DynamicLossScaler()

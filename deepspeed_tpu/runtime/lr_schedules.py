@@ -1,0 +1,277 @@
+"""Learning-rate schedules: LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR.
+
+TPU-native analog of the reference's ``deepspeed/runtime/lr_schedules.py``
+(classes at `runtime/lr_schedules.py:301,401,645,722`). The semantics are the
+same, but each schedule's math lives in a pure ``lr_at(step)`` usable both
+eagerly (Python floats) and under ``jax.jit`` (traced step counters), so the
+engine can fold the schedule into the compiled train step instead of mutating
+param-group state between steps.
+"""
+
+import argparse
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+LR_SCHEDULE = "lr_schedule"
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
+
+LR_RANGE_TEST_MIN_LR = "lr_range_test_min_lr"
+LR_RANGE_TEST_STEP_RATE = "lr_range_test_step_rate"
+LR_RANGE_TEST_STEP_SIZE = "lr_range_test_step_size"
+LR_RANGE_TEST_STAIRCASE = "lr_range_test_staircase"
+
+EDGE_VALUE = "edge_value"
+MID_VALUE = "mid_value"
+
+CYCLE_FIRST_STEP_SIZE = "cycle_first_step_size"
+CYCLE_FIRST_STAIR_COUNT = "cycle_first_stair_count"
+CYCLE_SECOND_STEP_SIZE = "cycle_second_step_size"
+CYCLE_SECOND_STAIR_COUNT = "cycle_second_stair_count"
+DECAY_STEP_SIZE = "decay_step_size"
+
+CYCLE_MIN_LR = "cycle_min_lr"
+CYCLE_MAX_LR = "cycle_max_lr"
+DECAY_LR_RATE = "decay_lr_rate"
+
+CYCLE_MIN_MOM = "cycle_min_mom"
+CYCLE_MAX_MOM = "cycle_max_mom"
+DECAY_MOM_RATE = "decay_mom_rate"
+
+WARMUP_MIN_LR = "warmup_min_lr"
+WARMUP_MAX_LR = "warmup_max_lr"
+WARMUP_NUM_STEPS = "warmup_num_steps"
+
+TOTAL_NUM_STEPS = "total_num_steps"
+
+
+def add_tuning_arguments(parser):
+    """CLI LR-tuning argument group (reference: `lr_schedules.py:54-152`)."""
+    group = parser.add_argument_group("Convergence Tuning",
+                                      "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training.")
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    group.add_argument("--lr_range_test_staircase", type=bool, default=False)
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_first_stair_count", type=int, default=-1)
+    group.add_argument("--cycle_second_step_size", type=int, default=-1)
+    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    group.add_argument("--decay_step_size", type=int, default=1000)
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--cycle_min_mom", type=float, default=0.8)
+    group.add_argument("--cycle_max_mom", type=float, default=0.9)
+    group.add_argument("--decay_mom_rate", type=float, default=0.0)
+    group.add_argument("--warmup_min_lr", type=float, default=0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    return parser
+
+
+def parse_arguments():
+    parser = argparse.ArgumentParser()
+    parser = add_tuning_arguments(parser)
+    lr_sched_args, unknown_args = parser.parse_known_args()
+    return lr_sched_args, unknown_args
+
+
+class _Schedule:
+    """Base: stateful step API around a pure per-step lr computation."""
+
+    def __init__(self, last_batch_iteration=-1):
+        self.last_batch_iteration = last_batch_iteration
+
+    def lr_at(self, step):
+        raise NotImplementedError
+
+    def as_fn(self):
+        """Pure ``step -> lr`` function for folding into a jitted train step."""
+        return self.lr_at
+
+    def get_lr(self):
+        if self.last_batch_iteration < 0:
+            logger.warning("Attempting to get learning rate from scheduler "
+                           "before it has started")
+            return [0.0]
+        return [float(self.lr_at(self.last_batch_iteration))]
+
+    def step(self, last_batch_iteration=None):
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+
+    def state_dict(self):
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd):
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+class LRRangeTest(_Schedule):
+    """LR range test: lr = min_lr * (1 + step_rate * interval(step))."""
+
+    def __init__(self,
+                 lr_range_test_min_lr=1e-3,
+                 lr_range_test_step_size=2000,
+                 lr_range_test_step_rate=1.0,
+                 lr_range_test_staircase=False,
+                 last_batch_iteration=-1,
+                 optimizer=None):
+        super().__init__(last_batch_iteration)
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = lr_range_test_step_size
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+
+    def lr_at(self, step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        interval = jnp.floor(step / self.step_size) if self.staircase \
+            else step / self.step_size
+        return self.min_lr * (1 + self.step_rate * interval)
+
+
+class OneCycle(_Schedule):
+    """1-cycle policy: triangular lr cycle then post-cycle decay.
+
+    Momentum cycling is exposed via ``mom_at(step)`` (the reference mutates
+    optimizer betas as a side effect; here the engine folds the momentum
+    schedule into the jitted optimizer update).
+    """
+
+    def __init__(self,
+                 cycle_min_lr,
+                 cycle_max_lr,
+                 decay_lr_rate=0.0,
+                 cycle_first_step_size=2000,
+                 cycle_second_step_size=None,
+                 cycle_first_stair_count=0,
+                 cycle_second_stair_count=None,
+                 decay_step_size=0,
+                 cycle_momentum=True,
+                 cycle_min_mom=0.8,
+                 cycle_max_mom=0.9,
+                 decay_mom_rate=0.0,
+                 last_batch_iteration=-1,
+                 optimizer=None):
+        super().__init__(last_batch_iteration)
+        first = float(cycle_first_step_size)
+        second = float(cycle_second_step_size) \
+            if cycle_second_step_size is not None else first
+        self.total_size = first + second
+        self.step_ratio = first / self.total_size
+        self.first_stair_count = cycle_first_stair_count
+        self.second_stair_count = cycle_first_stair_count \
+            if cycle_second_stair_count is None else cycle_second_stair_count
+        self.decay_step_size = decay_step_size
+        self.min_lr = cycle_min_lr
+        self.max_lr = cycle_max_lr
+        self.decay_lr_rate = decay_lr_rate
+        self.cycle_momentum = cycle_momentum
+        self.min_mom = cycle_min_mom
+        self.max_mom = cycle_max_mom
+        self.decay_mom_rate = decay_mom_rate
+
+    def _scale_factor(self, step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        cycle = jnp.floor(1 + step / self.total_size)
+        x = 1.0 + step / self.total_size - cycle
+        return jnp.where(x <= self.step_ratio,
+                         x / self.step_ratio,
+                         (x - 1) / (self.step_ratio - 1))
+
+    def _decay_interval(self, step):
+        decay_steps = jnp.asarray(step, jnp.float32) - self.total_size
+        return decay_steps / max(self.decay_step_size, 1)
+
+    def lr_at(self, step):
+        cycle_lr = self.min_lr + (self.max_lr - self.min_lr) * self._scale_factor(step)
+        decay_lr = self.min_lr * (1 + self.decay_lr_rate * self._decay_interval(step))
+        in_cycle = jnp.asarray(step, jnp.float32) <= self.total_size
+        return jnp.where(in_cycle, cycle_lr, decay_lr)
+
+    def mom_at(self, step):
+        cycle_mom = self.max_mom - (self.max_mom - self.min_mom) * self._scale_factor(step)
+        decay_mom = self.max_mom * (1 + self.decay_mom_rate * self._decay_interval(step))
+        in_cycle = jnp.asarray(step, jnp.float32) <= self.total_size
+        return jnp.where(in_cycle, cycle_mom, decay_mom)
+
+    def get_mom(self):
+        if not self.cycle_momentum:
+            return None
+        return [(float(self.mom_at(max(self.last_batch_iteration, 0))), 0.99)]
+
+
+class WarmupLR(_Schedule):
+    """Log-warmup from min_lr to max_lr over warmup_num_steps, then flat."""
+
+    def __init__(self,
+                 warmup_min_lr=0.0,
+                 warmup_max_lr=0.001,
+                 warmup_num_steps=1000,
+                 last_batch_iteration=-1,
+                 optimizer=None):
+        super().__init__(last_batch_iteration)
+        self.min_lr = warmup_min_lr
+        self.max_lr = warmup_max_lr
+        self.delta_lr = warmup_max_lr - warmup_min_lr
+        self.warmup_num_steps = warmup_num_steps
+        self.inverse_log_warm_up = 1.0 / jnp.log(float(warmup_num_steps))
+
+    def _gamma(self, step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warm = self.inverse_log_warm_up * jnp.log(step + 1)
+        return jnp.where(step < self.warmup_num_steps, warm, 1.0)
+
+    def lr_at(self, step):
+        return self.min_lr + self.delta_lr * self._gamma(step)
+
+
+class WarmupDecayLR(WarmupLR):
+    """Log-warmup then linear decay to zero at total_num_steps."""
+
+    def __init__(self,
+                 total_num_steps,
+                 warmup_min_lr=0.0,
+                 warmup_max_lr=0.001,
+                 warmup_num_steps=1000,
+                 last_batch_iteration=-1,
+                 optimizer=None):
+        self.total_num_steps = total_num_steps
+        super().__init__(warmup_min_lr, warmup_max_lr, warmup_num_steps,
+                         last_batch_iteration)
+        if self.total_num_steps < self.warmup_num_steps:
+            logger.warning(
+                "total_num_steps {} is less than warmup_num_steps {}".format(
+                    total_num_steps, warmup_num_steps))
+
+    def _gamma(self, step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warm = self.inverse_log_warm_up * jnp.log(step + 1)
+        decay = jnp.maximum(
+            0.0, (self.total_num_steps - step) /
+            max(1.0, self.total_num_steps - self.warmup_num_steps))
+        return jnp.where(step < self.warmup_num_steps, warm, decay)
+
+
+SCHEDULE_REGISTRY = {
+    LR_RANGE_TEST: LRRangeTest,
+    ONE_CYCLE: OneCycle,
+    WARMUP_LR: WarmupLR,
+    WARMUP_DECAY_LR: WarmupDecayLR,
+}
+
+
+def get_lr_scheduler(name, params):
+    """Instantiate a schedule by config name (engine resolver analog)."""
+    if name not in SCHEDULE_REGISTRY:
+        raise ValueError(f"unknown lr schedule {name}; valid: {VALID_LR_SCHEDULES}")
+    return SCHEDULE_REGISTRY[name](**params)

@@ -1,0 +1,252 @@
+"""Runtime helper math: overflow checks, norms, partitioning, sharded tensors.
+
+TPU-native analog of the reference's ``deepspeed/runtime/utils.py``:
+``CheckOverflow`` (:41), ``get_grad_norm`` (:154), ``partition_uniform`` (:295),
+``partition_balanced`` (:361), ``PartitionedTensor`` (:379),
+``see_memory_usage`` (:531). Overflow checks and norms are pure jnp functions
+(jit-safe, mesh-aware via an optional ``axis_name`` when called inside
+``shard_map``); partitioning is plain Python (it runs at trace/setup time).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+# ---------------------------------------------------------------------------
+# Overflow detection (reference: CheckOverflow / _has_inf_or_nan)
+# ---------------------------------------------------------------------------
+
+def has_inf_or_nan(x):
+    """True iff any element of ``x`` is inf or nan. jit-safe; returns a
+    traced boolean scalar."""
+    return jnp.logical_not(jnp.all(jnp.isfinite(x.astype(jnp.float32))))
+
+
+def check_overflow(grads, axis_names=()):
+    """Overflow vote over a grad pytree.
+
+    Inside ``shard_map``, pass the mesh axis names to reduce the vote across
+    shards — the analog of the reference's MAX-allreduce overflow vote across
+    dp and mp groups (`zero/stage2.py:1527-1551`).
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        overflow = jnp.asarray(False)
+    else:
+        flags = [has_inf_or_nan(g) for g in leaves]
+        overflow = jnp.any(jnp.stack(flags))
+    for axis in axis_names:
+        overflow = jax.lax.pmax(overflow.astype(jnp.int32), axis) > 0
+    return overflow
+
+
+class CheckOverflow:
+    """Stateful facade over ``check_overflow`` for engine parity."""
+
+    def __init__(self, param_groups=None, mpu=None, zero_reduce_scatter=False):
+        self.mpu = mpu
+        self.zero_reduce_scatter = zero_reduce_scatter
+
+    def check_using_norm(self, norm_group):
+        overflow = any(float(norm) in (float("inf"), -float("inf")) or
+                       norm != norm for norm in norm_group)
+        return overflow
+
+    def has_overflow(self, grads):
+        return bool(check_overflow(grads))
+
+
+# ---------------------------------------------------------------------------
+# Norms and clipping (reference: get_grad_norm / get_weight_norm / clip_grad_norm_)
+# ---------------------------------------------------------------------------
+
+def global_norm(tree, axis_names=()):
+    """Global L2 norm of a pytree. ``axis_names`` psums the squared sum across
+    mesh axes when shards hold disjoint slices (ZeRO / model parallel)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    for axis in axis_names:
+        sq = jax.lax.psum(sq, axis)
+    return jnp.sqrt(sq)
+
+
+def clip_by_global_norm(tree, max_norm, norm=None, eps=1e-6):
+    """Scale the pytree so its global norm is at most ``max_norm``.
+
+    Matches the reference's clip: scale = max_norm / (norm + eps) applied only
+    when norm exceeds max_norm.
+    """
+    if norm is None:
+        norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (norm + eps))
+    return jax.tree_util.tree_map(lambda x: (x * scale).astype(x.dtype), tree)
+
+
+def get_grad_norm(gradients, axis_names=()):
+    return global_norm(gradients, axis_names)
+
+
+def get_weight_norm(parameters, axis_names=()):
+    return global_norm(parameters, axis_names)
+
+
+# ---------------------------------------------------------------------------
+# Partitioning math (reference: partition_uniform :295, partition_balanced :361)
+# Pure Python — used for pipeline stage assignment and ZeRO bookkeeping,
+# runs at setup time, unit-testable without devices.
+# ---------------------------------------------------------------------------
+
+def prefix_sum_inc(weights):
+    """Inclusive prefix sum of a list."""
+    out = []
+    total = 0
+    for w in weights:
+        total += w
+        out.append(total)
+    return out
+
+
+def partition_uniform(num_items, num_parts):
+    """Even split boundaries: len == num_parts+1, remainder spread across
+    the leading parts."""
+    parts = [(p * num_items) // num_parts for p in range(num_parts)]
+    parts.append(num_items)
+    return parts
+
+
+def _feasible(weights, num_parts, bottleneck):
+    """Greedy check: can ``weights`` split into ≤ num_parts contiguous chunks
+    each with sum ≤ bottleneck?"""
+    parts_used = 1
+    current = 0
+    for w in weights:
+        if w > bottleneck:
+            return False
+        if current + w > bottleneck:
+            parts_used += 1
+            current = w
+            if parts_used > num_parts:
+                return False
+        else:
+            current += w
+    return True
+
+
+def partition_balanced(weights, num_parts, eps=1e-3):
+    """Boundaries minimizing the max part weight (contiguous partition).
+
+    Same capability as the reference's binary-search-over-prefix-sums
+    (`runtime/utils.py:361,310`): binary search the bottleneck, then lay out
+    chunks greedily while keeping every trailing part non-empty.
+    """
+    num_items = len(weights)
+    if num_items <= num_parts:
+        # Degenerate: one item (or empty) per part.
+        parts = list(range(num_items + 1))
+        parts += [num_items] * (num_parts - num_items)
+        return parts
+
+    lo = max(weights) if weights else 0
+    hi = sum(weights)
+    while lo < hi:
+        mid = (lo + hi) // 2 if isinstance(lo, int) and isinstance(hi, int) \
+            else (lo + hi) / 2
+        if _feasible(weights, num_parts, mid):
+            hi = mid
+        else:
+            lo = mid + 1 if isinstance(mid, int) else mid + eps
+    bottleneck = hi
+
+    # Greedy layout, reserving enough items for the remaining parts.
+    parts = [0]
+    idx = 0
+    for p in range(num_parts):
+        remaining_parts = num_parts - p - 1
+        current = 0
+        while idx < num_items - remaining_parts:
+            if current + weights[idx] > bottleneck and current > 0:
+                break
+            current += weights[idx]
+            idx += 1
+        parts.append(idx)
+    parts[-1] = num_items
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# PartitionedTensor (reference: runtime/utils.py:379-486)
+# ---------------------------------------------------------------------------
+
+class PartitionedTensor:
+    """A tensor flattened, padded, and split into ``world`` equal shards.
+
+    The reference version shards over a process group and reconstructs with an
+    allgather; here the shards are plain arrays plus meta, and ``full()``
+    reconstruction is a concatenate (per-host) or an ``all_gather`` when used
+    inside ``shard_map`` via :func:`from_shard`.
+    """
+
+    def __init__(self, tensor, world, rank=None):
+        self.orig_shape = tuple(tensor.shape)
+        self.orig_dtype = tensor.dtype
+        self.world = world
+        flat = tensor.reshape(-1)
+        self.orig_size = flat.shape[0]
+        pad = (-self.orig_size) % world
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        self.padded_size = flat.shape[0]
+        self._shards = flat.reshape(world, -1)
+        self.rank = rank
+
+    def local_data(self, rank=None):
+        r = self.rank if rank is None else rank
+        assert r is not None, "rank required to read a local shard"
+        return self._shards[r]
+
+    def to_meta(self):
+        return {
+            "orig_shape": self.orig_shape,
+            "orig_size": self.orig_size,
+            "padded_size": self.padded_size,
+            "world": self.world,
+            "dtype": self.orig_dtype,
+        }
+
+    @staticmethod
+    def full_from_shards(shards, meta):
+        """Rebuild the original tensor from stacked shards [world, shard]."""
+        flat = shards.reshape(-1)[: meta["orig_size"]]
+        return flat.reshape(meta["orig_shape"]).astype(meta["dtype"])
+
+    @staticmethod
+    def full_from_local(shard, meta, axis_name):
+        """Inside shard_map: allgather this rank's shard along ``axis_name``
+        and rebuild (the reference's dist.all_gather path)."""
+        gathered = jax.lax.all_gather(shard, axis_name)
+        return PartitionedTensor.full_from_shards(gathered, meta)
+
+    def full(self):
+        return self.full_from_shards(self._shards, self.to_meta())
+
+
+# ---------------------------------------------------------------------------
+# Memory reporting (reference: see_memory_usage :531)
+# ---------------------------------------------------------------------------
+
+def see_memory_usage(message, force=False):
+    try:
+        parts = []
+        for d in jax.local_devices():
+            stats = d.memory_stats() or {}
+            parts.append(
+                f"{d.platform}:{d.id} in_use "
+                f"{stats.get('bytes_in_use', 0) / 2**30:.2f}GB peak "
+                f"{stats.get('peak_bytes_in_use', 0) / 2**30:.2f}GB")
+        logger.info(f"{message} | {' | '.join(parts)}")
+    except Exception:
+        logger.info(f"{message} | memory stats unavailable")

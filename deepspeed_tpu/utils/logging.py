@@ -1,0 +1,53 @@
+"""Logging utilities.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py``
+(`utils/logging.py:7,40` in the reference): a singleton package logger plus a
+rank-filtered ``log_dist``. Rank is taken from ``jax.process_index()`` when JAX
+is initialized (multi-host pods), falling back to 0.
+"""
+
+import logging
+import sys
+import functools
+
+LOG_NAME = "deepspeed_tpu"
+
+
+@functools.lru_cache(None)
+def _create_logger(name=LOG_NAME, level=logging.INFO):
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    if not logger_.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setLevel(level)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"))
+        logger_.addHandler(handler)
+    return logger_
+
+
+logger = _create_logger()
+
+
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the given process ranks.
+
+    ``ranks=None`` or ``ranks=[-1]`` logs on every process (mirrors the
+    reference semantics of ``log_dist``).
+    """
+    should_log = ranks is None or (len(ranks) > 0 and ranks[0] == -1)
+    if not should_log:
+        should_log = _process_index() in set(ranks)
+    if should_log:
+        rank = _process_index()
+        logger.log(level, f"[Rank {rank}] {message}")

@@ -1,0 +1,18 @@
+"""Version info (reference: `deepspeed/git_version_info.py`)."""
+
+version = "0.1.0"
+git_hash = None
+git_branch = None
+
+try:
+    import subprocess
+    _out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                          capture_output=True, text=True, timeout=2)
+    if _out.returncode == 0:
+        git_hash = _out.stdout.strip()
+    _out = subprocess.run(["git", "rev-parse", "--abbrev-ref", "HEAD"],
+                          capture_output=True, text=True, timeout=2)
+    if _out.returncode == 0:
+        git_branch = _out.stdout.strip()
+except Exception:
+    pass

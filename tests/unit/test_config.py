@@ -1,0 +1,194 @@
+"""Config tests: batch triple solver + sanity checks.
+
+Models the reference's `tests/unit/test_config.py` coverage.
+"""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def make_config(d, world_size=1):
+    return DeepSpeedConfig(d, world_size=world_size)
+
+
+def test_batch_all_three_consistent():
+    cfg = make_config({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    }, world_size=4)
+    assert cfg.train_batch_size == 32
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_all_three_inconsistent_raises():
+    with pytest.raises(AssertionError):
+        make_config({
+            "train_batch_size": 33,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2,
+        }, world_size=4)
+
+
+def test_batch_infer_grad_accum():
+    cfg = make_config({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+    }, world_size=4)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_infer_micro_batch():
+    cfg = make_config({
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": 2,
+    }, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_batch_infer_train_batch():
+    cfg = make_config({
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    }, world_size=4)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_only_train_batch():
+    cfg = make_config({"train_batch_size": 32}, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_none_raises():
+    with pytest.raises(ValueError):
+        make_config({}, world_size=1)
+
+
+def test_zero_requires_low_precision():
+    with pytest.raises(AssertionError):
+        make_config({
+            "train_batch_size": 8,
+            "zero_optimization": {"stage": 2},
+        }, world_size=1)
+
+
+def test_zero_with_fp16():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+    }, world_size=1)
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 2
+    assert cfg.fp16_enabled
+
+
+def test_zero_with_bf16():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+    }, world_size=1)
+    assert cfg.zero_enabled
+    assert cfg.bf16_enabled and not cfg.fp16_enabled
+
+
+def test_zero_legacy_bool_form():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True},
+        "zero_optimization": True,
+    }, world_size=1)
+    assert cfg.zero_optimization_stage == 1
+
+
+def test_fp16_and_bf16_mutually_exclusive():
+    with pytest.raises(ValueError):
+        make_config({
+            "train_batch_size": 8,
+            "fp16": {"enabled": True},
+            "bf16": {"enabled": True},
+        }, world_size=1)
+
+
+def test_dynamic_loss_scale_args():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "fp16": {
+            "enabled": True,
+            "loss_scale": 0,
+            "initial_scale_power": 16,
+            "loss_scale_window": 500,
+            "hysteresis": 3,
+            "min_loss_scale": 2,
+        },
+    }, world_size=1)
+    args = cfg.dynamic_loss_scale_args
+    assert args["init_scale"] == 2 ** 16
+    assert args["scale_window"] == 500
+    assert args["delayed_shift"] == 3
+    assert args["min_scale"] == 2
+    assert cfg.initial_dynamic_scale == 2 ** 16
+
+
+def test_static_loss_scale():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True, "loss_scale": 128},
+    }, world_size=1)
+    assert cfg.loss_scale == 128
+
+
+def test_optimizer_scheduler_sections():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    }, world_size=1)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params == {"lr": 1e-3}
+    assert cfg.scheduler_name == "WarmupLR"
+    assert cfg.scheduler_params == {"warmup_num_steps": 10}
+
+
+def test_duplicate_json_keys_rejected(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=1)
+
+
+def test_json_file_load(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text('{"train_batch_size": 16, "fp16": {"enabled": true}}')
+    cfg = DeepSpeedConfig(str(p), world_size=2)
+    assert cfg.train_batch_size == 16
+    assert cfg.train_micro_batch_size_per_gpu == 8
+    assert cfg.fp16_enabled
+
+
+def test_sparse_attention_fixed_mode():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "sparse_attention": {
+            "mode": "fixed",
+            "block": 16,
+            "num_local_blocks": 4,
+            "num_global_blocks": 1,
+        },
+    }, world_size=1)
+    sa = cfg.sparse_attention
+    assert sa["mode"] == "fixed"
+    assert sa["block"] == 16
+    assert sa["num_local_blocks"] == 4
+
+
+def test_mesh_config():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "mesh": {"data": 2, "model": 4},
+    }, world_size=2)
+    assert cfg.mesh_shape == {"data": 2, "model": 4}

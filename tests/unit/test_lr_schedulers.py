@@ -1,0 +1,109 @@
+"""LR schedule tests mirroring the reference's `tests/unit/test_lr_schedulers.py`."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest,
+    OneCycle,
+    WarmupLR,
+    WarmupDecayLR,
+    get_lr_scheduler,
+)
+
+
+def test_warmup_lr_log_warmup_then_flat():
+    sched = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=100)
+    # step index 0 → lr = max_lr * log(1)/log(100) = 0
+    assert float(sched.lr_at(0)) == pytest.approx(0.0, abs=1e-8)
+    mid = float(sched.lr_at(9))
+    assert mid == pytest.approx(0.1 * math.log(10) / math.log(100), rel=1e-5)
+    # after warmup, fixed at max lr
+    assert float(sched.lr_at(100)) == pytest.approx(0.1, rel=1e-6)
+    assert float(sched.lr_at(10_000)) == pytest.approx(0.1, rel=1e-6)
+
+
+def test_warmup_lr_stateful_api():
+    sched = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    assert sched.get_lr() == [0.0]  # not started
+    for _ in range(20):
+        sched.step()
+    assert sched.get_lr()[0] == pytest.approx(0.1, rel=1e-5)
+    sd = sched.state_dict()
+    sched2 = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    sched2.load_state_dict(sd)
+    assert sched2.last_batch_iteration == sched.last_batch_iteration
+
+
+def test_warmup_decay_lr():
+    sched = WarmupDecayLR(total_num_steps=100, warmup_max_lr=0.1,
+                          warmup_num_steps=10)
+    # peak at end of warmup
+    assert float(sched.lr_at(10)) == pytest.approx(0.1, rel=1e-5)
+    # midpoint of decay
+    assert float(sched.lr_at(55)) == pytest.approx(0.1 * 45 / 90, rel=1e-5)
+    # fully decayed
+    assert float(sched.lr_at(100)) == pytest.approx(0.0, abs=1e-7)
+    assert float(sched.lr_at(150)) == pytest.approx(0.0, abs=1e-7)
+
+
+def test_lr_range_test_continuous():
+    sched = LRRangeTest(lr_range_test_min_lr=0.01,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0)
+    assert float(sched.lr_at(0)) == pytest.approx(0.01)
+    assert float(sched.lr_at(10)) == pytest.approx(0.02, rel=1e-5)
+    assert float(sched.lr_at(5)) == pytest.approx(0.015, rel=1e-5)
+
+
+def test_lr_range_test_staircase():
+    sched = LRRangeTest(lr_range_test_min_lr=0.01,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0,
+                        lr_range_test_staircase=True)
+    assert float(sched.lr_at(5)) == pytest.approx(0.01)
+    assert float(sched.lr_at(15)) == pytest.approx(0.02, rel=1e-5)
+
+
+def test_one_cycle_triangle():
+    sched = OneCycle(cycle_min_lr=0.1, cycle_max_lr=0.2,
+                     cycle_first_step_size=10)
+    # peak at end of first phase
+    assert float(sched.lr_at(10)) == pytest.approx(0.2, rel=1e-4)
+    # back to min at end of cycle
+    assert float(sched.lr_at(20)) == pytest.approx(0.1, rel=1e-4)
+    # halfway up
+    assert float(sched.lr_at(5)) == pytest.approx(0.15, rel=1e-4)
+
+
+def test_one_cycle_momentum_inverse():
+    sched = OneCycle(cycle_min_lr=0.1, cycle_max_lr=0.2,
+                     cycle_first_step_size=10,
+                     cycle_min_mom=0.8, cycle_max_mom=0.9)
+    # momentum moves opposite the lr: at lr peak, momentum is at min
+    assert float(sched.mom_at(10)) == pytest.approx(0.8, rel=1e-4)
+    assert float(sched.mom_at(0)) == pytest.approx(0.9, rel=1e-4)
+
+
+def test_one_cycle_decay_phase():
+    sched = OneCycle(cycle_min_lr=0.1, cycle_max_lr=0.2,
+                     cycle_first_step_size=10,
+                     decay_step_size=5, decay_lr_rate=-0.01)
+    lr_after = float(sched.lr_at(30))  # 10 steps past the 20-step cycle
+    assert lr_after == pytest.approx(0.1 * (1 + -0.01 * 2), rel=1e-4)
+
+
+def test_registry():
+    sched = get_lr_scheduler("WarmupLR", {"warmup_max_lr": 0.1})
+    assert isinstance(sched, WarmupLR)
+    with pytest.raises(ValueError):
+        get_lr_scheduler("Nope", {})
+
+
+def test_schedule_as_fn_jittable():
+    import jax
+    sched = WarmupDecayLR(total_num_steps=100, warmup_max_lr=0.1,
+                          warmup_num_steps=10)
+    fn = jax.jit(sched.as_fn())
+    assert float(fn(10)) == pytest.approx(0.1, rel=1e-5)

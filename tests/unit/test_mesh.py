@@ -1,0 +1,47 @@
+"""Mesh bootstrap tests on the virtual 8-device CPU mesh."""
+
+import jax
+import pytest
+
+from deepspeed_tpu.parallel.mesh import (
+    MESH_AXES,
+    build_mesh,
+    normalize_mesh_shape,
+    axis_size,
+)
+
+
+def test_eight_devices_available():
+    assert jax.device_count() == 8
+
+
+def test_default_mesh_all_data():
+    mesh = build_mesh()
+    assert axis_size(mesh, "data") == 8
+    assert axis_size(mesh, "model") == 1
+    assert set(mesh.axis_names) == set(MESH_AXES)
+
+
+def test_mesh_data_model():
+    mesh = build_mesh({"data": 2, "model": 4})
+    assert axis_size(mesh, "data") == 2
+    assert axis_size(mesh, "model") == 4
+
+
+def test_mesh_data_absorbs_remainder():
+    mesh = build_mesh({"model": 2})
+    assert axis_size(mesh, "data") == 4
+    assert axis_size(mesh, "model") == 2
+
+
+def test_mesh_pipe():
+    mesh = build_mesh({"pipe": 4})
+    assert axis_size(mesh, "pipe") == 4
+    assert axis_size(mesh, "data") == 2
+
+
+def test_mesh_invalid_shape():
+    with pytest.raises(ValueError):
+        normalize_mesh_shape({"model": 3}, n_devices=8)
+    with pytest.raises(ValueError):
+        normalize_mesh_shape({"data": 3, "model": 2}, n_devices=8)

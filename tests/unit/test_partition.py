@@ -1,0 +1,102 @@
+"""Partitioning-math and PartitionedTensor tests, mirroring the reference's
+`tests/unit/test_partition.py` (raw-tensor partition tests) and the
+partition_balanced unit coverage.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.utils import (
+    PartitionedTensor,
+    clip_by_global_norm,
+    check_overflow,
+    global_norm,
+    partition_balanced,
+    partition_uniform,
+    prefix_sum_inc,
+)
+
+
+def test_prefix_sum():
+    assert prefix_sum_inc([1, 2, 3]) == [1, 3, 6]
+    assert prefix_sum_inc([]) == []
+
+
+def test_partition_uniform_exact():
+    parts = partition_uniform(8, 4)
+    assert parts == [0, 2, 4, 6, 8]
+
+
+def test_partition_uniform_remainder():
+    parts = partition_uniform(10, 4)
+    assert parts[0] == 0 and parts[-1] == 10
+    sizes = [parts[i + 1] - parts[i] for i in range(4)]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_partition_balanced_uniform_weights():
+    parts = partition_balanced([1] * 8, 4)
+    assert parts == [0, 2, 4, 6, 8]
+
+
+def test_partition_balanced_skewed():
+    weights = [10, 1, 1, 1, 1, 1, 1, 10]
+    parts = partition_balanced(weights, 2)
+    sizes = [sum(weights[parts[i]:parts[i + 1]]) for i in range(2)]
+    assert max(sizes) == 13  # optimal bottleneck
+
+
+def test_partition_balanced_more_parts_than_items():
+    parts = partition_balanced([5, 5], 4)
+    assert parts[0] == 0 and parts[-1] == 2
+    assert len(parts) == 5
+
+
+def test_partition_balanced_all_parts_cover():
+    weights = [3, 1, 4, 1, 5, 9, 2, 6]
+    for num_parts in (1, 2, 3, 4):
+        parts = partition_balanced(weights, num_parts)
+        assert len(parts) == num_parts + 1
+        assert parts[0] == 0 and parts[-1] == len(weights)
+        assert all(parts[i] <= parts[i + 1] for i in range(num_parts))
+
+
+def test_partitioned_tensor_roundtrip():
+    x = jnp.arange(23, dtype=jnp.float32).reshape(23)
+    pt = PartitionedTensor(x, world=4)
+    assert pt.padded_size % 4 == 0
+    y = pt.full()
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_partitioned_tensor_2d():
+    x = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+    pt = PartitionedTensor(x, world=8)
+    y = pt.full()
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    meta = pt.to_meta()
+    assert meta["orig_shape"] == (3, 4)
+
+
+def test_global_norm():
+    tree = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+    assert float(global_norm(tree)) == pytest.approx(5.0)
+
+
+def test_clip_by_global_norm():
+    tree = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+    clipped = clip_by_global_norm(tree, 1.0)
+    assert float(global_norm(clipped)) == pytest.approx(1.0, rel=1e-4)
+    # under the limit → unchanged
+    not_clipped = clip_by_global_norm(tree, 10.0)
+    assert float(not_clipped["a"][0]) == pytest.approx(3.0)
+
+
+def test_check_overflow():
+    ok = {"a": jnp.asarray([1.0, 2.0])}
+    bad = {"a": jnp.asarray([1.0, float("inf")])}
+    nan = {"a": jnp.asarray([float("nan")])}
+    assert not bool(check_overflow(ok))
+    assert bool(check_overflow(bad))
+    assert bool(check_overflow(nan))
