@@ -1,0 +1,95 @@
+"""Benchmark: GPT-2 training throughput on the local TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: training tokens/sec/chip for GPT-2 (bf16, full fwd+bwd+Adam step via
+the engine's compiled train step). vs_baseline compares achieved model
+TFLOPS/chip against the reference's best published per-GPU number
+(64 TFLOPS on V100, `docs/_tutorials/bert-pretraining.md:387` — see
+BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def model_flops_per_token(cfg, seq_len):
+    """6*N per token plus attention term (12*L*H*T per token)."""
+    n_params = (cfg.vocab_size * cfg.n_embd + cfg.n_positions * cfg.n_embd +
+                cfg.n_layer * (12 * cfg.n_embd ** 2 + 13 * cfg.n_embd) +
+                2 * cfg.n_embd)
+    return 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * seq_len
+
+
+def main():
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2LMHead, gpt2_125m, gpt2_350m, init_gpt2_params, make_gpt2_loss_fn)
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        cfg_fn, batch_size, seq_len, steps = gpt2_125m, 8, 1024, 30
+    else:  # CPU smoke mode
+        cfg_fn, batch_size, seq_len, steps = gpt2_125m, 2, 128, 2
+
+    cfg = cfg_fn(n_positions=seq_len, remat=on_tpu)
+    model = GPT2LMHead(cfg)
+    params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
+    loss_fn = make_gpt2_loss_fn(model)
+
+    config = {
+        "train_batch_size": batch_size,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=config, loss_fn=loss_fn, params=params)
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(batch_size, seq_len)).astype(np.int32)}
+
+    # warmup / compile (float() forces full materialization — on the axon
+    # relay, block_until_ready alone can return before execution completes)
+    for _ in range(2):
+        float(engine.train_batch(batch))
+
+    # Prefer XLA's own FLOP count for the compiled step when available.
+    xla_flops = None
+    try:
+        ca = engine._compiled_train_step.lower(
+            engine.params, engine.opt_state, engine.device_state,
+            engine._shard_batch(batch),
+            jax.random.PRNGKey(1)).compile().cost_analysis()
+        xla_flops = ca.get("flops")
+    except Exception:
+        pass
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch_size * seq_len * steps / dt
+    if xla_flops:
+        tflops = xla_flops * steps / dt / 1e12
+    else:
+        tflops = tokens_per_sec * model_flops_per_token(cfg, seq_len) / 1e12
+    baseline_tflops = 64.0  # reference best published per-GPU (V100)
+    print(json.dumps({
+        "metric": f"GPT-2 {'125M' if on_tpu else '125M(cpu-smoke)'} train "
+                  f"tokens/sec/chip (bf16, seq{seq_len})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tflops / baseline_tflops, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

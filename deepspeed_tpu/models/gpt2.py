@@ -1,0 +1,224 @@
+"""GPT-2 model family, TPU-first.
+
+The reference ships no in-tree GPT-2 (its perf harness drives Megatron-GPT2
+externally, `tests/model/Megatron_GPT2/run_perf_baseline.py:18-60`); this
+module provides the equivalent flagship decoder for the framework's
+benchmarks: sizes matching the reference perf configs (125M … 1.5B),
+bf16 compute over fp32 masters, optional rematerialization, and
+Megatron-style tensor-parallel PartitionSpecs over the ``model`` mesh axis.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from flax.traverse_util import flatten_dict, unflatten_dict
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16        # compute dtype (MXU-native)
+    param_dtype: Any = jnp.float32   # master param dtype
+    remat: bool = False              # activation checkpointing per block
+    use_flash_attention: bool = False  # Pallas flash-attention kernel
+
+
+# Sizes follow the reference perf-harness configs
+# (`tests/model/Megatron_GPT2/run_perf_baseline.py:18-60`).
+def gpt2_125m(**kw):
+    return GPT2Config(n_embd=768, n_layer=12, n_head=12, **kw)
+
+
+def gpt2_350m(**kw):
+    return GPT2Config(n_embd=1024, n_layer=24, n_head=16, **kw)
+
+
+def gpt2_760m(**kw):
+    return GPT2Config(n_embd=1536, n_layer=24, n_head=16, **kw)
+
+
+def gpt2_1_5b(**kw):
+    return GPT2Config(n_embd=1600, n_layer=48, n_head=25, **kw)
+
+
+def gpt2_tiny(**kw):
+    """Test-size model (the `SimpleModel` analog for LM tests)."""
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("n_positions", 64)
+    return GPT2Config(n_embd=64, n_layer=2, n_head=4, **kw)
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        B, T, C = x.shape
+        H = cfg.n_head
+        qkv = nn.Dense(3 * C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, C // H)
+        k = k.reshape(B, T, H, C // H)
+        v = v.reshape(B, T, H, C // H)
+
+        if cfg.use_flash_attention:
+            # The fused kernel has no attention-prob dropout; refuse configs
+            # where the two attention paths would train differently.
+            assert cfg.dropout == 0.0 or deterministic, (
+                "use_flash_attention does not support attention dropout; "
+                "set dropout=0.0 or use the dense attention path")
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+            y = flash_attention(q, k, v, causal=True)
+        else:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(C // H, cfg.dtype))
+            att = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            att = jnp.where(mask[None, None], att, jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+            y = jnp.einsum("bhts,bshd->bthd", att, v)
+        y = y.reshape(B, T, C)
+        y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="c_proj")(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return y
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        C = x.shape[-1]
+        h = nn.Dense(4 * C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="c_proj")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
+        return x
+
+
+class GPT2LMHead(nn.Module):
+    """Decoder-only LM with tied embedding / output head."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
+        x = wte[input_ids].astype(cfg.dtype) + \
+            wpe[None, :T].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, prevent_cse=False)
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = x @ wte.T.astype(cfg.dtype)
+        return logits
+
+
+def cross_entropy_loss(logits, labels, ignore_index=-100):
+    """Mean token cross-entropy in fp32, masking ``ignore_index`` labels."""
+    logits = logits.astype(jnp.float32)
+    mask = (labels != ignore_index)
+    safe_labels = jnp.where(mask, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_loss = -jnp.take_along_axis(logp, safe_labels[..., None],
+                                      axis=-1).squeeze(-1)
+    token_loss = jnp.where(mask, token_loss, 0.0)
+    return token_loss.sum() / jnp.maximum(mask.sum(), 1)
+
+
+def make_gpt2_loss_fn(model: GPT2LMHead):
+    """loss_fn(params, batch, rng) for the engine.
+
+    ``batch`` is a dict with ``input_ids`` [B, T] (labels default to the
+    next-token shift) or explicit ``labels``.
+    """
+
+    def loss_fn(params, batch, rng=None):
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [input_ids[:, 1:],
+                 jnp.full((input_ids.shape[0], 1), -100, input_ids.dtype)],
+                axis=1)
+        rngs = {"dropout": rng} if rng is not None else {}
+        logits = model.apply({"params": params}, input_ids,
+                             deterministic=rng is None, rngs=rngs)
+        return cross_entropy_loss(logits, labels)
+
+    return loss_fn
+
+
+def init_gpt2_params(model: GPT2LMHead, rng, batch_size=2, seq_len=None):
+    cfg = model.config
+    T = seq_len or min(cfg.n_positions, 64)
+    dummy = jnp.zeros((batch_size, T), jnp.int32)
+    return model.init({"params": rng}, dummy)["params"]
+
+
+def gpt2_partition_specs(params, model_axis="model"):
+    """Megatron-style tensor-parallel PartitionSpecs over the ``model`` axis.
+
+    The reference delegates TP to an external Megatron mpu (SURVEY §2.1); here
+    TP is first-class: column-parallel QKV/FC kernels shard their output dim,
+    row-parallel projections shard their input dim, embeddings shard the
+    vocab dim, and GSPMD inserts the psums that Megatron hand-codes.
+    """
+    flat = flatten_dict(params)
+    specs = {}
+    for path, leaf in flat.items():
+        name = "/".join(str(p) for p in path)
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim <= 1:
+            specs[path] = P()
+        elif name.endswith("wte"):
+            specs[path] = P(model_axis, None)
+        elif name.endswith("wpe"):
+            specs[path] = P()
+        elif "attn/c_attn" in name and name.endswith("kernel"):
+            specs[path] = P(None, model_axis)     # column parallel
+        elif "attn/c_proj" in name and name.endswith("kernel"):
+            specs[path] = P(model_axis, None)     # row parallel
+        elif "mlp/c_fc" in name and name.endswith("kernel"):
+            specs[path] = P(None, model_axis)
+        elif "mlp/c_proj" in name and name.endswith("kernel"):
+            specs[path] = P(model_axis, None)
+        else:
+            specs[path] = P()
+    return unflatten_dict(specs)

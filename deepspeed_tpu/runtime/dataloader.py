@@ -1,0 +1,118 @@
+"""Data loading.
+
+Analog of the reference's ``DeepSpeedDataLoader`` (`runtime/dataloader.py:33`)
+and ``RepeatingLoader`` (:10). Key difference: JAX is single-controller per
+host, so instead of a per-rank ``DistributedSampler`` the loader yields
+*global* batches on each host's process shard; the engine shards rows over
+the ``data`` mesh axis when placing the batch on devices. For multi-host,
+each process loads its ``process_index``-strided slice.
+"""
+
+import math
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference :10).
+
+    On each restart, advances the wrapped loader's epoch (when it supports
+    ``set_epoch``) so shuffling differs across epochs — the engine path's
+    analog of advancing a DistributedSampler's epoch.
+    """
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.epoch = 0
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.epoch += 1
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(self.epoch)
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+def _default_collate(samples):
+    """Stack a list of samples (dicts of arrays, tuples, or arrays)."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples])
+                for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack([np.asarray(s[i]) for s in samples])
+                           for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """Batched loader over an indexable dataset with per-process sharding.
+
+    ``batch_size`` here is the number of rows this loader emits per
+    ``__next__`` — the engine asks for the *global* train batch and shards
+    it over the mesh. On multi-host runs each process sees a strided subset
+    of the dataset and emits its ``batch_size // process_count`` share.
+    """
+
+    def __init__(self,
+                 dataset,
+                 batch_size,
+                 collate_fn=None,
+                 shuffle=True,
+                 seed=0,
+                 drop_last=True,
+                 process_index=None,
+                 process_count=None):
+        if process_index is None or process_count is None:
+            try:
+                import jax
+                process_index = jax.process_index()
+                process_count = jax.process_count()
+            except Exception:
+                process_index, process_count = 0, 1
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.process_index = process_index
+        self.process_count = process_count
+        self.epoch = 0
+
+        n = len(dataset)
+        self.num_local = n // process_count if drop_last \
+            else math.ceil(n / process_count)
+        self.local_batch = batch_size // process_count
+        assert self.local_batch >= 1, (
+            f"batch_size {batch_size} < process_count {process_count}")
+        self.len = self.num_local // self.local_batch if drop_last \
+            else math.ceil(self.num_local / self.local_batch)
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        # Strided per-process shard (DistributedSampler semantics).
+        local = order[self.process_index::self.process_count][:self.num_local]
+        for i in range(self.len):
+            idx = local[i * self.local_batch:(i + 1) * self.local_batch]
+            if len(idx) == 0:
+                return
+            yield self.collate_fn([self.dataset[int(j)] for j in idx])

@@ -1,0 +1,819 @@
+"""DeepSpeedEngine: the central training wrapper, TPU-native.
+
+Analog of the reference's ``DeepSpeedEngine`` (`runtime/engine.py:91` —
+``forward``:783, ``backward``:824, ``step``:960, checkpoints:1215-1482), with
+the hook-driven mutable-tensor machinery replaced by one compiled train step:
+
+- grad accumulation   → ``lax.scan`` over microbatches inside the step
+- DP gradient allreduce → GSPMD: mean loss over the data-sharded batch
+- ZeRO 1/2/3          → sharding declarations (see `runtime/zero/sharding.py`)
+- fp16 master weights → fp32 params cast to compute dtype inside the grad fn
+- dynamic loss scale  → pure state machine + ``jnp.where`` skip (the
+  data-dependent overflow skip lives *inside* jit)
+- LR/momentum schedule → folded into the step as functions of the counter
+
+The imperative ``forward``/``backward``/``step`` micro-batch API is kept as a
+compatibility shim; ``train_batch`` is the fast path (one XLA program per
+global batch).
+"""
+
+import os
+import json
+from typing import Any, Callable, NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.runtime.config import (
+    ADAM_OPTIMIZER,
+    DeepSpeedConfig,
+    LAMB_OPTIMIZER,
+    ONEBIT_ADAM_OPTIMIZER,
+)
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    LossScaleState,
+    init_loss_scale_state,
+    update_loss_scale,
+)
+from deepspeed_tpu.runtime.lr_schedules import get_lr_scheduler, OneCycle
+from deepspeed_tpu.runtime.utils import check_overflow, clip_by_global_norm, global_norm
+from deepspeed_tpu.runtime.zero.sharding import build_zero_shardings, constrain_tree
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.ops.adam.fused_adam import adam_update, init_adam_state
+from deepspeed_tpu.ops.lamb.fused_lamb import init_lamb_state, lamb_update
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+MEMORY_OPT_ALLREDUCE_SIZE = 500000000
+
+
+class DeviceState(NamedTuple):
+    """Device-resident step state threaded through the compiled train step."""
+    loss_scale: LossScaleState
+    global_step: jnp.ndarray     # i32 — optimizer-step boundaries seen
+    skipped_steps: jnp.ndarray   # i32 — overflow-skipped steps
+
+
+class DeepSpeedEngine:
+    """Training engine around a pure ``loss_fn(params, batch, rng)``."""
+
+    def __init__(self,
+                 args=None,
+                 model=None,
+                 optimizer=None,
+                 model_parameters=None,
+                 training_data=None,
+                 lr_scheduler=None,
+                 mpu=None,
+                 dist_init_required=None,
+                 collate_fn=None,
+                 config=None,
+                 config_params=None,
+                 loss_fn: Optional[Callable] = None,
+                 params=None,
+                 param_specs=None,
+                 mesh=None,
+                 seed: int = 0):
+        # --- resolve the model contract ---------------------------------
+        if loss_fn is None and model is not None and hasattr(model, "loss_fn"):
+            loss_fn = model.loss_fn
+        if params is None and model_parameters is not None:
+            params = model_parameters
+        if params is None and model is not None and hasattr(model, "params"):
+            params = model.params
+        assert loss_fn is not None, (
+            "deepspeed_tpu needs a pure loss_fn(params, batch, rng) — pass "
+            "loss_fn= directly or a model object exposing .loss_fn")
+        assert params is not None, "initial params pytree required"
+        self.module = model
+        self.loss_fn = loss_fn
+
+        # --- config ------------------------------------------------------
+        if config is None and config_params is not None:
+            config = config_params
+        if config is None and args is not None and \
+                getattr(args, "deepspeed_config", None):
+            config = args.deepspeed_config
+        assert config is not None, "config (dict or json path) required"
+
+        self.mesh = mesh if mesh is not None else build_mesh(
+            (config.get("mesh") if isinstance(config, dict) else None))
+        self.dp_world_size = self.mesh.shape["data"]
+        self.mp_world_size = self.mesh.shape["model"]
+        self._config = DeepSpeedConfig(config, world_size=self.dp_world_size)
+
+        # --- precision policy -------------------------------------------
+        if self._config.fp16_enabled:
+            self.compute_dtype = jnp.float16
+        elif self._config.bf16_enabled or self._config.amp_enabled:
+            self.compute_dtype = jnp.bfloat16
+        else:
+            self.compute_dtype = jnp.float32
+        self.dynamic_loss_scale = (self._config.fp16_enabled and
+                                   self._config.loss_scale == 0)
+        if self._config.fp16_enabled and self._config.loss_scale > 0:
+            self.static_loss_scale = float(self._config.loss_scale)
+        else:
+            self.static_loss_scale = 1.0
+
+        # --- counters ----------------------------------------------------
+        self.micro_steps = 0
+        self.global_steps = 0
+
+        # --- optimizer / schedule ----------------------------------------
+        self._configure_optimizer(optimizer)
+        self._configure_lr_scheduler(lr_scheduler)
+
+        # --- shardings & placement ---------------------------------------
+        base_specs = param_specs if param_specs is not None else \
+            jax.tree_util.tree_map(lambda _: PartitionSpec(), params)
+        self._shardings = build_zero_shardings(
+            params, base_specs, self.mesh, self.zero_optimization_stage())
+        # Copy (never alias) the caller's params: the compiled train step
+        # donates the engine's buffers, and donating the caller's arrays
+        # would delete them out from under the caller.
+        fp32 = jax.tree_util.tree_map(
+            lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params)
+        self.params = jax.device_put(fp32, self._shardings["param"])
+        self.opt_state = jax.jit(
+            self.opt_init_fn,
+            out_shardings=self._opt_state_shardings())(self.params)
+        self.device_state = self._init_device_state()
+
+        # --- data --------------------------------------------------------
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(
+                training_data, collate_fn=collate_fn)
+        from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+        self._data_iter = iter(RepeatingLoader(self.training_dataloader)) \
+            if self.training_dataloader is not None else None
+
+        # --- aux ---------------------------------------------------------
+        self.progressive_layer_drop = None
+        if self._config.pld_enabled:
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                **(self._config.pld_params or {}))
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self._config.train_micro_batch_size_per_gpu *
+            self._config.gradient_accumulation_steps,
+            num_workers=self.dp_world_size,
+            steps_per_output=self._config.steps_per_print)
+        self.summary_writer = None
+        if self._config.tensorboard_enabled and jax.process_index() == 0:
+            self.summary_writer = self._get_summary_writer()
+
+        self._rng = jax.random.PRNGKey(seed)
+        self._compiled_train_step = None
+        self._compiled_eval_step = None
+        self._grad_buffer = None
+        self._pending_batch = None
+        self._last_metrics = {}
+
+        if self._config.dump_state:
+            self._config.print("DeepSpeedEngine configuration")
+
+    # ------------------------------------------------------------------
+    # configuration accessors (reference engine.py:241-396)
+    # ------------------------------------------------------------------
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self._config.gradient_accumulation_steps
+
+    def zero_optimization_stage(self):
+        return self._config.zero_optimization_stage
+
+    def zero_optimization(self):
+        return self._config.zero_enabled
+
+    def fp16_enabled(self):
+        return self._config.fp16_enabled
+
+    def bfloat16_enabled(self):
+        return self._config.bf16_enabled
+
+    def gradient_clipping(self):
+        return self._config.gradient_clipping
+
+    def steps_per_print(self):
+        return self._config.steps_per_print
+
+    def wall_clock_breakdown(self):
+        return self._config.wall_clock_breakdown
+
+    def memory_breakdown(self):
+        return self._config.memory_breakdown
+
+    def sparse_gradients_enabled(self):
+        return self._config.sparse_gradients_enabled
+
+    def progressive_layer_drop_enabled(self):
+        return self._config.pld_enabled
+
+    def dump_state(self):
+        return self._config.dump_state
+
+    @property
+    def config(self):
+        return self._config
+
+    @property
+    def loss_scale(self):
+        if self.dynamic_loss_scale:
+            return float(self.device_state.loss_scale.cur_scale)
+        return self.static_loss_scale
+
+    @property
+    def skipped_steps(self):
+        return int(self.device_state.skipped_steps)
+
+    # ------------------------------------------------------------------
+    # setup helpers
+    # ------------------------------------------------------------------
+    def _configure_optimizer(self, client_optimizer):
+        """Resolve (init_fn, update_fn) — the analog of
+        `_configure_basic_optimizer` (engine.py:577)."""
+        if client_optimizer is not None and not isinstance(client_optimizer, str):
+            # Client passed one of our optimizer wrapper objects.
+            self.client_optimizer = client_optimizer
+            self.opt_init_fn = client_optimizer.init
+            self._opt_update = lambda p, g, s, lr, beta1: \
+                client_optimizer.update(p, g, s, lr=lr, beta1=beta1)
+            self._base_lr = getattr(client_optimizer, "lr", 1e-3)
+            self._betas = getattr(client_optimizer, "betas", (0.9, 0.999))
+            self.optimizer_name = type(client_optimizer).__name__.lower()
+            return
+        self.client_optimizer = None
+
+        name = (self._config.optimizer_name or ADAM_OPTIMIZER).lower()
+        opt_params = dict(self._config.optimizer_params or {})
+        lr = opt_params.pop("lr", 1e-3)
+        betas = tuple(opt_params.pop("betas", (0.9, 0.999)))
+        eps = opt_params.pop("eps", 1e-8)
+        weight_decay = opt_params.pop("weight_decay", 0.0)
+        bias_correction = opt_params.pop("bias_correction", True)
+        self._base_lr = lr
+        self.optimizer_name = name
+
+        if name in (ADAM_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, "adamw"):
+            adam_w_mode = opt_params.pop("adam_w_mode", name == "adamw")
+            self.opt_init_fn = init_adam_state
+            self._opt_update = lambda p, g, s, lr_, beta1: adam_update(
+                p, g, s, lr=lr_, beta1=beta1, beta2=betas[1], eps=eps,
+                weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+                bias_correction=bias_correction)
+        elif name == LAMB_OPTIMIZER:
+            max_coeff = opt_params.pop("max_coeff", 10.0)
+            min_coeff = opt_params.pop("min_coeff", 0.01)
+            self.opt_init_fn = init_lamb_state
+            self._opt_update = lambda p, g, s, lr_, beta1: lamb_update(
+                p, g, s, lr=lr_, beta1=beta1, beta2=betas[1], eps=eps,
+                weight_decay=weight_decay, bias_correction=bias_correction,
+                max_coeff=max_coeff, min_coeff=min_coeff)
+        else:
+            raise ValueError(f"unknown optimizer {name!r}; supported: adam, "
+                             f"adamw, lamb, onebitadam")
+        self._betas = betas
+
+    def _configure_lr_scheduler(self, client_scheduler):
+        """Schedule resolution (reference engine.py:398-444)."""
+        self.lr_scheduler = None
+        if client_scheduler is not None:
+            self.lr_scheduler = client_scheduler
+        elif self._config.scheduler_name is not None:
+            self.lr_scheduler = get_lr_scheduler(self._config.scheduler_name,
+                                                 self._config.scheduler_params or {})
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "lr_at"):
+            # Our schedules fold into the compiled step (device-resident).
+            self._lr_fn = self.lr_scheduler.lr_at
+            self._lr_foldable = True
+        elif self.lr_scheduler is not None and \
+                hasattr(self.lr_scheduler, "get_lr"):
+            # Foreign scheduler: read its lr host-side every step and feed it
+            # into the compiled step as a scalar argument.
+            self._lr_fn = None
+            self._lr_foldable = False
+            logger.info("client lr scheduler without lr_at(): lr will be "
+                        "read host-side each step")
+        else:
+            base = self._base_lr
+            self._lr_fn = lambda step: jnp.asarray(base, jnp.float32)
+            self._lr_foldable = True
+        if isinstance(self.lr_scheduler, OneCycle) and \
+                self.lr_scheduler.cycle_momentum:
+            self._mom_fn = self.lr_scheduler.mom_at
+        else:
+            beta1 = getattr(self, "_betas", (0.9, 0.999))[0]
+            self._mom_fn = lambda step: jnp.asarray(beta1, jnp.float32)
+
+    def _opt_state_shardings(self):
+        """Shardings for the optimizer-state pytree: the m/v moment trees
+        follow the (possibly ZeRO-sharded) opt layout; the step counter
+        replicates. AdamState and LambState share the (m, v, step) shape."""
+        opt = self._shardings["opt"]
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        sample = jax.eval_shape(self.opt_init_fn, self.params)
+        return type(sample)(m=opt, v=opt, step=rep)
+
+    def _current_host_lr(self):
+        """Host-side lr for schedulers the compiled step can't fold."""
+        if self._lr_foldable:
+            return 0.0  # unused: lr comes from the folded schedule
+        lrs = self.lr_scheduler.get_lr()
+        return float(lrs[0] if isinstance(lrs, (list, tuple)) else lrs)
+
+    def _init_device_state(self):
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        init_scale = float(self._config.initial_dynamic_scale) \
+            if self.dynamic_loss_scale else self.static_loss_scale
+        delayed_shift = 1
+        if self._config.dynamic_loss_scale_args:
+            delayed_shift = self._config.dynamic_loss_scale_args.get(
+                "delayed_shift", 1)
+        state = DeviceState(
+            loss_scale=init_loss_scale_state(init_scale, delayed_shift),
+            global_step=jnp.asarray(0, jnp.int32),
+            skipped_steps=jnp.asarray(0, jnp.int32))
+        return jax.device_put(state, rep)
+
+    def _get_summary_writer(self):
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception:
+            logger.warning("tensorboard unavailable; disabling")
+            return None
+        base = os.environ.get("DLWS_JOB_ID", "local")
+        log_dir = os.path.join(self._config.tensorboard_output_path or
+                               os.path.join(".", "runs"), base,
+                               self._config.tensorboard_job_name)
+        os.makedirs(log_dir, exist_ok=True)
+        return SummaryWriter(log_dir=log_dir)
+
+    def deepspeed_io(self, dataset, batch_size=None, route=None,
+                     collate_fn=None, num_local_io_workers=None,
+                     data_sampler=None):
+        """Build the DP-sharded loader (reference engine.py:706). The loader
+        yields *global* batches of ``train_batch_size`` rows; the engine
+        shards them over the data axis when feeding the compiled step."""
+        if batch_size is None:
+            batch_size = self._config.train_batch_size
+        return DeepSpeedDataLoader(dataset,
+                                   batch_size=batch_size,
+                                   collate_fn=collate_fn,
+                                   drop_last=True)
+
+    # ------------------------------------------------------------------
+    # the compiled train step
+    # ------------------------------------------------------------------
+    def _scale_args(self):
+        args = dict(scale_factor=2.0, scale_window=1000, min_scale=1.0,
+                    delayed_shift=1, consecutive_hysteresis=False)
+        if self._config.dynamic_loss_scale_args:
+            a = self._config.dynamic_loss_scale_args
+            args.update(scale_window=a.get("scale_window", 1000),
+                        min_scale=a.get("min_scale", 1.0),
+                        delayed_shift=a.get("delayed_shift", 1))
+        return args
+
+    def _make_train_step(self):
+        accum = self._config.gradient_accumulation_steps
+        compute_dtype = self.compute_dtype
+        fp16 = self._config.fp16_enabled
+        clip = float(self._config.gradient_clipping or 0.0)
+        prescale = self._config.prescale_gradients
+        predivide = float(self._config.gradient_predivide_factor or 1.0)
+        lr_fn = self._lr_fn
+        mom_fn = self._mom_fn
+        opt_update = self._opt_update
+        loss_fn = self.loss_fn
+        grad_shardings = self._shardings["grad"] if \
+            self.zero_optimization_stage() >= 2 else None
+        param_shardings = self._shardings["param"]
+        opt_shardings = self._shardings["opt"]
+        scale_args = self._scale_args()
+        dynamic = self.dynamic_loss_scale
+        static_scale = self.static_loss_scale
+
+        def cast_params(p):
+            return jax.tree_util.tree_map(
+                lambda x: x.astype(compute_dtype), p)
+
+        def micro_grads(params, micro_batch, rng, scale):
+            def scaled_loss(p):
+                loss = loss_fn(cast_params(p), micro_batch, rng)
+                return loss * scale, loss
+            (_, loss), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True)(params)
+            return loss, grads
+
+        def train_step(params, opt_state, dstate, batch, rng, lr_in):
+            scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
+                else jnp.asarray(static_scale, jnp.float32)
+
+            if accum == 1:
+                micro = jax.tree_util.tree_map(lambda x: x[0], batch)
+                loss_sum, grads = micro_grads(params, micro, rng, scale)
+            else:
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+                def body(carry, micro):
+                    g_acc, loss_acc, key = carry
+                    key, sub = jax.random.split(key)
+                    loss, g = micro_grads(params, micro, sub, scale)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    return (g_acc, loss_acc + loss, key), None
+
+                (grads, loss_sum, _), _ = jax.lax.scan(
+                    body, (zeros, jnp.asarray(0.0, jnp.float32), rng), batch)
+
+            # Unscale + average over microbatches. The reference's
+            # prescale_gradients / gradient_predivide_factor knobs
+            # (allreduce_bucket pre/post scaling, engine.py:1082) exist to
+            # keep fp16 reductions in range; here the cross-replica mean is
+            # computed by XLA in fp32, so they are accepted for config
+            # compatibility but are intentionally no-ops.
+            denom = scale * accum
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) / denom), grads)
+            if grad_shardings is not None:
+                grads = constrain_tree(grads, grad_shardings)
+
+            overflow = check_overflow(grads) if fp16 else jnp.asarray(False)
+            grad_norm = global_norm(grads)
+            applied_norm = grad_norm
+            if clip > 0:
+                grads = clip_by_global_norm(grads, clip, norm=grad_norm)
+                applied_norm = global_norm(grads)
+
+            lr = lr_fn(dstate.global_step) if lr_fn is not None else lr_in
+            beta1 = mom_fn(dstate.global_step)
+            new_params, new_opt = opt_update(params, grads, opt_state, lr, beta1)
+
+            # Overflow → skip the update (reference stage2.py:1341-1362).
+            def select(old, new):
+                return jax.tree_util.tree_map(
+                    lambda o, n: jnp.where(overflow, o, n), old, new)
+            params_out = constrain_tree(select(params, new_params),
+                                        param_shardings)
+            opt_out = type(opt_state)(
+                m=constrain_tree(select(opt_state.m, new_opt.m), opt_shardings),
+                v=constrain_tree(select(opt_state.v, new_opt.v), opt_shardings),
+                step=jnp.where(overflow, opt_state.step, new_opt.step))
+
+            if fp16 and dynamic:
+                new_scale = update_loss_scale(dstate.loss_scale, overflow,
+                                              **scale_args)
+            else:
+                new_scale = dstate.loss_scale
+            dstate_out = DeviceState(
+                loss_scale=new_scale,
+                global_step=dstate.global_step + 1,
+                skipped_steps=dstate.skipped_steps +
+                overflow.astype(jnp.int32))
+            metrics = {
+                "loss": loss_sum / accum,
+                "grad_norm": grad_norm,
+                "applied_grad_norm": applied_norm,
+                "lr": lr,
+                "loss_scale": scale,
+                "overflow": overflow,
+            }
+            return params_out, opt_out, dstate_out, metrics
+
+        # Inputs arrive pre-placed (device_put with committed shardings);
+        # outputs are pinned by the constrain_tree calls above, so plain jit
+        # with donation suffices.
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def _shard_batch(self, batch):
+        """Host-side: this process's batch rows → [accum, per_step_global, ...]
+        with the per-step dim sharded over ``data``.
+
+        Single-host: the caller passes the full global batch
+        (``train_batch_size`` rows). Multi-host: each process passes its
+        ``train_batch_size // process_count`` share (what
+        DeepSpeedDataLoader emits) and the global array is assembled from
+        the per-process shards.
+        """
+        accum = self._config.gradient_accumulation_steps
+        sharding = NamedSharding(self.mesh, PartitionSpec(None, "data"))
+        n_proc = jax.process_count()
+        expected = self._config.train_batch_size // n_proc
+
+        def place(x):
+            x = np.asarray(x)
+            assert x.shape[0] == expected, (
+                f"train_batch expects {expected} rows per process "
+                f"(train_batch_size {self._config.train_batch_size} / "
+                f"{n_proc} processes), got {x.shape[0]}")
+            x = x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+            if n_proc == 1:
+                return jax.device_put(x, sharding)
+            return jax.make_array_from_process_local_data(sharding, x)
+
+        return jax.tree_util.tree_map(place, batch)
+
+    # ------------------------------------------------------------------
+    # public training API
+    # ------------------------------------------------------------------
+    def train_batch(self, batch=None):
+        """One full optimizer step over a global batch (the fast path).
+
+        ``batch``: pytree of arrays with leading dim ``train_batch_size``,
+        or None to pull from the engine dataloader.
+        """
+        if batch is None:
+            assert self._data_iter is not None, \
+                "no training_data given; pass a batch explicitly"
+            batch = next(self._data_iter)
+        if self._compiled_train_step is None:
+            self._compiled_train_step = self._make_train_step()
+
+        if self.wall_clock_breakdown():
+            self.timers("train_batch").start()
+        self.tput_timer.start()
+        placed = self._shard_batch(batch)
+        self._rng, step_rng = jax.random.split(self._rng)
+        lr_in = jnp.asarray(self._current_host_lr(), jnp.float32)
+        self.params, self.opt_state, self.device_state, metrics = \
+            self._compiled_train_step(self.params, self.opt_state,
+                                      self.device_state, placed, step_rng,
+                                      lr_in)
+        self.tput_timer.stop()
+        if self.wall_clock_breakdown():
+            self.timers("train_batch").stop()
+            self.timers.log(["train_batch"],
+                            memory_breakdown=self.memory_breakdown())
+
+        self.micro_steps += self._config.gradient_accumulation_steps
+        self.global_steps += 1
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        if self.lr_scheduler is not None and \
+                hasattr(self.lr_scheduler, "step"):
+            self.lr_scheduler.step()
+        self._last_metrics = metrics
+
+        if self.global_steps % self._config.steps_per_print == 0:
+            loss = float(metrics["loss"])
+            lr = float(metrics["lr"])
+            log_dist(f"step={self.global_steps}, skipped="
+                     f"{self.skipped_steps}, lr={lr:.6g}, loss={loss:.5f}",
+                     ranks=[0])
+        if self.summary_writer is not None:
+            self.summary_writer.add_scalar("Train/loss",
+                                           float(metrics["loss"]),
+                                           self.global_steps)
+            self.summary_writer.add_scalar("Train/lr", float(metrics["lr"]),
+                                           self.global_steps)
+            if self._config.fp16_enabled:
+                self.summary_writer.add_scalar(
+                    "Train/loss_scale", float(metrics["loss_scale"]),
+                    self.global_steps)
+        return metrics["loss"]
+
+    def eval_batch(self, batch):
+        """Forward-only loss over a global batch (no grad, no state change)."""
+        if self._compiled_eval_step is None:
+            compute_dtype = self.compute_dtype
+            loss_fn = self.loss_fn
+
+            def eval_step(params, batch):
+                cast = jax.tree_util.tree_map(
+                    lambda x: x.astype(compute_dtype), params)
+                return loss_fn(cast, batch, None)
+
+            self._compiled_eval_step = jax.jit(eval_step)
+        placed = self._place_rows(batch)
+        return self._compiled_eval_step(self.params, placed)
+
+    def _place_rows(self, batch):
+        """Place a [rows, ...] batch sharded over ``data``; multi-host safe."""
+        sharding = NamedSharding(self.mesh, PartitionSpec("data"))
+        n_proc = jax.process_count()
+
+        def place(x):
+            x = np.asarray(x)
+            if n_proc == 1:
+                return jax.device_put(x, sharding)
+            return jax.make_array_from_process_local_data(sharding, x)
+
+        return jax.tree_util.tree_map(place, batch)
+
+    # ------------------------------------------------------------------
+    # forward/backward/step compatibility shim (reference hot-loop API)
+    # ------------------------------------------------------------------
+    def forward(self, batch):
+        """Compatibility: compute the micro-batch loss; remember the batch so
+        ``backward()`` can compute gradients for it."""
+        self._pending_batch = batch
+        loss = self.eval_batch(batch)
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None, batch=None):
+        """Compatibility: accumulate gradients for the pending micro-batch.
+        (In JAX the gradient comes from re-running the fused fwd+bwd program,
+        not from a stored graph — prefer ``train_batch``.)"""
+        if batch is None:
+            batch = self._pending_batch
+        assert batch is not None, "call forward(batch) first or pass batch="
+        if not hasattr(self, "_micro_grad_fn"):
+            compute_dtype = self.compute_dtype
+            loss_fn = self.loss_fn
+
+            def grad_fn(params, b, rng, scale):
+                def f(p):
+                    cast = jax.tree_util.tree_map(
+                        lambda x: x.astype(compute_dtype), p)
+                    loss = loss_fn(cast, b, rng)
+                    return loss * scale, loss
+                (_, loss), grads = jax.value_and_grad(f, has_aux=True)(params)
+                return loss, grads
+
+            self._micro_grad_fn = jax.jit(grad_fn)
+        placed = self._place_rows(batch)
+        self._rng, rng = jax.random.split(self._rng)
+        scale = jnp.asarray(self.loss_scale, jnp.float32)
+        loss_val, grads = self._micro_grad_fn(self.params, placed, rng, scale)
+        if self._grad_buffer is None:
+            self._grad_buffer = grads
+        else:
+            self._grad_buffer = jax.tree_util.tree_map(
+                jnp.add, self._grad_buffer, grads)
+        self.micro_steps += 1
+        self._pending_batch = None
+        return loss_val
+
+    def is_gradient_accumulation_boundary(self):
+        return self.micro_steps % self._config.gradient_accumulation_steps == 0
+
+    def step(self):
+        """Compatibility: apply the buffered gradients at the accumulation
+        boundary (reference `_take_model_step`, engine.py:922)."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        assert self._grad_buffer is not None, "no gradients accumulated"
+        accum = self._config.gradient_accumulation_steps
+        denom = self.loss_scale * accum
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) / denom, self._grad_buffer)
+
+        # fp16: overflow vote + skip + scale update (same semantics as the
+        # compiled path / reference stage2.py:1341-1362).
+        overflow = bool(check_overflow(grads)) if self.fp16_enabled() else False
+        if not overflow:
+            clip = float(self._config.gradient_clipping or 0.0)
+            if clip > 0:
+                grads = clip_by_global_norm(grads, clip)
+            lr = self._lr_fn(self.device_state.global_step) \
+                if self._lr_foldable else self._current_host_lr()
+            beta1 = self._mom_fn(self.device_state.global_step)
+            self.params, self.opt_state = self._opt_update(
+                self.params, grads, self.opt_state, lr, beta1)
+        if self.fp16_enabled() and self.dynamic_loss_scale:
+            new_scale = update_loss_scale(self.device_state.loss_scale,
+                                          overflow, **self._scale_args())
+        else:
+            new_scale = self.device_state.loss_scale
+        self.device_state = DeviceState(
+            loss_scale=new_scale,
+            global_step=self.device_state.global_step + 1,
+            skipped_steps=self.device_state.skipped_steps + int(overflow))
+        self._grad_buffer = None
+        self.global_steps += 1
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
+            self.lr_scheduler.step()
+
+    # ------------------------------------------------------------------
+    # checkpointing (reference engine.py:1215-1482)
+    # ------------------------------------------------------------------
+    def _get_ckpt_name(self, checkpoints_path, tag):
+        return os.path.join(checkpoints_path, str(tag))
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        """Single logical checkpoint with sharded async-capable writes
+        (orbax/tensorstore) — supersedes the reference's file-per-rank layout
+        while keeping its capabilities: counters, optimizer state, loss-scale
+        state, lr-scheduler state, client state, elastic dp resize on load.
+        """
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        path = os.path.abspath(self._get_ckpt_name(save_dir, tag))
+        os.makedirs(path, exist_ok=True)
+
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        state = {
+            "params": self.params,
+            "opt_state": self._opt_state_to_tree(),
+            "device_state": {
+                "cur_scale": self.device_state.loss_scale.cur_scale,
+                "cur_iter": self.device_state.loss_scale.cur_iter,
+                "last_overflow_iter":
+                    self.device_state.loss_scale.last_overflow_iter,
+                "cur_hysteresis": self.device_state.loss_scale.cur_hysteresis,
+                "global_step": self.device_state.global_step,
+                "skipped_steps": self.device_state.skipped_steps,
+            },
+        }
+        ckptr.save(os.path.join(path, "state"), state, force=True)
+
+        meta = {
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "dp_world_size": self.dp_world_size,
+            "mp_world_size": self.mp_world_size,
+            "lr_scheduler": self.lr_scheduler.state_dict()
+            if self.lr_scheduler is not None and
+            hasattr(self.lr_scheduler, "state_dict") else None,
+            "client_state": client_state or {},
+        }
+        if jax.process_index() == 0:
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump(meta, f)
+            if save_latest:
+                with open(os.path.join(save_dir, "latest"), "w") as f:
+                    f.write(str(tag))
+        log_dist(f"saved checkpoint {path}", ranks=[0])
+        return True
+
+    def _opt_state_to_tree(self):
+        s = self.opt_state
+        return {"m": s.m, "v": s.v, "step": s.step}
+
+    def _opt_state_from_tree(self, tree, template):
+        return type(template)(m=tree["m"], v=tree["v"],
+                              step=jnp.asarray(tree["step"], jnp.int32))
+
+    def load_checkpoint(self, load_dir, tag=None,
+                        load_optimizer_states=True,
+                        load_lr_scheduler_states=True):
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if os.path.isfile(latest):
+                with open(latest) as f:
+                    tag = f.read().strip()
+            else:
+                logger.warning(f"no 'latest' file at {load_dir}; cannot load")
+                return None, {}
+        path = os.path.abspath(self._get_ckpt_name(load_dir, tag))
+        if not os.path.isdir(path):
+            logger.warning(f"checkpoint {path} not found")
+            return None, {}
+
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(os.path.join(path, "state"))
+
+        # Re-place on the *current* mesh/shardings: the elastic-checkpoint
+        # capability (reference stage1.py:1030 re-partitions for a new dp
+        # world size) comes for free from resharding on load.
+        self.params = jax.device_put(
+            jax.tree_util.tree_map(jnp.asarray, restored["params"]),
+            self._shardings["param"])
+        if load_optimizer_states:
+            opt_tree = jax.tree_util.tree_map(jnp.asarray,
+                                              restored["opt_state"])
+            self.opt_state = jax.device_put(
+                self._opt_state_from_tree(opt_tree, self.opt_state),
+                self._opt_state_shardings())
+        ds = restored["device_state"]
+        self.device_state = jax.device_put(
+            DeviceState(
+                loss_scale=LossScaleState(
+                    cur_scale=jnp.asarray(ds["cur_scale"], jnp.float32),
+                    cur_iter=jnp.asarray(ds["cur_iter"], jnp.int32),
+                    last_overflow_iter=jnp.asarray(ds["last_overflow_iter"],
+                                                   jnp.int32),
+                    cur_hysteresis=jnp.asarray(ds["cur_hysteresis"],
+                                               jnp.int32)),
+                global_step=jnp.asarray(ds["global_step"], jnp.int32),
+                skipped_steps=jnp.asarray(ds["skipped_steps"], jnp.int32)),
+            NamedSharding(self.mesh, PartitionSpec()))
+
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        self.global_steps = meta["global_steps"]
+        self.micro_steps = meta["micro_steps"]
+        if load_lr_scheduler_states and meta.get("lr_scheduler") and \
+                self.lr_scheduler is not None and \
+                hasattr(self.lr_scheduler, "load_state_dict"):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        log_dist(f"loaded checkpoint {path} (saved at dp="
+                 f"{meta.get('dp_world_size')}, now dp={self.dp_world_size})",
+                 ranks=[0])
+        return path, meta.get("client_state", {})

@@ -1,0 +1,16 @@
+"""Pipeline engine (under construction).
+
+Analog of the reference's ``PipelineEngine`` (`runtime/pipe/engine.py:152`).
+The TPU execution model: per-stage compiled programs over submeshes of the
+``pipe`` axis with instruction-list scheduling (see `runtime/pipe/schedule.py`)
+— lands in the pipeline milestone; until then construction fails loudly.
+"""
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+
+class PipelineEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "PipelineEngine is not wired up yet in this build; "
+            "use DeepSpeedEngine (dp/tp/ZeRO) for now.")

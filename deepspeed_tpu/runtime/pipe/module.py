@@ -1,0 +1,152 @@
+"""Pipeline module: layer specs and stage partitioning.
+
+Analog of the reference's ``PipelineModule``/``LayerSpec``/``TiedLayerSpec``
+(`runtime/pipe/module.py:85,23,71`). A pipeline model is a sequence of layer
+specs; stages are assigned by the same partitioning policies
+(``uniform`` / ``parameters`` / ``type:regex``) using the shared
+``partition_balanced`` math (`runtime/utils.py:361`).
+
+TPU-native execution model: each layer spec builds a pure
+``(params, x, rng) -> x`` callable; the pipeline engine runs stages over the
+``pipe`` mesh axis with collective-permute transfers (see
+`runtime/pipe/engine.py`), so a "stage" here is a contiguous slice of specs
+rather than a process-local nn.Sequential.
+"""
+
+import re
+from typing import Any, Callable, List, Optional
+
+import jax
+
+from deepspeed_tpu.runtime.utils import partition_balanced, partition_uniform
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    """Deferred layer: builds lazily so only the owning stage materializes
+    params (the reference's motivation at `pipe/module.py:23`).
+
+    ``typename`` is a factory returning an object with:
+      - ``init(rng, x_shape) -> params`` (or a flax Module with .init)
+      - ``apply(params, x, rng=None) -> x``
+    For flax modules, pass the module class and kwargs; adapters below
+    normalize the interface.
+    """
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not callable(typename):
+            raise RuntimeError("LayerSpec requires a callable typename")
+
+    def build(self, log=False):
+        if log:
+            logger.info(f"building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        name = getattr(self.typename, "__name__", str(self.typename))
+        args = ", ".join(
+            [repr(a) for a in self.module_args] +
+            [f"{k}={v!r}" for k, v in self.module_kwargs.items()])
+        return f"LayerSpec({name}, {args})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose parameters are shared with every other layer of the same
+    ``key`` (reference `pipe/module.py:71`). The pipeline engine keeps one
+    owner copy and reduces tied grads across the stages that use it."""
+
+    def __init__(self, key, typename, *module_args,
+                 forward_fn=None, tied_weight_attr="embedding",
+                 **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """A model defined as a list of LayerSpecs partitioned into stages.
+
+    Args mirror the reference (`pipe/module.py:85`): ``layers``,
+    ``num_stages``, ``loss_fn``, ``partition_method``,
+    ``activation_checkpoint_interval``, ``seed_layers``.
+    """
+
+    def __init__(self,
+                 layers: List[Any],
+                 num_stages: Optional[int] = None,
+                 topology=None,
+                 loss_fn: Optional[Callable] = None,
+                 seed_layers: bool = False,
+                 base_seed: int = 1234,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0):
+        self.specs = [
+            spec if isinstance(spec, LayerSpec) else LayerSpec(spec)
+            if callable(spec) else spec
+            for spec in layers
+        ]
+        self.num_stages = num_stages
+        self.topology = topology
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self._partition = None
+
+    def __len__(self):
+        return len(self.specs)
+
+    # -- partitioning (reference pipe/module.py:348 `_partition_layers`) ---
+    def partition_layers(self, num_stages=None, weights=None):
+        """Compute stage boundaries: list of len num_stages+1.
+
+        ``parameters``: balance by per-layer parameter count (caller provides
+        ``weights``; falls back to uniform when absent).
+        ``uniform``: balance by layer count.
+        ``type:regex``: balance by count of layers whose class name matches.
+        """
+        num_stages = num_stages or self.num_stages
+        assert num_stages, "num_stages required"
+        method = (self.partition_method or "parameters").lower()
+
+        if method == "uniform":
+            parts = partition_uniform(len(self.specs), num_stages)
+        elif method == "parameters":
+            if weights is None:
+                parts = partition_uniform(len(self.specs), num_stages)
+            else:
+                parts = partition_balanced(weights, num_stages)
+        elif method.startswith("type:"):
+            layertype = method.split(":", 1)[1]
+            binary_weights = [0] * len(self.specs)
+            for idx, spec in enumerate(self.specs):
+                name = getattr(spec.typename, "__name__", "")
+                if re.match(layertype, name, re.IGNORECASE):
+                    binary_weights[idx] = 1
+            parts = partition_balanced(binary_weights, num_stages)
+        elif method == "profile":
+            raise NotImplementedError("profile-based partitioning TBD")
+        else:
+            raise NotImplementedError(f"Partitioning method {method}")
+
+        self._partition = parts
+        return parts
+
+    def stage_layers(self, stage_id, num_stages=None, weights=None):
+        """Spec slice owned by ``stage_id``."""
+        if self._partition is None:
+            self.partition_layers(num_stages, weights)
+        lo, hi = self._partition[stage_id], self._partition[stage_id + 1]
+        return self.specs[lo:hi]
+
+    def tied_keys(self):
+        keys = []
+        for spec in self.specs:
+            if isinstance(spec, TiedLayerSpec) and spec.key not in keys:
+                keys.append(spec.key)
+        return keys

@@ -1,0 +1,102 @@
+"""ZeRO as GSPMD sharding declarations.
+
+The reference implements ZeRO-1/2 with hand-coded flatten/partition/
+reduce-scatter/all-gather machinery driven by per-param backward hooks
+(`runtime/zero/stage1.py:104`, `stage2.py:92`). On TPU the same capabilities
+are sharding *declarations* over the ``data`` mesh axis (the ZeRO-DP ≡
+weight-update-sharding equivalence; see PAPERS.md "Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training"):
+
+- stage 1 — optimizer state (fp32 masters + moments) sharded over ``data``;
+  XLA emits a reduce-scatter of grads into the shard and an all-gather of
+  updated params, exactly the collectives stage1.py hand-codes at :533,:692.
+- stage 2 — gradients additionally constrained to the sharded layout inside
+  the step (``with_sharding_constraint``), so the full replicated gradient
+  never materializes — the IPG-bucket capability of stage2.py:613.
+- stage 3 — parameters themselves sharded over ``data`` (beyond the
+  reference, which caps at stage 2); XLA all-gathers weights just-in-time
+  per layer.
+
+Overlap of grad communication with backward compute (stage2's
+``overlap_comm``) falls out of XLA's latency-hiding scheduler rather than a
+dedicated reduction stream.
+"""
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+import jax
+
+
+def zero_partition_spec(shape, base_spec, mesh, axis="data"):
+    """Augment ``base_spec`` by sharding one more dimension over ``axis``.
+
+    Picks the largest dimension that (a) is not already sharded by
+    ``base_spec`` and (b) divides evenly by the axis size; returns the base
+    spec unchanged when nothing qualifies (small params stay replicated —
+    the analog of the reference's padding of sub-partitions, without the
+    padding).
+    """
+    axis_size = mesh.shape[axis]
+    if axis_size == 1 or not shape:
+        return base_spec
+    spec = tuple(base_spec) if base_spec else ()
+    spec = spec + (None,) * (len(shape) - len(spec))
+    best_dim, best_size = None, 0
+    for dim, size in enumerate(shape):
+        if spec[dim] is not None:
+            continue
+        if size % axis_size == 0 and size > best_size:
+            best_dim, best_size = dim, size
+    if best_dim is None:
+        return PartitionSpec(*spec)
+    new_spec = list(spec)
+    new_spec[best_dim] = axis
+    return PartitionSpec(*new_spec)
+
+
+def build_zero_shardings(params, base_specs, mesh, stage, axis="data"):
+    """Per-leaf NamedShardings for params / optimizer state / gradients.
+
+    Returns a dict with ``param``, ``opt``, ``grad`` pytrees of NamedSharding.
+    """
+    def base_of(path_leaf_spec):
+        return path_leaf_spec if path_leaf_spec is not None else PartitionSpec()
+
+    def param_spec(leaf, spec):
+        if stage >= 3:
+            return zero_partition_spec(leaf.shape, base_of(spec), mesh, axis)
+        return base_of(spec)
+
+    def opt_spec(leaf, spec):
+        if stage >= 1:
+            return zero_partition_spec(leaf.shape, base_of(spec), mesh, axis)
+        return base_of(spec)
+
+    def grad_spec(leaf, spec):
+        if stage >= 2:
+            return zero_partition_spec(leaf.shape, base_of(spec), mesh, axis)
+        return base_of(spec)
+
+    def shard(fn):
+        # base_specs has PartitionSpec leaves at params' leaf positions;
+        # flatten_up_to keeps each spec whole (PartitionSpec is a tuple
+        # subclass, so a plain tree_map over it would descend into it).
+        treedef = jax.tree_util.tree_structure(params)
+        leaves = treedef.flatten_up_to(base_specs)
+        spec_tree = jax.tree_util.tree_unflatten(treedef, leaves)
+        return jax.tree_util.tree_map(
+            lambda leaf, spec: NamedSharding(mesh, fn(leaf, spec)),
+            params, spec_tree)
+
+    return {
+        "param": shard(param_spec),
+        "opt": shard(opt_spec),
+        "grad": shard(grad_spec),
+    }
+
+
+def constrain_tree(tree, sharding_tree):
+    """Apply with_sharding_constraint leaf-wise (inside jit)."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, s),
+        tree, sharding_tree)

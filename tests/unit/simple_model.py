@@ -1,0 +1,68 @@
+"""Test fixtures: tiny models + random data + config helpers.
+
+Analog of the reference's `tests/unit/simple_model.py` (SimpleModel,
+random_dataloader, args_from_dict).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def simple_init_params(rng, hidden_dim=10, nlayers=2):
+    """A small MLP params pytree."""
+    keys = jax.random.split(rng, nlayers)
+    params = {}
+    for i, k in enumerate(keys):
+        params[f"linear_{i}"] = {
+            "kernel": jax.random.normal(k, (hidden_dim, hidden_dim),
+                                        jnp.float32) * 0.1,
+            "bias": jnp.zeros((hidden_dim,), jnp.float32),
+        }
+    return params
+
+
+def simple_loss_fn(params, batch, rng=None):
+    """MSE of an MLP over batch dict(x, y)."""
+    x = batch["x"]
+    n = len(params)
+    for i in range(n):
+        layer = params[f"linear_{i}"]
+        x = x @ layer["kernel"] + layer["bias"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return jnp.mean(jnp.square(x - batch["y"]))
+
+
+def random_batch(batch_size, hidden_dim=10, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": rng.normal(size=(batch_size, hidden_dim)).astype(dtype),
+        "y": rng.normal(size=(batch_size, hidden_dim)).astype(dtype),
+    }
+
+
+class RandomDataset:
+    """Indexable dataset of (x, y) pairs for dataloader tests."""
+
+    def __init__(self, total_samples, hidden_dim=10, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)
+        self.y = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, idx):
+        return {"x": self.x[idx], "y": self.y[idx]}
+
+
+def base_config(**overrides):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    cfg.update(overrides)
+    return cfg

@@ -1,0 +1,138 @@
+"""Fused Adam/Lamb numerics, including parity vs torch.optim
+(the analog of the reference's `test_cpu_adam.py` torch-comparison tests).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.ops.adam.fused_adam import (
+    FusedAdam,
+    adam_update,
+    init_adam_state,
+)
+from deepspeed_tpu.ops.lamb.fused_lamb import (
+    FusedLamb,
+    init_lamb_state,
+    lamb_update,
+)
+
+
+def tree_close(a, b, rtol=1e-5, atol=1e-6):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                                rtol=rtol, atol=atol), a, b)
+
+
+def test_adam_matches_torch_adam():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(7, 5)).astype(np.float32)
+
+    t_param = torch.nn.Parameter(torch.tensor(w.copy()))
+    t_opt = torch.optim.Adam([t_param], lr=1e-2, betas=(0.9, 0.999), eps=1e-8)
+
+    params = {"w": jnp.asarray(w)}
+    state = init_adam_state(params)
+    for step in range(5):
+        g = rng.normal(size=w.shape).astype(np.float32)
+        t_param.grad = torch.tensor(g.copy())
+        t_opt.step()
+        params, state = adam_update(params, {"w": jnp.asarray(g)}, state,
+                                    lr=1e-2, adam_w_mode=False)
+    np.testing.assert_allclose(np.asarray(params["w"]),
+                               t_param.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_matches_torch_adamw():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(8,)).astype(np.float32)
+
+    t_param = torch.nn.Parameter(torch.tensor(w.copy()))
+    t_opt = torch.optim.AdamW([t_param], lr=1e-2, weight_decay=0.1)
+
+    params = {"w": jnp.asarray(w)}
+    state = init_adam_state(params)
+    for step in range(5):
+        g = rng.normal(size=w.shape).astype(np.float32)
+        t_param.grad = torch.tensor(g.copy())
+        t_opt.step()
+        # torch AdamW: p -= lr*wd*p then adam update; ours folds wd into the
+        # update term — same decoupled semantics.
+        params, state = adam_update(params, {"w": jnp.asarray(g)}, state,
+                                    lr=1e-2, weight_decay=0.1,
+                                    adam_w_mode=True)
+    np.testing.assert_allclose(np.asarray(params["w"]),
+                               t_param.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_adam_weight_decay_mode_1():
+    """adam_w_mode=False folds wd into the gradient (L2 reg)."""
+    params = {"w": jnp.ones((4,))}
+    g = {"w": jnp.zeros((4,))}
+    state = init_adam_state(params)
+    p1, _ = adam_update(params, g, state, lr=1e-2, weight_decay=0.1,
+                        adam_w_mode=False)
+    # zero grad + L2: effective grad = wd*p → params shrink
+    assert float(p1["w"][0]) < 1.0
+
+
+def test_adam_under_jit_and_scan():
+    params = {"w": jnp.ones((16, 16))}
+    state = init_adam_state(params)
+
+    @jax.jit
+    def run(params, state):
+        def body(carry, _):
+            p, s = carry
+            g = jax.tree_util.tree_map(jnp.ones_like, p)
+            p, s = adam_update(p, g, s, lr=1e-3)
+            return (p, s), None
+        (p, s), _ = jax.lax.scan(body, (params, state), None, length=10)
+        return p, s
+
+    p, s = run(params, state)
+    assert int(s.step) == 10
+    assert np.all(np.isfinite(np.asarray(p["w"])))
+
+
+def test_lamb_trust_ratio_clamped():
+    params = {"w": jnp.full((4,), 1e-8)}  # tiny param norm
+    g = {"w": jnp.ones((4,))}
+    state = init_lamb_state(params)
+    p1, _ = lamb_update(params, g, state, lr=1.0, min_coeff=0.01,
+                        max_coeff=10.0)
+    delta = np.abs(np.asarray(p1["w"]) - np.asarray(params["w"]))
+    # ratio clamps at min_coeff → update magnitude ≈ lr * 0.01 * unit update
+    assert delta.max() <= 0.02
+
+
+def test_lamb_decreases_loss():
+    rng = jax.random.PRNGKey(0)
+    w = jax.random.normal(rng, (10, 10))
+    target = jnp.eye(10)
+    params = {"w": w}
+    state = init_lamb_state(params)
+
+    def loss(p):
+        return jnp.mean(jnp.square(p["w"] - target))
+
+    l0 = float(loss(params))
+    for _ in range(20):
+        g = jax.grad(loss)(params)
+        params, state = lamb_update(params, g, state, lr=0.05)
+    assert float(loss(params)) < l0
+
+
+def test_wrapper_classes():
+    params = {"w": jnp.ones((4,))}
+    opt = FusedAdam(params, lr=1e-2)
+    g = {"w": jnp.ones((4,))}
+    opt.step(g)
+    assert float(opt.params["w"][0]) < 1.0
+    with pytest.raises(RuntimeError):
+        FusedAdam(params, amsgrad=True)
+    with pytest.raises(RuntimeError):
+        FusedLamb(params, amsgrad=True)

@@ -1,0 +1,102 @@
+"""GPT-2 model tests: forward shapes, loss, TP partition specs, engine e2e."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import (
+    GPT2LMHead,
+    cross_entropy_loss,
+    gpt2_partition_specs,
+    gpt2_tiny,
+    init_gpt2_params,
+    make_gpt2_loss_fn,
+)
+
+
+def build_tiny(dtype=jnp.float32):
+    cfg = gpt2_tiny(dtype=dtype)
+    model = GPT2LMHead(cfg)
+    params = init_gpt2_params(model, jax.random.PRNGKey(0))
+    return cfg, model, params
+
+
+def test_forward_shapes():
+    cfg, model, params = build_tiny()
+    ids = jnp.zeros((2, 16), jnp.int32)
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+
+
+def test_cross_entropy_masking():
+    logits = jnp.zeros((1, 4, 8))
+    labels = jnp.asarray([[1, 2, -100, -100]])
+    loss = cross_entropy_loss(logits, labels)
+    # uniform logits → loss == log(8) over the 2 unmasked tokens
+    np.testing.assert_allclose(float(loss), np.log(8), rtol=1e-5)
+
+
+def test_loss_fn_next_token_shift():
+    _, model, params = build_tiny()
+    loss_fn = make_gpt2_loss_fn(model)
+    batch = {"input_ids": jnp.ones((2, 16), jnp.int32)}
+    loss = loss_fn(params, batch, None)
+    assert np.isfinite(float(loss))
+
+
+def test_partition_specs_cover_all_leaves():
+    _, _, params = build_tiny()
+    specs = gpt2_partition_specs(params)
+    n_params = len(jax.tree_util.tree_leaves(params))
+    n_specs = len(jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)))
+    assert n_specs == n_params
+    # spot-check megatron layout
+    assert specs["h_0"]["attn"]["c_attn"]["kernel"] == P(None, "model")
+    assert specs["h_0"]["attn"]["c_proj"]["kernel"] == P("model", None)
+    assert specs["h_0"]["mlp"]["c_fc"]["kernel"] == P(None, "model")
+    assert specs["wte"] == P("model", None)
+
+
+def test_gpt2_trains_end_to_end():
+    """The round-1 minimum slice: tiny GPT-2 through the engine, loss drops."""
+    _, model, params = build_tiny()
+    loss_fn = make_gpt2_loss_fn(model)
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, loss_fn=loss_fn, params=params)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 255, size=(8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_gpt2_tensor_parallel_mesh():
+    """TP over the model axis: same loss as replicated run."""
+    _, model, params = build_tiny()
+    loss_fn = make_gpt2_loss_fn(model)
+    base_cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 255, size=(8, 32)).astype(np.int32)}
+
+    eng_rep, _, _, _ = deepspeed_tpu.initialize(
+        config=dict(base_cfg), loss_fn=loss_fn, params=params)
+    ref = [float(eng_rep.train_batch(batch)) for _ in range(3)]
+
+    specs = gpt2_partition_specs(params)
+    eng_tp, _, _, _ = deepspeed_tpu.initialize(
+        config=dict(base_cfg, mesh={"data": 2, "model": 4}),
+        loss_fn=loss_fn, params=params, param_specs=specs)
+    assert eng_tp.mp_world_size == 4
+    got = [float(eng_tp.train_batch(batch)) for _ in range(3)]
+    np.testing.assert_allclose(ref, got, rtol=2e-3)
