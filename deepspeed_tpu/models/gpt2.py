@@ -150,8 +150,9 @@ class GPT2LMHead(nn.Module):
         return logits
 
 
-def cross_entropy_loss(logits, labels, ignore_index=-100):
-    """Mean token cross-entropy in fp32, masking ``ignore_index`` labels."""
+def cross_entropy_sum_and_count(logits, labels, ignore_index=-100):
+    """(summed token cross-entropy in fp32, valid-token count) — the
+    weighted-loss form exact under sharded/microbatched averaging."""
     logits = logits.astype(jnp.float32)
     mask = (labels != ignore_index)
     safe_labels = jnp.where(mask, labels, 0)
@@ -159,7 +160,13 @@ def cross_entropy_loss(logits, labels, ignore_index=-100):
     token_loss = -jnp.take_along_axis(logp, safe_labels[..., None],
                                       axis=-1).squeeze(-1)
     token_loss = jnp.where(mask, token_loss, 0.0)
-    return token_loss.sum() / jnp.maximum(mask.sum(), 1)
+    return token_loss.sum(), mask.sum()
+
+
+def cross_entropy_loss(logits, labels, ignore_index=-100):
+    """Mean token cross-entropy in fp32, masking ``ignore_index`` labels."""
+    total, count = cross_entropy_sum_and_count(logits, labels, ignore_index)
+    return total / jnp.maximum(count, 1)
 
 
 def make_gpt2_loss_fn(model: GPT2LMHead):

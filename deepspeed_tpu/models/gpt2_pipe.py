@@ -1,0 +1,127 @@
+"""GPT-2 as a PipelineModule: the pipelined flagship.
+
+The reference's pipeline examples wrap Megatron GPT-2 layers in
+``LayerSpec``s (SURVEY §2.1 PP row); this is the in-tree equivalent:
+embedding prologue (tied with the LM head, the reference's
+``TiedLayerSpec`` pattern at `pipe/module.py:71`), a homogeneous stack of
+transformer blocks that the engine shards over the ``pipe`` axis, and a
+final-norm + tied-head epilogue.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.models.gpt2 import (
+    Block,
+    GPT2Config,
+    cross_entropy_sum_and_count,
+)
+from deepspeed_tpu.runtime.pipe.module import (
+    LayerSpec,
+    PipelineModule,
+    TiedLayerSpec,
+)
+
+
+class GPT2Embed:
+    """Prologue layer: token ids → hidden states. Owns the tied wte/wpe."""
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+
+    def init(self, rng, micro):
+        cfg = self.config
+        k1, k2 = jax.random.split(rng)
+        return {
+            "wte": nn.initializers.normal(0.02)(
+                k1, (cfg.vocab_size, cfg.n_embd), cfg.param_dtype),
+            "wpe": nn.initializers.normal(0.01)(
+                k2, (cfg.n_positions, cfg.n_embd), cfg.param_dtype),
+        }
+
+    def apply(self, params, micro, rng=None):
+        cfg = self.config
+        ids = micro["input_ids"]
+        T = ids.shape[1]
+        x = params["wte"][ids].astype(cfg.dtype) + \
+            params["wpe"][None, :T].astype(cfg.dtype)
+        if cfg.dropout > 0 and rng is not None:
+            keep = 1.0 - cfg.dropout
+            mask = jax.random.bernoulli(rng, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0).astype(cfg.dtype)
+        return x
+
+
+def tied_lm_head(params, x):
+    """Epilogue forward for the tied embedding: logits = x @ wte^T
+    (``TiedLayerSpec.forward_fn``)."""
+    return x @ params["wte"].T.astype(x.dtype)
+
+
+class GPT2BlockLayer:
+    """One transformer block in the homogeneous pipelined body."""
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+        self.module = Block(config)
+
+    def init(self, rng, x):
+        return self.module.init({"params": rng}, x)["params"]
+
+    def apply(self, params, x, rng=None):
+        rngs = {"dropout": rng} if rng is not None else {}
+        return self.module.apply({"params": params}, x,
+                                 deterministic=rng is None, rngs=rngs)
+
+
+class GPT2FinalNorm:
+    """Epilogue ln_f."""
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+        self.module = nn.LayerNorm(dtype=config.dtype)
+
+    def init(self, rng, x):
+        return self.module.init({"params": rng}, x)["params"]
+
+    def apply(self, params, x, rng=None):
+        return self.module.apply({"params": params}, x)
+
+
+def gpt2_pipe_loss(logits, micro):
+    """Per-microbatch LM loss as (sum, token count): the weighted form makes
+    the pipeline's global average exact under uneven ignore-index masks."""
+    input_ids = micro["input_ids"]
+    labels = micro.get("labels")
+    if labels is None:
+        labels = jnp.concatenate(
+            [input_ids[:, 1:],
+             jnp.full((input_ids.shape[0], 1), -100, input_ids.dtype)],
+            axis=1)
+    return cross_entropy_sum_and_count(logits, labels)
+
+
+def gpt2_pipeline_module(config: GPT2Config,
+                         num_stages=None,
+                         seq_len=None,
+                         activation_checkpoint_interval=0,
+                         seed_layers=False) -> PipelineModule:
+    """Spec list: [tied embed] + n_layer × [block] + [ln_f, tied head]."""
+    T = seq_len or min(config.n_positions, 64)
+    specs = [TiedLayerSpec("embed", GPT2Embed, config)]
+    specs += [LayerSpec(GPT2BlockLayer, config)
+              for _ in range(config.n_layer)]
+    specs += [LayerSpec(GPT2FinalNorm, config),
+              TiedLayerSpec("embed", GPT2Embed, config,
+                            forward_fn=tied_lm_head)]
+    example = {"input_ids": np.zeros((2, T), np.int32)}
+    return PipelineModule(layers=specs,
+                          num_stages=num_stages,
+                          loss_fn=gpt2_pipe_loss,
+                          seed_layers=seed_layers,
+                          partition_method="uniform",
+                          activation_checkpoint_interval=(
+                              activation_checkpoint_interval),
+                          example_input=example)

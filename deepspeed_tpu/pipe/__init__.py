@@ -1,0 +1,9 @@
+"""Pipeline-parallelism public surface (reference `deepspeed/pipe/__init__.py`)."""
+
+from deepspeed_tpu.runtime.pipe.module import (
+    LayerSpec,
+    PipelineModule,
+    TiedLayerSpec,
+)
+
+__all__ = ["PipelineModule", "LayerSpec", "TiedLayerSpec"]

@@ -385,8 +385,13 @@ class DeepSpeedEngine:
                         delayed_shift=a.get("delayed_shift", 1))
         return args
 
+    def _engine_accum_steps(self):
+        """Microbatch count the compiled step scans over. PipelineEngine
+        overrides to 1: its microbatching happens inside the pipeline."""
+        return self._config.gradient_accumulation_steps
+
     def _make_train_step(self):
-        accum = self._config.gradient_accumulation_steps
+        accum = self._engine_accum_steps()
         compute_dtype = self.compute_dtype
         fp16 = self._config.fp16_enabled
         clip = float(self._config.gradient_clipping or 0.0)
@@ -506,7 +511,7 @@ class DeepSpeedEngine:
         DeepSpeedDataLoader emits) and the global array is assembled from
         the per-process shards.
         """
-        accum = self._config.gradient_accumulation_steps
+        accum = self._engine_accum_steps()
         sharding = NamedSharding(self.mesh, PartitionSpec(None, "data"))
         n_proc = jax.process_count()
         expected = self._config.train_batch_size // n_proc
@@ -621,7 +626,9 @@ class DeepSpeedEngine:
         loss = self.eval_batch(batch)
         return loss
 
-    __call__ = forward
+    def __call__(self, *args, **kwargs):
+        # late-bound so subclasses overriding forward() are honored
+        return self.forward(*args, **kwargs)
 
     def backward(self, loss=None, batch=None):
         """Compatibility: accumulate gradients for the pending micro-batch.

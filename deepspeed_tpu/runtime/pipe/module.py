@@ -83,7 +83,8 @@ class PipelineModule:
                  seed_layers: bool = False,
                  base_seed: int = 1234,
                  partition_method: str = "parameters",
-                 activation_checkpoint_interval: int = 0):
+                 activation_checkpoint_interval: int = 0,
+                 example_input: Any = None):
         self.specs = [
             spec if isinstance(spec, LayerSpec) else LayerSpec(spec)
             if callable(spec) else spec
@@ -96,6 +97,10 @@ class PipelineModule:
         self.base_seed = base_seed
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
+        # Microbatch-shaped pytree for parameter shape inference (JAX builds
+        # params from shapes; torch modules carry their own — this is the
+        # one addition to the reference signature).
+        self.example_input = example_input
         self._partition = None
 
     def __len__(self):

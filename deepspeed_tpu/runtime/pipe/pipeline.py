@@ -1,0 +1,424 @@
+"""Compiled pipeline execution over the ``pipe`` mesh axis.
+
+The reference interprets instruction lists rank-by-rank, sending activations
+through 2-rank NCCL groups (`runtime/pipe/engine.py:1144`, `pipe/p2p.py`).
+The TPU-native execution model compiles the whole train batch into ONE XLA
+program: stages live at coordinates of the ``pipe`` mesh axis, microbatch
+activations rotate stage-to-stage with ``lax.ppermute`` over ICI, and the
+backward pipeline falls out of differentiating the rotation (ppermute's
+transpose is the reverse rotation — exactly SendGrad/RecvGrad of the
+instruction ISA in `schedule.py`).
+
+Model contract: a :class:`~deepspeed_tpu.runtime.pipe.module.PipelineModule`
+whose specs decompose as ``prologue + body + epilogue``:
+
+- **body** — the longest homogeneous run of identical LayerSpecs (the
+  transformer blocks). Their params are stacked to a leading
+  ``[num_stages, layers_per_stage]`` dim sharded ``P('pipe')``: each device
+  holds only its stage's layers — the pipeline memory partitioning of
+  `pipe/module.py:348`.
+- **prologue/epilogue** — leading/trailing heterogeneous specs (embedding,
+  final norm, head). They replicate across ``pipe`` and run only on the
+  first/last stage (``lax.cond``); tied specs share one param copy and their
+  gradients sum across the stages that use them — the tied-weight
+  replication + allreduce of `pipe/module.py:405-474`, done by AD.
+
+Layer protocol: built layer objects expose ``init(rng, x) -> params`` and
+``apply(params, x, rng=None) -> y``. Flax modules are adapted automatically.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, TiedLayerSpec
+
+
+# ---------------------------------------------------------------------------
+# layer adaptation
+# ---------------------------------------------------------------------------
+class FlaxLayerAdapter:
+    """Wrap a flax ``nn.Module`` into the (init, apply) layer protocol."""
+
+    def __init__(self, module):
+        self.module = module
+
+    def init(self, rng, x):
+        variables = self.module.init({"params": rng, "dropout": rng}, x)
+        return variables["params"]
+
+    def apply(self, params, x, rng=None):
+        rngs = {"dropout": rng} if rng is not None else {}
+        return self.module.apply({"params": params}, x, rngs=rngs)
+
+
+def adapt_layer(obj):
+    """Normalize a built layer object to the (init, apply) protocol."""
+    if hasattr(obj, "init") and hasattr(obj, "apply"):
+        return obj
+    try:
+        import flax.linen as nn
+        if isinstance(obj, nn.Module):
+            return FlaxLayerAdapter(obj)
+    except ImportError:
+        pass
+    raise TypeError(
+        f"pipeline layer {obj!r} must expose init(rng, x) and "
+        f"apply(params, x, rng=None), or be a flax Module")
+
+
+def _spec_signature(spec: LayerSpec):
+    """Two specs with the same signature build structurally-identical layers
+    (stackable into the homogeneous body)."""
+    return (spec.typename, spec.module_args,
+            tuple(sorted(spec.module_kwargs.items())),
+            isinstance(spec, TiedLayerSpec))
+
+
+def split_specs(specs: List[LayerSpec]):
+    """(prologue, body, epilogue): body = the longest run of
+    signature-identical non-tied specs."""
+    best_lo, best_hi = 0, 0
+    i = 0
+    while i < len(specs):
+        if isinstance(specs[i], TiedLayerSpec):
+            i += 1
+            continue
+        j = i
+        sig = _spec_signature(specs[i])
+        while j < len(specs) and _spec_signature(specs[j]) == sig:
+            j += 1
+        if j - i > best_hi - best_lo:
+            best_lo, best_hi = i, j
+        i = j
+    return specs[:best_lo], specs[best_lo:best_hi], specs[best_hi:]
+
+
+# ---------------------------------------------------------------------------
+# parts: built layers + params + specs
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class PipelineParts:
+    """Everything the compiled pipeline needs, derived from a PipelineModule."""
+    num_stages: int
+    layers_per_stage: int
+    prologue_specs: List[LayerSpec]
+    epilogue_specs: List[LayerSpec]
+    prologue_layers: List[Any]          # adapted layer objects
+    body_layer: Any                     # one adapted layer (homogeneous)
+    epilogue_layers: List[Any]
+    params: Dict[str, Any]              # {prologue, body, epilogue, tied}
+    param_specs: Dict[str, Any]         # PartitionSpec pytree, same structure
+    loss_fn: Callable                   # loss_fn(output, micro_batch)
+
+    def prologue_apply(self, params, micro, rng=None):
+        """tokens/micro-batch → first activation (first stage only)."""
+        x = micro
+        for idx, (spec, layer) in enumerate(
+                zip(self.prologue_specs, self.prologue_layers)):
+            p = self._layer_params(params, "prologue", idx, spec)
+            x = self._apply_one(spec, layer, p, x, rng)
+        return x
+
+    def epilogue_apply(self, params, x, rng=None):
+        """last activation → model output (last stage only)."""
+        for idx, (spec, layer) in enumerate(
+                zip(self.epilogue_specs, self.epilogue_layers)):
+            p = self._layer_params(params, "epilogue", idx, spec)
+            x = self._apply_one(spec, layer, p, x, rng)
+        return x
+
+    def body_apply(self, layer_params, x, rng=None):
+        return self.body_layer.apply(layer_params, x, rng)
+
+    def _layer_params(self, params, section, idx, spec):
+        if isinstance(spec, TiedLayerSpec):
+            return params["tied"][spec.key]
+        return params[section][f"layer_{idx}"]
+
+    def _apply_one(self, spec, layer, p, x, rng):
+        if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None:
+            return spec.forward_fn(p, x)
+        return layer.apply(p, x, rng)
+
+
+def build_pipeline_parts(module, num_stages: int, rng,
+                         example_micro) -> PipelineParts:
+    """Build layers, initialize params, and stack the body.
+
+    ``example_micro``: a microbatch-shaped pytree used for shape inference
+    (row count is irrelevant — only trailing dims matter).
+    """
+    pro_specs, body_specs, epi_specs = split_specs(module.specs)
+    if not body_specs:
+        raise ValueError("PipelineModule needs a homogeneous run of layer "
+                         "specs to pipeline (the transformer blocks)")
+    if len(body_specs) % num_stages != 0:
+        raise ValueError(
+            f"{len(body_specs)} pipelined layers do not divide evenly over "
+            f"{num_stages} stages; adjust n_layer or the pipe axis")
+
+    params = {"prologue": {}, "body": None, "epilogue": {}, "tied": {}}
+    tied_layers: Dict[str, Any] = {}
+
+    def next_rng(i):
+        if module.seed_layers:
+            return jax.random.PRNGKey(module.base_seed + i)
+        return jax.random.fold_in(rng, i)
+
+    layer_idx = 0
+    x = example_micro
+
+    def build_one(spec, section, idx, x):
+        nonlocal layer_idx
+        layer = adapt_layer(spec.build())
+        if isinstance(spec, TiedLayerSpec):
+            if spec.key not in params["tied"]:
+                params["tied"][spec.key] = layer.init(next_rng(layer_idx), x)
+                tied_layers[spec.key] = layer
+            p = params["tied"][spec.key]
+        else:
+            p = layer.init(next_rng(layer_idx), x)
+            params[section][f"layer_{idx}"] = p
+        layer_idx += 1
+        if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None:
+            return layer, spec.forward_fn(p, x)
+        return layer, layer.apply(p, x, None)
+
+    prologue_layers = []
+    for idx, spec in enumerate(pro_specs):
+        layer, x = build_one(spec, "prologue", idx, x)
+        prologue_layers.append(layer)
+
+    body_layer = None
+    body_params = []
+    for spec in body_specs:
+        layer = adapt_layer(spec.build())
+        if body_layer is None:
+            body_layer = layer
+        p = layer.init(next_rng(layer_idx), x)
+        layer_idx += 1
+        x = layer.apply(p, x, None)
+        body_params.append(p)
+
+    epilogue_layers = []
+    for idx, spec in enumerate(epi_specs):
+        layer, x = build_one(spec, "epilogue", idx, x)
+        epilogue_layers.append(layer)
+
+    # Stack body params: [L, ...] → [S, L/S, ...], leading dim over 'pipe'.
+    lps = len(body_specs) // num_stages
+    stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *body_params)
+    params["body"] = jax.tree_util.tree_map(
+        lambda a: a.reshape((num_stages, lps) + a.shape[1:]), stacked)
+
+    def spec_of(section):
+        return jax.tree_util.tree_map(lambda _: P(), params[section])
+
+    param_specs = {
+        "prologue": spec_of("prologue"),
+        "epilogue": spec_of("epilogue"),
+        "tied": spec_of("tied"),
+        "body": jax.tree_util.tree_map(
+            lambda a: P("pipe", *([None] * (a.ndim - 1))), params["body"]),
+    }
+
+    loss_fn = module.loss_fn
+    if loss_fn is None:
+        raise ValueError("PipelineModule.loss_fn required for training")
+
+    return PipelineParts(num_stages=num_stages,
+                         layers_per_stage=lps,
+                         prologue_specs=pro_specs,
+                         epilogue_specs=epi_specs,
+                         prologue_layers=prologue_layers,
+                         body_layer=body_layer,
+                         epilogue_layers=epilogue_layers,
+                         params=params,
+                         param_specs=param_specs,
+                         loss_fn=loss_fn)
+
+
+def sequential_loss_fn(parts: PipelineParts, params, micro_batches, rng=None):
+    """Non-pipelined reference execution of the same parts (test oracle):
+    mean loss over the leading microbatch dim."""
+    body = jax.tree_util.tree_map(
+        lambda a: a.reshape((-1,) + a.shape[2:]), params["body"])
+    n_layers = parts.num_stages * parts.layers_per_stage
+    num_total, den_total = 0.0, 0.0
+    weighted = None
+    M = jax.tree_util.tree_leaves(micro_batches)[0].shape[0]
+    for m in range(M):
+        micro = jax.tree_util.tree_map(lambda a: a[m], micro_batches)
+        x = parts.prologue_apply(params, micro,
+                                 None if rng is None
+                                 else jax.random.fold_in(rng, m))
+        for li in range(n_layers):
+            lp = jax.tree_util.tree_map(lambda a: a[li], body)
+            x = parts.body_apply(lp, x, None)
+        out = parts.epilogue_apply(params, x, None)
+        res = parts.loss_fn(out, micro)
+        weighted = isinstance(res, tuple)
+        if weighted:
+            num_total = num_total + res[0]
+            den_total = den_total + res[1]
+        else:
+            num_total = num_total + res
+    if weighted:
+        return num_total / jnp.maximum(den_total, 1.0)
+    return num_total / M
+
+
+# ---------------------------------------------------------------------------
+# the compiled pipeline loss
+# ---------------------------------------------------------------------------
+def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
+                          remat: bool = True):
+    """Build ``loss_fn(params, batch, rng)`` executing the GPipe rotation.
+
+    ``batch``: pytree of ``[rows, ...]`` arrays, rows divisible by
+    ``num_micro``; rows are data-sharded, microbatches run through the
+    ``pipe`` axis wavefront. Differentiable end-to-end: ``jax.grad`` of this
+    function performs the full backward pipeline (cooldown included).
+    """
+    S = parts.num_stages
+    M = num_micro
+    T = M + S - 1
+    axis_tail = tuple(a for a in mesh.axis_names
+                      if a not in ("pipe", "data"))
+
+    def device_fn(body_local, rest, batch_local, rng, use_rng):
+        # body_local arrives as [1, L/S, ...] — this stage's shard.
+        body_local = jax.tree_util.tree_map(lambda a: a[0], body_local)
+        s = lax.axis_index("pipe")
+
+        def micro_at(m):
+            return jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+                batch_local)
+
+        def mb_rng(m, section):
+            # distinct dropout stream per (microbatch, stage, section)
+            if not use_rng:
+                return None
+            key = jax.random.fold_in(jax.random.fold_in(rng, m), s)
+            return jax.random.fold_in(key, section)
+
+        def stage_fwd(x, key):
+            if not use_rng:
+                def layer(x, lp):
+                    return parts.body_apply(lp, x, None), None
+                x, _ = lax.scan(layer, x, body_local)
+                return x
+
+            def layer(carry, lp):
+                x, k = carry
+                k, sub = jax.random.split(k)
+                return (parts.body_apply(lp, x, sub), k), None
+            (x, _), _ = lax.scan(layer, (x, key), body_local)
+            return x
+
+        # activation template (shape-only trace; no FLOPs at runtime)
+        act = jax.eval_shape(
+            lambda p, mb: parts.prologue_apply(p, mb, None), rest,
+            micro_at(0))
+        zeros = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype), act)
+        # loss_fn may return a scalar (per-microbatch mean; averaged over
+        # microbatches/shards) or (loss_sum, weight) for the exact global
+        # weighted mean (e.g. token CE with uneven ignore-index masks).
+        loss_probe = jax.eval_shape(
+            lambda p, xx, mb: parts.loss_fn(
+                parts.epilogue_apply(p, xx, None), mb),
+            rest, act, micro_at(0))
+        weighted = isinstance(loss_probe, tuple)
+
+        def mb_loss_pair(x, m_oc):
+            res = parts.loss_fn(
+                parts.epilogue_apply(rest, x, mb_rng(m_oc, 2)),
+                micro_at(m_oc))
+            if weighted:
+                num, den = res
+                return num.astype(jnp.float32), den.astype(jnp.float32)
+            return res.astype(jnp.float32), jnp.asarray(1.0, jnp.float32)
+
+        def tick(carry, t):
+            x_recv, num_acc, den_acc = carry
+            m_in = jnp.clip(t - s, 0, M - 1)
+            x_in = lax.cond(
+                s == 0,
+                lambda: parts.prologue_apply(rest, micro_at(m_in),
+                                             mb_rng(m_in, 0)),
+                lambda: x_recv)
+            x = stage_fwd(x_in, mb_rng(m_in, 1))
+            m_out = t - (S - 1)
+            m_oc = jnp.clip(m_out, 0, M - 1)
+            num, den = lax.cond(
+                s == S - 1,
+                lambda: mb_loss_pair(x, m_oc),
+                lambda: (jnp.asarray(0.0, jnp.float32),
+                         jnp.asarray(0.0, jnp.float32)))
+            valid = (m_out >= 0) & (m_out < M)
+            num_acc = num_acc + jnp.where(valid, num, 0.0)
+            den_acc = den_acc + jnp.where(valid, den, 0.0)
+            x_next = lax.ppermute(
+                x, "pipe", [(i, (i + 1) % S) for i in range(S)])
+            return (x_next, num_acc, den_acc), None
+
+        tick_fn = jax.checkpoint(tick) if remat else tick
+        zero_f = jnp.asarray(0.0, jnp.float32)
+        (_, num_sum, den_sum), _ = lax.scan(
+            tick_fn, (zeros, zero_f, zero_f), jnp.arange(T))
+
+        # Only the last stage accumulated loss; share it everywhere so the
+        # result is replicated, matching out_specs=P().
+        if weighted:
+            # exact global weighted mean: sum losses / sum weights
+            num = lax.psum(lax.psum(num_sum, "pipe"), "data")
+            den = lax.psum(lax.psum(den_sum, "pipe"), "data")
+            loss = num / jnp.maximum(den, 1.0)
+        else:
+            # mean of per-(microbatch, shard) means
+            loss = lax.psum(num_sum, "pipe") / M
+            loss = lax.pmean(loss, "data")
+        if axis_tail:
+            loss = lax.pmean(loss, axis_tail)
+        return loss
+
+    batch_sharding = NamedSharding(mesh, P(None, "data"))
+
+    def pipeline_loss(params, batch, rng):
+        def to_micro(a):
+            rows = a.shape[0]
+            assert rows % M == 0, (
+                f"batch rows {rows} not divisible by {M} microbatches")
+            return a.reshape((M, rows // M) + a.shape[1:])
+
+        batch_m = jax.tree_util.tree_map(to_micro, batch)
+        batch_m = jax.tree_util.tree_map(
+            lambda a: lax.with_sharding_constraint(a, batch_sharding),
+            batch_m)
+        rest = {k: params[k] for k in ("prologue", "epilogue", "tied")}
+        use_rng = rng is not None
+        key = rng if use_rng else jnp.zeros((2,), jnp.uint32)
+
+        body_specs = jax.tree_util.tree_map(
+            lambda a: P("pipe", *([None] * (a.ndim - 1))), params["body"])
+        rest_specs = jax.tree_util.tree_map(lambda _: P(), rest)
+        batch_specs = jax.tree_util.tree_map(
+            lambda _: P(None, "data"), batch_m)
+
+        fn = jax.shard_map(
+            partial(device_fn, use_rng=use_rng),
+            mesh=mesh,
+            in_specs=(body_specs, rest_specs, batch_specs, P()),
+            out_specs=P(),
+            check_vma=False)
+        return fn(params["body"], rest, batch_m, key)
+
+    return pipeline_loss

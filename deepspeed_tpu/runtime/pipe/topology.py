@@ -1,0 +1,221 @@
+"""N-D process/device topology for hybrid parallelism.
+
+Analog of the reference's ``ProcessTopology`` / ``PipeDataParallelTopology``
+/ ``PipeModelDataParallelTopology`` / ``PipelineParallelGrid``
+(`runtime/pipe/topology.py:12,246,252`). On TPU the *execution* structure is
+a named ``jax.sharding.Mesh``; this module is the pure rank-math layer that
+(a) mirrors the reference API for parity and tests, and (b) converts a
+topology into the mesh axis layout the engines consume.
+
+Axes are named; ranks map to coordinates in row-major (last axis fastest)
+order — the same convention ``Mesh`` uses for its device array.
+"""
+
+import itertools
+from collections import namedtuple
+
+from deepspeed_tpu.parallel.mesh import MESH_AXES
+
+
+class ProcessTopology:
+    """Cartesian product of named axes ↔ global ranks.
+
+    ``axes`` orders dimensions outermost-first; ``dims`` gives their sizes.
+    """
+
+    def __init__(self, axes, dims):
+        assert len(axes) == len(dims)
+        self.axes = list(axes)
+        self.dims = list(int(d) for d in dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+        self.mapping = {}
+        for rank, coord in enumerate(itertools.product(
+                *[range(d) for d in self.dims])):
+            self.mapping[self.ProcessCoord(*coord)] = rank
+
+    def get_rank(self, **coord_kwargs):
+        """Global rank of the process at the given full coordinate."""
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() needs all axes {self.axes}, "
+                             f"got {sorted(coord_kwargs)}")
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"coord {key} not in topology"
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return list(self.axes)
+
+    def get_rank_repr(self, rank, omit_axes=("data", "pipe"),
+                      inner_sep="_", outer_sep="-"):
+        """String like ``model_00`` used in checkpoint filenames (reference
+        `topology.py:80`): all axes except the omitted ones."""
+        omit = set(omit_axes)
+        coord = self.get_coord(rank)
+        parts = [f"{axis}{inner_sep}{getattr(coord, axis):02d}"
+                 for axis in self.axes if axis not in omit]
+        return outer_sep.join(parts)
+
+    def get_dim(self, axis):
+        return self.dims[self.axes.index(axis)] if axis in self.axes else 0
+
+    def get_coord(self, rank):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis):
+        """Groups of ranks that vary only along ``axis`` — the communicator
+        building-block (reference `topology.py:107`)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        for combo in itertools.product(
+                *[range(self.get_dim(a)) for a in other_axes]):
+            fixed = dict(zip(other_axes, combo))
+            ranks = [self.get_rank(**{axis: i, **fixed})
+                     for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        """Ranks whose coordinates match all given axis=value filters."""
+        def matches(coord):
+            return all(getattr(coord, k) == v
+                       for k, v in filter_kwargs.items())
+        return sorted(r for c, r in self.mapping.items() if matches(c))
+
+    def get_axis_list(self, axis, idx):
+        """Ranks with coordinate ``idx`` along ``axis``."""
+        return sorted(r for c, r in self.mapping.items()
+                      if getattr(c, axis) == idx)
+
+    def world_size(self):
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def __str__(self):
+        return f"ProcessTopology(axes={self.axes}, dims={self.dims})"
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """pipe × data hybrid (reference `topology.py:236`): pipe outermost so a
+    dp group's ranks are ICI neighbors."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """pipe × data × model 3-D hybrid (reference `topology.py:246`)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Rank bookkeeping over a topology: stage ids, per-axis group ranks,
+    stage-to-stage neighbors (reference ``PipelineParallelGrid``,
+    `topology.py:252`). ``rank`` defaults to 0 (single-controller JAX hosts
+    drive all ranks; per-rank views exist for parity and multi-host)."""
+
+    def __init__(self, topology=None, rank=0, world_size=None):
+        if topology is None:
+            assert world_size is not None, "topology or world_size required"
+            topology = PipeDataParallelTopology(num_pp=1, num_dp=world_size)
+        self._topo = topology
+        self.global_rank = rank
+        self.world_size = topology.world_size()
+
+        self.data_parallel_size = max(topology.get_dim("data"), 1)
+        self.pipe_parallel_size = max(topology.get_dim("pipe"), 1)
+        self.model_parallel_size = max(topology.get_dim("model"), 1)
+        assert self.world_size == (self.data_parallel_size *
+                                   self.pipe_parallel_size *
+                                   self.model_parallel_size)
+
+        self.stage_id = self.get_stage_id()
+        self.data_parallel_id = self.get_data_parallel_id()
+
+        # group rank-lists per axis (the reference builds dist groups here;
+        # on TPU these become mesh-axis index sets)
+        self.dp_groups = topology.get_axis_comm_lists("data")
+        self.pp_groups = topology.get_axis_comm_lists("pipe")
+        self.mp_groups = (topology.get_axis_comm_lists("model")
+                          if "model" in topology.get_axis_names() else [])
+
+        # p2p: successor/predecessor stage for this rank's pipe group
+        self.p2p_groups = self._build_p2p_groups()
+
+    def get_stage_id(self):
+        if "pipe" not in self._topo.get_axis_names():
+            return 0
+        return getattr(self._topo.get_coord(self.global_rank), "pipe")
+
+    def get_data_parallel_id(self):
+        if "data" not in self._topo.get_axis_names():
+            return 0
+        return getattr(self._topo.get_coord(self.global_rank), "data")
+
+    def _build_p2p_groups(self):
+        """Consecutive stage pairs within each pipe group (reference
+        `topology.py:299`: the 2-rank groups p2p.py sends through)."""
+        pairs = []
+        for ranks in self.pp_groups:
+            for i in range(len(ranks)):
+                pairs.append([ranks[i], ranks[(i + 1) % len(ranks)]])
+        return pairs
+
+    # --- stage neighbors -------------------------------------------------
+    def stage_to_global(self, stage_id, **kwargs):
+        """Global rank of ``stage_id`` holding all other coords equal."""
+        coord = self._topo.get_coord(self.global_rank)
+        me = coord._asdict()
+        me.update(kwargs)
+        me["pipe"] = stage_id
+        return self._topo.get_rank(**me)
+
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    def is_last_stage(self):
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    # --- reference-parity accessors --------------------------------------
+    def get_global_rank(self):
+        return self.global_rank
+
+    def get_pipe_parallel_rank(self):
+        return self.stage_id
+
+    def get_pipe_parallel_world_size(self):
+        return self.pipe_parallel_size
+
+    def get_data_parallel_rank(self):
+        return self.data_parallel_id
+
+    def get_data_parallel_world_size(self):
+        return self.data_parallel_size
+
+    def get_model_parallel_rank(self):
+        if "model" not in self._topo.get_axis_names():
+            return 0
+        return getattr(self._topo.get_coord(self.global_rank), "model")
+
+    def get_model_parallel_world_size(self):
+        return self.model_parallel_size
+
+    # --- mesh bridge ------------------------------------------------------
+    def mesh_shape(self):
+        """{axis: size} dict in canonical mesh-axis order, for
+        ``parallel.mesh.build_mesh`` — the point where rank math becomes a
+        real device mesh."""
+        shape = {axis: 1 for axis in MESH_AXES}
+        for axis in self._topo.get_axis_names():
+            if axis in shape:
+                shape[axis] = self._topo.get_dim(axis)
+        return shape

@@ -1,0 +1,145 @@
+"""Schedule generation unit tests — no devices needed, like the reference's
+`tests/unit/test_pipe_schedule.py` (157 LoC, pure)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe import schedule as S
+
+
+def _flat(sched):
+    return [cmd for step in sched for cmd in step]
+
+
+@pytest.mark.parametrize("micro,stages", [(1, 1), (4, 1), (1, 4), (4, 4),
+                                          (8, 4), (3, 5), (16, 2)])
+def test_train_schedule_covers_all_microbatches(micro, stages):
+    for sid in range(stages):
+        cmds = _flat(S.TrainSchedule(micro, stages, sid))
+        fwd = [c.micro_batch_id for c in cmds if isinstance(c, S.ForwardPass)]
+        bwd = [c.micro_batch_id for c in cmds if isinstance(c, S.BackwardPass)]
+        assert sorted(fwd) == list(range(micro))
+        assert sorted(bwd) == list(range(micro))
+
+
+@pytest.mark.parametrize("micro,stages", [(4, 4), (8, 4), (3, 5), (16, 2)])
+def test_train_schedule_forward_before_backward(micro, stages):
+    for sid in range(stages):
+        seen_fwd = set()
+        for step in S.TrainSchedule(micro, stages, sid):
+            for cmd in step:
+                if isinstance(cmd, S.ForwardPass):
+                    seen_fwd.add(cmd.micro_batch_id)
+                if isinstance(cmd, S.BackwardPass):
+                    assert cmd.micro_batch_id in seen_fwd
+
+
+@pytest.mark.parametrize("micro,stages", [(4, 4), (8, 4), (3, 5)])
+def test_train_schedule_sends_precede_recvs(micro, stages):
+    """Cross-stage pairing: every RecvActivation at stage s must be preceded
+    (in global rounds) by the matching SendActivation at s-1; grads dually."""
+    per_stage = [list(S.TrainSchedule(micro, stages, sid).steps())
+                 for sid in range(stages)]
+    n_rounds = max(len(p) for p in per_stage)
+
+    def round_of(sid, klass, mb):
+        for r, step in enumerate(per_stage[sid]):
+            for cmd in step:
+                if isinstance(cmd, klass) and \
+                        getattr(cmd, "micro_batch_id", None) == mb:
+                    return r
+        return None
+
+    for sid in range(1, stages):
+        for mb in range(micro):
+            r_recv = round_of(sid, S.RecvActivation, mb)
+            r_send = round_of(sid - 1, S.SendActivation, mb)
+            assert r_send is not None and r_recv is not None
+            assert r_send < r_recv, (sid, mb)
+    for sid in range(stages - 1):
+        for mb in range(micro):
+            r_recv = round_of(sid, S.RecvGrad, mb)
+            r_send = round_of(sid + 1, S.SendGrad, mb)
+            assert r_send is not None and r_recv is not None
+            assert r_send < r_recv, (sid, mb)
+    assert n_rounds >= micro + stages - 1
+
+
+@pytest.mark.parametrize("micro,stages", [(2, 2), (8, 4), (3, 5)])
+def test_train_schedule_buffer_bounds(micro, stages):
+    """Buffer ids stay within num_pipe_buffers (reference
+    `schedule.py:243-247` bound: min(stages - stage_id + 1, micro))."""
+    for sid in range(stages):
+        sched = S.TrainSchedule(micro, stages, sid)
+        expected = micro if micro <= stages - sid else stages - sid + 1
+        assert sched.num_pipe_buffers() == expected
+        for cmd in _flat(sched):
+            if hasattr(cmd, "buffer_id"):
+                assert 0 <= cmd.buffer_id < sched.num_pipe_buffers()
+
+
+def test_train_schedule_epilogue_order():
+    sched = S.TrainSchedule(4, 2, 0)
+    cmds = _flat(sched)
+    names = [type(c).__name__ for c in cmds[-3:]]
+    assert names == ["ReduceTiedGrads", "ReduceGrads", "OptimizerStep"]
+
+
+def test_train_schedule_1f1b_steady_state():
+    """After warmup, forwards and backwards alternate on the first stage
+    (the memory-bounding property of 1F1B)."""
+    micro, stages = 8, 4
+    sched = S.TrainSchedule(micro, stages, 0)
+    live = 0
+    peak = 0
+    for step in sched:
+        for cmd in step:
+            if isinstance(cmd, S.ForwardPass):
+                live += 1
+            elif isinstance(cmd, S.BackwardPass):
+                live -= 1
+            peak = max(peak, live)
+    # 1F1B keeps in-flight activations bounded by the pipeline depth,
+    # not by the number of microbatches.
+    assert peak <= stages + 1
+
+
+@pytest.mark.parametrize("micro,stages", [(1, 1), (4, 2), (6, 3)])
+def test_inference_schedule_wavefront(micro, stages):
+    for sid in range(stages):
+        sched = S.InferenceSchedule(micro, stages, sid)
+        steps = list(sched.steps())
+        assert len(steps) == micro + stages - 1
+        for r, step in enumerate(steps):
+            fwd = [c for c in step if isinstance(c, S.ForwardPass)]
+            if fwd:
+                assert fwd[0].micro_batch_id == r - sid
+        fwds = [c.micro_batch_id for c in _flat(
+            S.InferenceSchedule(micro, stages, sid))
+            if isinstance(c, S.ForwardPass)]
+        assert fwds == list(range(micro))
+
+
+def test_inference_schedule_loads_first_and_last():
+    micro, stages = 4, 3
+    for sid, expect_load in [(0, True), (1, False), (2, True)]:
+        cmds = _flat(S.InferenceSchedule(micro, stages, sid))
+        has_load = any(isinstance(c, S.LoadMicroBatch) for c in cmds)
+        assert has_load == expect_load
+
+
+def test_data_parallel_schedule():
+    sched = S.DataParallelSchedule(micro_batches=3, stages=1, stage_id=0)
+    steps = list(sched.steps())
+    assert len(steps) == 3
+    last = steps[-1]
+    assert any(isinstance(c, S.ReduceGrads) for c in last)
+    assert any(isinstance(c, S.OptimizerStep) for c in last)
+    assert sched.num_pipe_buffers() == 1
+
+
+def test_instruction_repr_and_eq():
+    a = S.ForwardPass(1, stage_id=0, micro_batch_id=3)
+    b = S.ForwardPass(1, stage_id=0, micro_batch_id=3)
+    c = S.ForwardPass(2, stage_id=0, micro_batch_id=3)
+    assert a == b and a != c
+    assert "ForwardPass" in repr(a) and "micro_batch_id=3" in repr(a)

@@ -1,0 +1,109 @@
+"""Topology / grid rank-math tests (reference `tests/unit/test_topology.py`,
+222 LoC — pure, no devices)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe.topology import (
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    PipelineParallelGrid,
+    ProcessTopology,
+)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_coord(2) == topo.ProcessCoord(row=1, col=0)
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("a") == 2
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("c") == 4
+    assert topo.get_dim("missing") == 0
+
+
+def test_topology_rank_requires_all_axes():
+    topo = ProcessTopology(axes=["a", "b"], dims=[2, 2])
+    with pytest.raises(ValueError):
+        topo.get_rank(a=0)
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    # pipe-major: rank = pipe * num_dp + data
+    assert topo.get_axis_comm_lists("data") == [[0, 1], [2, 3]]
+    assert topo.get_axis_comm_lists("pipe") == [[0, 2], [1, 3]]
+    assert topo.get_axis_comm_lists("model") == []
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.filter_match(pipe=0) == [0, 1, 2, 3]
+    assert topo.filter_match(pipe=1, model=0) == [4, 6]
+    assert topo.filter_match(pipe=1, data=1, model=1) == [7]
+
+
+def test_topology_axis_list():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    assert topo.get_axis_list("pipe", 0) == [0, 1, 2, 3]
+    assert topo.get_axis_list("data", 1) == [1, 5]
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=1)
+    # data/pipe omitted by default: only the model coordinate shows
+    assert topo.get_rank_repr(0) == "model_00"
+    assert topo.get_rank_repr(1) == "model_01"
+
+
+def test_grid_pipe_data():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, rank=3)
+    assert grid.pipe_parallel_size == 2
+    assert grid.data_parallel_size == 2
+    assert grid.model_parallel_size == 1
+    assert grid.stage_id == 1
+    assert grid.data_parallel_id == 1
+    assert grid.is_last_stage() and not grid.is_first_stage()
+    assert grid.get_pipe_parallel_rank() == 1
+    assert grid.get_data_parallel_rank() == 1
+
+
+def test_grid_3d():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, rank=5)
+    # rank 5: pipe=1, data=0, model=1
+    assert grid.stage_id == 1
+    assert grid.get_model_parallel_rank() == 1
+    assert grid.get_data_parallel_rank() == 0
+    assert grid.stage_to_global(0) == 1
+
+
+def test_grid_default_world():
+    grid = PipelineParallelGrid(world_size=4)
+    assert grid.pipe_parallel_size == 1
+    assert grid.data_parallel_size == 4
+    assert grid.stage_id == 0
+
+
+def test_grid_p2p_groups():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=1)
+    grid = PipelineParallelGrid(topology=topo, rank=0)
+    assert [0, 1] in grid.p2p_groups
+    assert [3, 0] in grid.p2p_groups  # wraparound pair
+
+
+def test_grid_mesh_shape_bridge():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, rank=0)
+    shape = grid.mesh_shape()
+    assert shape["pipe"] == 2 and shape["model"] == 2 and shape["data"] == 2
+    assert shape["seq"] == 1 and shape["expert"] == 1
