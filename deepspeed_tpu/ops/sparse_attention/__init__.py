@@ -1,0 +1,18 @@
+"""Block-sparse attention ops (reference `deepspeed/ops/sparse_attention/`)."""
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    SparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    VariableSparsityConfig,
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+)
+from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+    block_sparse_attention,
+    build_lut,
+    masked_dense_attention,
+)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention,
+)

@@ -1,0 +1,349 @@
+"""Block-sparse attention for TPU.
+
+The reference implements block-sparse attention as three Triton kernels —
+SDD/DSD block matmuls (`deepspeed/ops/sparse_attention/matmul.py:16-614`,
+`trsrc/matmul.tr`) and a fused scale+rpe+mask softmax over nonzero blocks
+(`softmax.py:17-217`, `trsrc/softmax_fwd.tr`) — stitched together by
+``SparseSelfAttention`` with the [T, T] block-sparse score matrix
+materialized in HBM.
+
+TPU-first redesign: one *fused* block-sparse flash-attention — for each
+(head, q-block) the kernel walks only that row's nonzero k-blocks (a LUT
+built from the ``SparsityConfig`` layout) with online-softmax accumulation,
+so the sparse score matrix never exists in memory at all. Two
+implementations share the LUT:
+
+- ``pallas``: TPU kernel; the LUT rides in SMEM via scalar prefetch and
+  drives the k/v block index maps, acc/m/l accumulate in VMEM scratch.
+- ``xla``: per-head gather of the LUT's k/v blocks + masked softmax —
+  runs everywhere (CPU test meshes), natively differentiable, and carries
+  the rpe / key-padding-mask / attention-mask features of the reference
+  softmax kernel.
+
+The pallas forward pairs with the xla backward through ``jax.custom_vjp``.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+# ---------------------------------------------------------------------------
+# LUT construction (the analog of the reference's make_lut machinery,
+# `matmul.py:53-114` / `softmax.py:42-77`, minus the Triton segmenting)
+# ---------------------------------------------------------------------------
+
+def build_lut(layout):
+    """Per-(head, q-block) list of nonzero k-block indices.
+
+    layout: [H, nq, nk] 0/1 array →
+      lut:  [H, nq, max_nnz] int32 (k-block index; padded entries are 0)
+      nnz:  [H, nq] int32 (valid entries per row)
+    """
+    layout = np.asarray(layout)
+    H, nq, nk = layout.shape
+    nnz = layout.sum(axis=-1).astype(np.int32)
+    max_nnz = max(int(nnz.max()), 1)
+    lut = np.zeros((H, nq, max_nnz), dtype=np.int32)
+    for h in range(H):
+        for qi in range(nq):
+            cols = np.nonzero(layout[h, qi])[0]
+            lut[h, qi, :len(cols)] = cols
+    return lut, nnz
+
+
+# ---------------------------------------------------------------------------
+# XLA gather implementation (differentiable; carries rpe/masks)
+# ---------------------------------------------------------------------------
+
+def _xla_impl(q, k, v, lut, nnz, block, causal, sm_scale,
+              rpe=None, key_padding_mask=None, attn_mask=None,
+              key_padding_mask_mode="add", attn_mask_mode="mul"):
+    """q,k,v: [B, T, H, D]; lut/nnz per build_lut. Returns [B, T, H, D]."""
+    B, T, H, D = q.shape
+    nq = T // block
+    max_nnz = lut.shape[-1]
+    lut = jnp.asarray(lut)
+    nnz = jnp.asarray(nnz)
+
+    def to_heads(x):
+        # [B, T, H, D] → [H, B, nq, block, D]
+        return x.transpose(2, 0, 1, 3).reshape(H, B, nq, block, D)
+
+    qh = to_heads(q).astype(jnp.float32) * sm_scale
+    kh = to_heads(k).astype(jnp.float32)
+    vh = to_heads(v).astype(jnp.float32)
+
+    in_block = jnp.arange(block)
+    q_pos = jnp.arange(nq)[:, None] * block + in_block[None, :]   # [nq, blk]
+
+    def mask_to_additive(m, mode):
+        m = m.astype(jnp.float32)
+        if mode == "mul":
+            # reference softmax_fwd.tr:103 — zero entries become -inf
+            return jnp.where(m == 0, DEFAULT_MASK_VALUE, 0.0)
+        return m
+
+    kp_add = None
+    if key_padding_mask is not None:
+        kp_add = mask_to_additive(jnp.asarray(key_padding_mask),
+                                  key_padding_mask_mode)    # [B, T]
+        kp_blocks = kp_add.reshape(B, nq, block)
+    attn_add = None
+    if attn_mask is not None:
+        attn_add = mask_to_additive(jnp.asarray(attn_mask),
+                                    attn_mask_mode)         # [T, T]
+
+    def per_head(h, q_h, k_h, v_h):
+        lut_h = lut[h]                      # [nq, max_nnz]
+        nnz_h = nnz[h]                      # [nq]
+        kg = k_h[:, lut_h]                  # [B, nq, nnz, blk, D]
+        vg = v_h[:, lut_h]
+        s = jnp.einsum("bqrd,bqjcd->bqrjc", q_h, kg)   # [B,nq,blk,nnz,blk]
+
+        k_pos = lut_h[:, :, None] * block + in_block[None, None, :]
+        valid = jnp.arange(max_nnz)[None, :] < nnz_h[:, None]   # [nq, nnz]
+        mask = valid[:, None, :, None]
+        if causal:
+            cmask = k_pos[:, None, :, :] <= q_pos[:, :, None, None]
+            mask = mask & cmask
+        if rpe is not None:
+            # rpe: [B, H, T, T] added to scaled scores (softmax_fwd.tr:117)
+            s = s + _gather_rows(rpe[:, h].astype(jnp.float32), lut_h,
+                                 block, nq)
+        if kp_add is not None:
+            # [B, nq, nnz, blk] → broadcast over the q-row dim
+            s = s + kp_blocks[:, lut_h][:, :, None, :, :]
+        if attn_add is not None:
+            s = s + _gather_attn(attn_add, lut_h, block, nq)
+
+        s = jnp.where(mask[None], s, DEFAULT_MASK_VALUE)
+        s = s.reshape(B, nq, block, max_nnz * block)
+        p = jax.nn.softmax(s, axis=-1)
+        p = p.reshape(B, nq, block, max_nnz, block)
+        return jnp.einsum("bqrjc,bqjcd->bqrd", p, vg)
+
+    out = jax.vmap(per_head, in_axes=(0, 0, 0, 0))(
+        jnp.arange(H), qh, kh, vh)          # [H, B, nq, blk, D]
+    return out.transpose(1, 2, 3, 0, 4).reshape(B, T, H, D).astype(q.dtype)
+
+
+def _gather_rows(rpe_h, lut_h, block, nq):
+    """rpe_h: [B, T, T]; gather k-blocks per q-block row →
+    [B, nq, blk, max_nnz, blk]."""
+    B = rpe_h.shape[0]
+    r = rpe_h.reshape(B, nq, block, nq, block)
+    # vmap over q-block rows: r[:, qi][:, :, lut_h[qi]] per row
+    return jax.vmap(lambda rq, idx: rq[:, :, idx],
+                    in_axes=(1, 0), out_axes=1)(r, lut_h)
+
+
+def _gather_attn(attn_add, lut_h, block, nq):
+    """attn_add: [T, T] → gathered [nq, blk, max_nnz, blk] broadcast over B."""
+    a = attn_add.reshape(nq, block, nq, block)
+    gathered = jax.vmap(lambda aq, idx: aq[:, idx],
+                        in_axes=(0, 0))(a, lut_h)  # [nq, blk, nnz, blk]
+    return gathered[None]
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel (no-mask fast path)
+# ---------------------------------------------------------------------------
+
+def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    nq = T // block
+    max_nnz = lut.shape[-1]
+
+    # [B, T, H, D] → [B*H, nq*block, D], h fastest in the folded dim
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    q, k, v = to_bh(q), to_bh(k), to_bh(v)
+    lut_flat = jnp.asarray(lut.reshape(H * nq * max_nnz), jnp.int32)
+    nnz_flat = jnp.asarray(nnz.reshape(H * nq), jnp.int32)
+
+    def kernel(lut_ref, nnz_ref, q_ref, k_ref, v_ref, o_ref,
+               acc_ref, m_ref, l_ref):
+        bh = pl.program_id(0)
+        qi = pl.program_id(1)
+        j = pl.program_id(2)
+        h = jax.lax.rem(bh, H)
+
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[:] = jnp.zeros_like(l_ref)
+
+        @pl.when(j < nnz_ref[h * nq + qi])
+        def _compute():
+            kblk = lut_ref[(h * nq + qi) * max_nnz + j]
+            qb = q_ref[0].astype(jnp.float32) * sm_scale     # [blk, D]
+            kb = k_ref[0].astype(jnp.float32)                # [blk, D]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [blk, blk]
+            if causal:
+                q_pos = qi * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 0)
+                k_pos = kblk * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, block), 1)
+                s = jnp.where(k_pos <= q_pos, s, DEFAULT_MASK_VALUE)
+            m_prev = m_ref[:, 0]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            corr = jnp.exp(m_prev - m_new)
+            l_ref[:, 0] = l_ref[:, 0] * corr + p.sum(axis=-1)
+            m_ref[:, 0] = m_new
+            vb = v_ref[0].astype(jnp.float32)
+            acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(j == max_nnz - 1)
+        def _finish():
+            l = jnp.maximum(l_ref[:, 0], 1e-30)
+            o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+    def k_index(bh, qi, j, lut_ref, nnz_ref):
+        h = jax.lax.rem(bh, H)
+        return (bh, lut_ref[(h * nq + qi) * max_nnz + j], 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * H, nq, max_nnz),
+        in_specs=[
+            pl.BlockSpec((1, block, D),
+                         lambda bh, qi, j, lut_ref, nnz_ref: (bh, qi, 0)),
+            pl.BlockSpec((1, block, D), k_index),
+            pl.BlockSpec((1, block, D), k_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block, D), lambda bh, qi, j, lut_ref, nnz_ref: (bh, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block, D), jnp.float32),
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(lut_flat, nnz_flat, q, k, v)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+@functools.lru_cache(maxsize=64)
+def _make_sparse_fn(layout_bytes, layout_shape, block, causal, sm_scale,
+                    interpret):
+    """Build (and cache) a differentiable block-sparse attention closure for
+    one static layout."""
+    layout = np.frombuffer(layout_bytes, dtype=np.int64).reshape(layout_shape)
+    lut, nnz = build_lut(layout)
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        return _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
+                            interpret=interpret)
+
+    def f_fwd(q, k, v):
+        return f(q, k, v), (q, k, v)
+
+    def f_bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q, k, v: _xla_impl(q, k, v, lut, nnz, block, causal,
+                                      sm_scale), q, k, v)
+        return vjp(g)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f, lut, nnz
+
+
+def block_sparse_attention(q, k, v, layout, block, causal=False,
+                           sm_scale=None, rpe=None, key_padding_mask=None,
+                           attn_mask=None, key_padding_mask_mode="add",
+                           attn_mask_mode="mul", implementation="auto",
+                           interpret=False):
+    """Fused block-sparse attention.
+
+    q,k,v: [B, T, H, D]; layout: [H, T//block, T//block] 0/1 (numpy,
+    static — from ``SparsityConfig.make_layout``). rpe: [B, H, T, T];
+    key_padding_mask: [B, T]; attn_mask: [T, T] (mask semantics per the
+    reference softmax op, `softmax.py:219`).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    T = q.shape[1]
+    layout = np.asarray(layout).astype(np.int64)
+    assert layout.shape[0] == q.shape[2], (
+        f"layout heads {layout.shape[0]} != tensor heads {q.shape[2]}")
+    assert layout.shape[1] * block == T, (
+        f"layout covers {layout.shape[1] * block} positions, seq len is {T}")
+
+    has_extras = (rpe is not None or key_padding_mask is not None or
+                  attn_mask is not None)
+    if implementation == "auto":
+        platform = jax.devices()[0].platform
+        implementation = "pallas" if (platform == "tpu" and
+                                      not has_extras) else "xla"
+    if implementation == "pallas":
+        assert not has_extras, (
+            "rpe/masks route through implementation='xla'")
+        fn, _, _ = _make_sparse_fn(layout.tobytes(), layout.shape, block,
+                                   causal, float(sm_scale), interpret)
+        return fn(q, k, v)
+    if implementation == "xla":
+        lut, nnz = build_lut(layout)
+        return _xla_impl(q, k, v, lut, nnz, block, causal, sm_scale,
+                         rpe=rpe, key_padding_mask=key_padding_mask,
+                         attn_mask=attn_mask,
+                         key_padding_mask_mode=key_padding_mask_mode,
+                         attn_mask_mode=attn_mask_mode)
+    raise ValueError(f"unknown implementation {implementation!r}")
+
+
+def masked_dense_attention(q, k, v, layout, block, causal=False,
+                           sm_scale=None, rpe=None, key_padding_mask=None,
+                           attn_mask=None, key_padding_mask_mode="add",
+                           attn_mask_mode="mul"):
+    """Dense attention with the layout applied as an elementwise mask — the
+    parity oracle for the sparse kernels (plays the role the dense-BERT
+    fixture plays for the reference's `test_sparse_attention.py`)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    B, T, H, D = q.shape
+    layout = np.asarray(layout)
+    elem = np.kron(layout, np.ones((block, block)))  # [H, T, T]
+    allowed = jnp.asarray(elem, bool)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * sm_scale
+    if rpe is not None:
+        scores = scores + rpe.astype(jnp.float32)
+    if key_padding_mask is not None:
+        m = key_padding_mask.astype(jnp.float32)
+        if key_padding_mask_mode == "mul":
+            m = jnp.where(m == 0, DEFAULT_MASK_VALUE, 0.0)
+        scores = scores + m[:, None, None, :]
+    if attn_mask is not None:
+        m = attn_mask.astype(jnp.float32)
+        if attn_mask_mode == "mul":
+            m = jnp.where(m == 0, DEFAULT_MASK_VALUE, 0.0)
+        scores = scores + m[None, None]
+    mask = allowed[None]
+    if causal:
+        tri = jnp.tril(jnp.ones((T, T), bool))
+        mask = mask & tri[None, None]
+    scores = jnp.where(mask, scores, DEFAULT_MASK_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32)) \
+        .astype(q.dtype)

@@ -1,0 +1,79 @@
+"""SparseSelfAttention: layout-driven sparse attention module.
+
+Analog of the reference module (`deepspeed/ops/sparse_attention/
+sparse_self_attention.py:13`), which chains SDD matmul → sparse softmax →
+DSD matmul; here the chain is one fused block-sparse flash-attention call
+(`block_sparse_attention.py`). Tensors follow the reference convention:
+[batch, heads, seq, head_dim].
+"""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+    block_sparse_attention,
+)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    FixedSparsityConfig,
+    SparsityConfig,
+)
+
+
+class SparseSelfAttention:
+    """Efficient sparse self attention (Generative Modeling with Sparse
+    Transformers, arXiv:1904.10509).
+
+    ``sparsity_config``: a :class:`SparsityConfig` subclass instance.
+    ``key_padding_mask_mode`` / ``attn_mask_mode``: "add" (mask added to
+    scores) or "mul" (zeros become -inf) — reference semantics.
+    """
+
+    def __init__(self, sparsity_config=None, key_padding_mask_mode="add",
+                 attn_mask_mode="mul", implementation="auto"):
+        if sparsity_config is None:
+            sparsity_config = FixedSparsityConfig(num_heads=4)
+        assert isinstance(sparsity_config, SparsityConfig)
+        self.sparsity_config = sparsity_config
+        assert key_padding_mask_mode in ("add", "mul")
+        assert attn_mask_mode in ("add", "mul")
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self.implementation = implementation
+        # per-instance layout cache keyed by seq len — the analog of the
+        # reference's per-seq-len ops cache (`sparse_self_attention.py:41-66`)
+        self._layouts = {}
+
+    def get_layout(self, seq_len):
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = \
+                self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        """query/key/value: [B, H, T, D] → attention context [B, H, T, D]."""
+        bsz, num_heads, tgt_len, head_dim = query.shape
+        if query.shape != key.shape or key.shape != value.shape:
+            raise NotImplementedError(
+                "only self-attention is supported for now")
+        assert num_heads == self.sparsity_config.num_heads, (
+            f"tensor has {num_heads} heads, sparsity config expects "
+            f"{self.sparsity_config.num_heads}")
+
+        layout = self.get_layout(tgt_len)
+        causal = getattr(self.sparsity_config, "attention",
+                         "bidirectional") == "unidirectional"
+        # [B, H, T, D] → [B, T, H, D]
+        q = jnp.swapaxes(query, 1, 2)
+        k = jnp.swapaxes(key, 1, 2)
+        v = jnp.swapaxes(value, 1, 2)
+        out = block_sparse_attention(
+            q, k, v, layout, self.sparsity_config.block,
+            causal=causal,
+            sm_scale=float(head_dim) ** -0.5,
+            rpe=rpe,
+            key_padding_mask=key_padding_mask,
+            attn_mask=attn_mask,
+            key_padding_mask_mode=self.key_padding_mask_mode,
+            attn_mask_mode=self.attn_mask_mode,
+            implementation=self.implementation)
+        return jnp.swapaxes(out, 1, 2)

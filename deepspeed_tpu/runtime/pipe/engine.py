@@ -91,7 +91,11 @@ class PipelineEngine(DeepSpeedEngine):
         if model_parameters is not None:
             # Pretrained weights: must match the built structure
             # (prologue/body/epilogue/tied with the stacked body layout).
-            jax.tree_util.tree_structure(model_parameters)  # raises if bogus
+            expected = jax.tree_util.tree_structure(self.pipeline_parts.params)
+            got = jax.tree_util.tree_structure(model_parameters)
+            assert got == expected, (
+                f"model_parameters do not match the built pipeline param "
+                f"structure:\n  expected {expected}\n  got      {got}")
             self.pipeline_parts.params = model_parameters
         # reference semantics: interval 0 disables rematerialization
         loss_fn = make_pipeline_loss_fn(

@@ -21,6 +21,7 @@ invariants (send-before-recv, forward-before-backward, buffer bounds)
 direct consequences of the simulation.
 """
 
+from functools import lru_cache as _functools_lru_cache
 from typing import List
 
 
@@ -115,12 +116,17 @@ class TrainSchedule(PipeSchedule):
     gradient reduction and the optimizer step (reference `schedule.py:182`).
     """
 
-    def num_pipe_buffers(self):
+    @staticmethod
+    def buffers_for(micro_batches, stages, stage_id):
         """In-flight activations at stage s are bounded by the 1F1B depth
         remaining to the last stage (reference `schedule.py:243-247`)."""
-        if self.micro_batches <= self.stages - self.stage_id:
-            return self.micro_batches
-        return self.stages - self.stage_id + 1
+        if micro_batches <= stages - stage_id:
+            return micro_batches
+        return stages - stage_id + 1
+
+    def num_pipe_buffers(self):
+        return self.buffers_for(self.micro_batches, self.stages,
+                                self.stage_id)
 
     def _warmup(self, stage_id):
         """Forwards issued before the first backward under 1F1B."""
@@ -128,74 +134,9 @@ class TrainSchedule(PipeSchedule):
 
     def _simulate(self):
         """Round-based event simulation of all stages; returns
-        per-stage, per-round instruction lists."""
-        M, S = self.micro_batches, self.stages
-        # Activations/gradients that have *arrived* and await consumption.
-        acts_in = [list(range(M)) if s == 0 else [] for s in range(S)]
-        grads_in = [[] for _ in range(S)]
-        fwds_done = [0] * S
-        bwds_done = [0] * S
-        rounds = []  # rounds[r][s] -> [instructions]
-        while any(b < M for b in bwds_done):
-            round_cmds = [[] for _ in range(S)]
-            # arrivals produced this round, delivered for the *next* round
-            act_arrivals = []   # (stage, micro_batch)
-            grad_arrivals = []
-            for s in range(S):
-                cmds = round_cmds[s]
-                # 1F1B in-flight bound: at most warmup(s) forwards may be
-                # outstanding (forwarded but not yet backwarded) — this is
-                # what caps activation memory at the pipeline depth.
-                in_flight = fwds_done[s] - bwds_done[s]
-                fwd_ready = (bool(acts_in[s]) and fwds_done[s] < M
-                             and in_flight < self._warmup(s))
-                bwd_ready = bool(grads_in[s])
-                # Once warmup forwards are in flight, prefer backward
-                # whenever one is ready.
-                do_bwd = bwd_ready and (fwds_done[s] >= self._warmup(s)
-                                        or not fwd_ready)
-                if do_bwd:
-                    m = grads_in[s].pop(0)
-                    sched = TrainSchedule(M, S, s)
-                    buf = m % sched.num_pipe_buffers()
-                    if s != S - 1:
-                        cmds.append(RecvGrad(buf, stage_id=s,
-                                             micro_batch_id=m))
-                    cmds.append(BackwardPass(buf, stage_id=s,
-                                             micro_batch_id=m))
-                    if s != 0:
-                        cmds.append(SendGrad(buf, stage_id=s,
-                                             micro_batch_id=m))
-                        grad_arrivals.append((s - 1, m))
-                    bwds_done[s] += 1
-                elif fwd_ready:
-                    m = acts_in[s].pop(0)
-                    sched = TrainSchedule(M, S, s)
-                    buf = m % sched.num_pipe_buffers()
-                    if s == 0 or s == S - 1:
-                        cmds.append(LoadMicroBatch(buf, stage_id=s,
-                                                   micro_batch_id=m))
-                    if s != 0:
-                        cmds.append(RecvActivation(buf, stage_id=s,
-                                                   micro_batch_id=m))
-                    cmds.append(ForwardPass(buf, stage_id=s,
-                                            micro_batch_id=m))
-                    if s != S - 1:
-                        cmds.append(SendActivation(buf, stage_id=s,
-                                                   micro_batch_id=m))
-                        act_arrivals.append((s + 1, m))
-                    else:
-                        # Loss is local to the last stage: its backward is
-                        # ready the round after its forward.
-                        grad_arrivals.append((s, m))
-                    fwds_done[s] += 1
-                # else: bubble
-            for s, m in act_arrivals:
-                acts_in[s].append(m)
-            for s, m in grad_arrivals:
-                grads_in[s].append(m)
-            rounds.append(round_cmds)
-        return rounds
+        per-stage, per-round instruction lists. The simulation is
+        stage-independent, so it's computed once per (M, S)."""
+        return _simulate_rounds(self.micro_batches, self.stages)
 
     def steps(self):
         for round_cmds in self._simulate():
@@ -204,6 +145,78 @@ class TrainSchedule(PipeSchedule):
         yield [ReduceTiedGrads(stage_id=self.stage_id),
                ReduceGrads(stage_id=self.stage_id),
                OptimizerStep(stage_id=self.stage_id)]
+
+
+@_functools_lru_cache(maxsize=128)
+def _simulate_rounds(M, S):
+    """Round-based event simulation of all S stages for M microbatches."""
+    def warmup(s):
+        return min(M, S - s)
+
+    # Activations/gradients that have *arrived* and await consumption.
+    acts_in = [list(range(M)) if s == 0 else [] for s in range(S)]
+    grads_in = [[] for _ in range(S)]
+    fwds_done = [0] * S
+    bwds_done = [0] * S
+    rounds = []  # rounds[r][s] -> [instructions]
+    while any(b < M for b in bwds_done):
+        round_cmds = [[] for _ in range(S)]
+        # arrivals produced this round, delivered for the *next* round
+        act_arrivals = []   # (stage, micro_batch)
+        grad_arrivals = []
+        for s in range(S):
+            cmds = round_cmds[s]
+            # 1F1B in-flight bound: at most warmup(s) forwards may be
+            # outstanding (forwarded but not yet backwarded) — this is
+            # what caps activation memory at the pipeline depth.
+            in_flight = fwds_done[s] - bwds_done[s]
+            fwd_ready = (bool(acts_in[s]) and fwds_done[s] < M
+                         and in_flight < warmup(s))
+            bwd_ready = bool(grads_in[s])
+            # Once warmup forwards are in flight, prefer backward
+            # whenever one is ready.
+            do_bwd = bwd_ready and (fwds_done[s] >= warmup(s)
+                                    or not fwd_ready)
+            if do_bwd:
+                m = grads_in[s].pop(0)
+                buf = m % TrainSchedule.buffers_for(M, S, s)
+                if s != S - 1:
+                    cmds.append(RecvGrad(buf, stage_id=s,
+                                         micro_batch_id=m))
+                cmds.append(BackwardPass(buf, stage_id=s,
+                                         micro_batch_id=m))
+                if s != 0:
+                    cmds.append(SendGrad(buf, stage_id=s,
+                                         micro_batch_id=m))
+                    grad_arrivals.append((s - 1, m))
+                bwds_done[s] += 1
+            elif fwd_ready:
+                m = acts_in[s].pop(0)
+                buf = m % TrainSchedule.buffers_for(M, S, s)
+                if s == 0 or s == S - 1:
+                    cmds.append(LoadMicroBatch(buf, stage_id=s,
+                                               micro_batch_id=m))
+                if s != 0:
+                    cmds.append(RecvActivation(buf, stage_id=s,
+                                               micro_batch_id=m))
+                cmds.append(ForwardPass(buf, stage_id=s,
+                                        micro_batch_id=m))
+                if s != S - 1:
+                    cmds.append(SendActivation(buf, stage_id=s,
+                                               micro_batch_id=m))
+                    act_arrivals.append((s + 1, m))
+                else:
+                    # Loss is local to the last stage: its backward is
+                    # ready the round after its forward.
+                    grad_arrivals.append((s, m))
+                fwds_done[s] += 1
+            # else: bubble
+        for s, m in act_arrivals:
+            acts_in[s].append(m)
+        for s, m in grad_arrivals:
+            grads_in[s].append(m)
+        rounds.append(round_cmds)
+    return rounds
 
 
 class DataParallelSchedule(PipeSchedule):
