@@ -29,7 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+from deepspeed_tpu.ops.pallas.flash_attention import DEFAULT_MASK_VALUE
 
 
 # ---------------------------------------------------------------------------
@@ -54,6 +54,12 @@ def build_lut(layout):
             cols = np.nonzero(layout[h, qi])[0]
             lut[h, qi, :len(cols)] = cols
     return lut, nnz
+
+
+@functools.lru_cache(maxsize=64)
+def _build_lut_cached(layout_bytes, layout_shape):
+    layout = np.frombuffer(layout_bytes, dtype=np.int64).reshape(layout_shape)
+    return build_lut(layout)
 
 
 # ---------------------------------------------------------------------------
@@ -248,8 +254,7 @@ def _make_sparse_fn(layout_bytes, layout_shape, block, causal, sm_scale,
                     interpret):
     """Build (and cache) a differentiable block-sparse attention closure for
     one static layout."""
-    layout = np.frombuffer(layout_bytes, dtype=np.int64).reshape(layout_shape)
-    lut, nnz = build_lut(layout)
+    lut, nnz = _build_lut_cached(layout_bytes, layout_shape)
 
     @jax.custom_vjp
     def f(q, k, v):
@@ -304,7 +309,7 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
                                    causal, float(sm_scale), interpret)
         return fn(q, k, v)
     if implementation == "xla":
-        lut, nnz = build_lut(layout)
+        lut, nnz = _build_lut_cached(layout.tobytes(), layout.shape)
         return _xla_impl(q, k, v, lut, nnz, block, causal, sm_scale,
                          rpe=rpe, key_padding_mask=key_padding_mask,
                          attn_mask=attn_mask,

@@ -1,0 +1,15 @@
+"""Parallelism primitives: mesh bootstrap + sequence/context parallelism."""
+
+from deepspeed_tpu.parallel.mesh import (
+    MESH_AXES,
+    build_mesh,
+    initialize_distributed,
+    normalize_mesh_shape,
+    single_device_mesh,
+)
+from deepspeed_tpu.parallel.sequence import (
+    ring_attention,
+    ring_attention_local,
+    ulysses_attention,
+    ulysses_attention_local,
+)

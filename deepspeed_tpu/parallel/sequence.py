@@ -1,0 +1,143 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has **no** sequence-dim sharding (SURVEY.md §5.7 — its
+long-context story is block-sparse attention only). These are the
+TPU-idiomatic long-context mechanisms this framework adds on top of parity:
+
+- **Ring attention** (Liu et al., arXiv:2310.01889): q stays put, k/v chunks
+  rotate around the ``seq`` mesh axis via ``ppermute`` (ICI-neighbor
+  traffic), with online-softmax accumulation so each device only ever holds
+  one remote chunk. Memory per device: O(T/n); comm: n-1 neighbor hops that
+  XLA overlaps with the chunk matmuls.
+- **Ulysses** (DeepSpeed-Ulysses, arXiv:2309.14509): two ``all_to_all``
+  collectives re-shard [seq-sharded, all heads] ⟷ [all seq, head-sharded]
+  so any full-sequence attention kernel (flash, block-sparse) runs
+  unchanged on H/n heads.
+
+Both come as a ``*_local`` form for use inside an existing ``shard_map``
+(how the engine composes them) and a standalone wrapper that builds the
+``shard_map`` over a mesh.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.pallas.flash_attention import (
+    DEFAULT_MASK_VALUE,
+    flash_attention,
+)
+
+
+def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
+    """Ring attention over ``axis_name``; call inside ``shard_map``.
+
+    q, k, v: [B, T_local, H, D] — this device's sequence shard. Returns the
+    local [B, T_local, H, D] attention output, exactly equal to the
+    corresponding slice of full attention over the global sequence.
+    """
+    B, Tloc, H, D = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32) * sm_scale
+    q_pos = idx * Tloc + jnp.arange(Tloc)            # global q positions
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def compute_chunk(acc, m, l, kc, vc, src):
+        k_pos = src * Tloc + jnp.arange(Tloc)
+        s = jnp.einsum("bthd,bshd->bhts", qf, kc.astype(jnp.float32))
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]  # [Tloc, Tloc] global
+            s = jnp.where(mask[None, None], s, DEFAULT_MASK_VALUE)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc = acc * corr[..., None] + \
+            jnp.einsum("bhts,bshd->bhtd", p, vc.astype(jnp.float32))
+        return acc, m_new, l_new
+
+    def step(carry, t):
+        acc, m, l, kc, vc = carry
+        # rotation first: t=0 (own chunk) is handled outside the scan, so
+        # only n-1 ppermutes ever ship data
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        # after t rotations this device holds the chunk of owner (idx - t)
+        src = jnp.mod(idx - t, n)
+        if causal:
+            # chunks entirely in the future are all-masked: skip their
+            # matmuls (predicate varies per device; branch is local math)
+            acc, m, l = jax.lax.cond(
+                src <= idx,
+                lambda a, mm, ll: compute_chunk(a, mm, ll, kc, vc, src),
+                lambda a, mm, ll: (a, mm, ll),
+                acc, m, l)
+        else:
+            acc, m, l = compute_chunk(acc, m, l, kc, vc, src)
+        return (acc, m, l, kc, vc), None
+
+    acc0 = jnp.zeros((B, H, Tloc, D), jnp.float32)
+    m0 = jnp.full((B, H, Tloc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Tloc), jnp.float32)
+    acc, m, l = compute_chunk(acc0, m0, l0, k, v, idx)   # own (diagonal) chunk
+    (acc, m, l, _, _), _ = jax.lax.scan(
+        step, (acc, m, l, k, v), jnp.arange(1, n))
+    # causal rows always see the diagonal chunk (t=0), so l > 0 everywhere
+    out = acc / l[..., None]
+    return jnp.moveaxis(out, 1, 2).astype(q.dtype)
+
+
+def ulysses_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
+                            attn_fn=None):
+    """Ulysses sequence parallelism; call inside ``shard_map``.
+
+    q, k, v: [B, T_local, H, D] seq shards with H divisible by the axis
+    size. all_to_all → [B, T, H/n, D], run ``attn_fn`` (default
+    :func:`flash_attention`) on the full sequence, all_to_all back.
+    """
+    n = jax.lax.psum(1, axis_name)
+    H = q.shape[2]
+    assert H % n == 0, f"heads {H} must divide seq-parallel degree {n}"
+    if attn_fn is None:
+        attn_fn = flash_attention   # "auto": Pallas on TPU, XLA elsewhere
+
+    def scatter_heads(x):   # [B, Tloc, H, D] → [B, T, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    out = attn_fn(scatter_heads(q), scatter_heads(k), scatter_heads(v),
+                  causal=causal, sm_scale=sm_scale)
+    # [B, T, H/n, D] → [B, Tloc, H, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def _seq_sharded_call(local_fn, mesh, q, k, v, seq_axis, data_axis):
+    specs = P(data_axis, seq_axis, None, None)
+    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(specs, specs, specs),
+                       out_specs=specs, check_vma=False)
+    return fn(q, k, v)
+
+
+def ring_attention(q, k, v, mesh, causal=True, sm_scale=None,
+                   seq_axis="seq", data_axis="data"):
+    """Standalone ring attention: q,k,v [B, T, H, D] global arrays sharded
+    [data, seq] over ``mesh``."""
+    local = functools.partial(ring_attention_local, axis_name=seq_axis,
+                              causal=causal, sm_scale=sm_scale)
+    return _seq_sharded_call(local, mesh, q, k, v, seq_axis, data_axis)
+
+
+def ulysses_attention(q, k, v, mesh, causal=True, sm_scale=None,
+                      seq_axis="seq", data_axis="data", attn_fn=None):
+    """Standalone Ulysses attention: q,k,v [B, T, H, D] sharded [data, seq]."""
+    local = functools.partial(ulysses_attention_local, axis_name=seq_axis,
+                              causal=causal, sm_scale=sm_scale,
+                              attn_fn=attn_fn)
+    return _seq_sharded_call(local, mesh, q, k, v, seq_axis, data_axis)

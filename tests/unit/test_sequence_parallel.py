@@ -1,0 +1,116 @@
+"""Ring attention / Ulysses sequence parallelism on the 8-device CPU mesh —
+exact parity vs full dense attention (the capability the reference lacks;
+SURVEY.md §5.7)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.ops.pallas.flash_attention import dense_attention
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.parallel.sequence import (
+    ring_attention,
+    ulysses_attention,
+)
+
+
+def qkv(seed=0, B=2, T=128, H=4, D=16, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, T, H, D)
+    return (jax.random.normal(ks[0], shape, dtype),
+            jax.random.normal(ks[1], shape, dtype),
+            jax.random.normal(ks[2], shape, dtype))
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    return build_mesh({"seq": 4, "data": 2})
+
+
+@pytest.fixture(scope="module")
+def seq8_mesh():
+    return build_mesh({"seq": 8, "data": 1})
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(seq_mesh, causal):
+    q, k, v = qkv()
+    ref = dense_attention(q, k, v, causal=causal)
+    got = ring_attention(q, k, v, seq_mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_full_seq_axis(seq8_mesh):
+    q, k, v = qkv(T=64)
+    ref = dense_attention(q, k, v, causal=True)
+    got = ring_attention(q, k, v, seq8_mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_under_jit(seq_mesh):
+    q, k, v = qkv(T=64)
+    f = jax.jit(lambda q, k, v: ring_attention(q, k, v, seq_mesh,
+                                               causal=True))
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_gradients(seq_mesh):
+    q, k, v = qkv(T=64, B=2, H=2, D=8)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, seq_mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    g_got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_got, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_dense(seq_mesh, causal):
+    q, k, v = qkv()
+    ref = dense_attention(q, k, v, causal=causal)
+    got = ulysses_attention(q, k, v, seq_mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_gradients(seq_mesh):
+    q, k, v = qkv(T=64, B=2, H=4, D=8)
+
+    def loss_uly(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, seq_mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    g_got = jax.grad(loss_uly, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_got, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_head_divisibility(seq8_mesh):
+    q, k, v = qkv(T=64, H=4)  # 4 heads on an 8-way seq axis
+    with pytest.raises(Exception):
+        jax.block_until_ready(
+            ulysses_attention(q, k, v, seq8_mesh, causal=False))
+
+
+def test_ring_attention_bf16(seq_mesh):
+    q, k, v = qkv(dtype=jnp.bfloat16, T=64)
+    ref = dense_attention(q, k, v, causal=True)
+    got = ring_attention(q, k, v, seq_mesh, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        rtol=0.05, atol=0.05)
