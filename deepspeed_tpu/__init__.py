@@ -11,6 +11,8 @@ from deepspeed_tpu.version import version as __version__, git_hash, git_branch
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
+from deepspeed_tpu.runtime.activation_checkpointing import (
+    checkpointing)
 from deepspeed_tpu.utils.logging import logger, log_dist
 
 

@@ -168,6 +168,14 @@ class DeepSpeedEngine:
         if self._config.tensorboard_enabled and jax.process_index() == 0:
             self.summary_writer = self._get_summary_writer()
 
+        # Activation checkpointing module config (reference
+        # `_configure_checkpointing`, engine.py:412). An explicit user
+        # configure() beforehand wins over the engine's JSON-derived one.
+        from deepspeed_tpu.runtime.activation_checkpointing import (
+            checkpointing as _act_ckpt)
+        if not _act_ckpt.is_configured():
+            _act_ckpt.configure(mpu_=mpu, deepspeed_config=self._config)
+
         self._rng = jax.random.PRNGKey(seed)
         self._compiled_train_step = None
         self._compiled_eval_step = None

@@ -8,7 +8,12 @@ note). Must set the env vars before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# NOTE: the image's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon (the real-TPU tunnel), so env vars set here are too
+# late for jax's config defaults — jax.config.update below is what actually
+# forces CPU. XLA_FLAGS is still read lazily at first backend init, so the
+# device-count flag works from here.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,4 +21,5 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_platform_name", "cpu")
