@@ -58,6 +58,44 @@ class DeviceState(NamedTuple):
     skipped_steps: jnp.ndarray   # i32 — overflow-skipped steps
 
 
+def make_grad_accumulator(loss_fn, compute_dtype, accum):
+    """Build ``accumulate(params, batch, rng, scale) -> (loss_sum, grads)``:
+    scaled-loss value-and-grad over one microbatch, or a ``lax.scan`` over
+    ``accum`` microbatches (batch leading dim = accum). Shared by the dense
+    and the 1-bit (shard_map) train steps."""
+
+    def cast_params(p):
+        return jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
+
+    def micro_grads(params, micro_batch, rng, scale):
+        def scaled_loss(p):
+            loss = loss_fn(cast_params(p), micro_batch, rng)
+            return loss * scale, loss
+        (_, loss), grads = jax.value_and_grad(
+            scaled_loss, has_aux=True)(params)
+        return loss, grads
+
+    def accumulate(params, batch, rng, scale):
+        if accum == 1:
+            micro = jax.tree_util.tree_map(lambda x: x[0], batch)
+            return micro_grads(params, micro, rng, scale)
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+        def body(carry, micro):
+            g_acc, loss_acc, key = carry
+            key, sub = jax.random.split(key)
+            loss, g = micro_grads(params, micro, sub, scale)
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+            return (g_acc, loss_acc + loss, key), None
+
+        (grads, loss_sum, _), _ = jax.lax.scan(
+            body, (zeros, jnp.asarray(0.0, jnp.float32), rng), batch)
+        return loss_sum, grads
+
+    return accumulate
+
+
 class DeepSpeedEngine:
     """Training engine around a pure ``loss_fn(params, batch, rng)``."""
 
@@ -254,12 +292,23 @@ class DeepSpeedEngine:
         if client_optimizer is not None and not isinstance(client_optimizer, str):
             # Client passed one of our optimizer wrapper objects.
             self.client_optimizer = client_optimizer
-            self.opt_init_fn = client_optimizer.init
+            self.optimizer_name = type(client_optimizer).__name__.lower()
+            if self.optimizer_name == ONEBIT_ADAM_OPTIMIZER:
+                # The wrapper's init needs the data-parallel world size for
+                # the error-feedback buffers, and the optimizer needs the
+                # shard_map train step (fp16-path scope, not ZeRO).
+                assert self.zero_optimization_stage() == 0, (
+                    "OneBitAdam is not compatible with ZeRO "
+                    "(reference scope: fp16 optimizer path only)")
+                world = self.dp_world_size
+                self.opt_init_fn = lambda p: client_optimizer.init(
+                    p, world=world)
+            else:
+                self.opt_init_fn = client_optimizer.init
             self._opt_update = lambda p, g, s, lr, beta1: \
                 client_optimizer.update(p, g, s, lr=lr, beta1=beta1)
             self._base_lr = getattr(client_optimizer, "lr", 1e-3)
             self._betas = getattr(client_optimizer, "betas", (0.9, 0.999))
-            self.optimizer_name = type(client_optimizer).__name__.lower()
             return
         self.client_optimizer = None
 
@@ -273,7 +322,24 @@ class DeepSpeedEngine:
         self._base_lr = lr
         self.optimizer_name = name
 
-        if name in (ADAM_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, "adamw"):
+        if name == ONEBIT_ADAM_OPTIMIZER:
+            # 1-bit Adam runs the fp16-optimizer path, not ZeRO (same scope
+            # as the reference, whose OnebitAdam goes through FP16_Optimizer)
+            # and needs local per-shard grads, so the train step switches to
+            # shard_map over the data axis.
+            assert self.zero_optimization_stage() == 0, (
+                "OneBitAdam is not compatible with ZeRO "
+                "(reference scope: fp16 optimizer path only)")
+            from deepspeed_tpu.runtime.fp16.onebit_adam import (
+                init_onebit_state, onebit_adam_update)
+            freeze_step = opt_params.pop("freeze_step", 100000)
+            world = self.dp_world_size
+            self.opt_init_fn = lambda p: init_onebit_state(p, world)
+            self._opt_update = lambda p, g, s, lr_, beta1: onebit_adam_update(
+                p, g, s, lr=lr_, beta1=beta1, beta2=betas[1], eps=eps,
+                weight_decay=weight_decay, freeze_step=freeze_step,
+                axis_name="data")
+        elif name in (ADAM_OPTIMIZER, "adamw"):
             adam_w_mode = opt_params.pop("adam_w_mode", name == "adamw")
             self.opt_init_fn = init_adam_state
             self._opt_update = lambda p, g, s, lr_, beta1: adam_update(
@@ -327,10 +393,18 @@ class DeepSpeedEngine:
     def _opt_state_shardings(self):
         """Shardings for the optimizer-state pytree: the m/v moment trees
         follow the (possibly ZeRO-sharded) opt layout; the step counter
-        replicates. AdamState and LambState share the (m, v, step) shape."""
+        replicates. AdamState and LambState share the (m, v, step) shape;
+        OnebitAdamState adds data-sharded error-feedback residuals."""
         opt = self._shardings["opt"]
         rep = NamedSharding(self.mesh, PartitionSpec())
         sample = jax.eval_shape(self.opt_init_fn, self.params)
+        from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdamState
+        if isinstance(sample, OnebitAdamState):
+            return OnebitAdamState(
+                m=opt, v=opt, step=rep,
+                worker_error=NamedSharding(
+                    self.mesh, PartitionSpec("data", None)),
+                server_error=NamedSharding(self.mesh, PartitionSpec("data")))
         return type(sample)(m=opt, v=opt, step=rep)
 
     def _current_host_lr(self):
@@ -399,6 +473,8 @@ class DeepSpeedEngine:
         return self._config.gradient_accumulation_steps
 
     def _make_train_step(self):
+        if self.optimizer_name == ONEBIT_ADAM_OPTIMIZER:
+            return self._make_onebit_train_step()
         accum = self._engine_accum_steps()
         compute_dtype = self.compute_dtype
         fp16 = self._config.fp16_enabled
@@ -416,39 +492,12 @@ class DeepSpeedEngine:
         scale_args = self._scale_args()
         dynamic = self.dynamic_loss_scale
         static_scale = self.static_loss_scale
-
-        def cast_params(p):
-            return jax.tree_util.tree_map(
-                lambda x: x.astype(compute_dtype), p)
-
-        def micro_grads(params, micro_batch, rng, scale):
-            def scaled_loss(p):
-                loss = loss_fn(cast_params(p), micro_batch, rng)
-                return loss * scale, loss
-            (_, loss), grads = jax.value_and_grad(
-                scaled_loss, has_aux=True)(params)
-            return loss, grads
+        accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum)
 
         def train_step(params, opt_state, dstate, batch, rng, lr_in):
             scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
                 else jnp.asarray(static_scale, jnp.float32)
-
-            if accum == 1:
-                micro = jax.tree_util.tree_map(lambda x: x[0], batch)
-                loss_sum, grads = micro_grads(params, micro, rng, scale)
-            else:
-                zeros = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
-
-                def body(carry, micro):
-                    g_acc, loss_acc, key = carry
-                    key, sub = jax.random.split(key)
-                    loss, g = micro_grads(params, micro, sub, scale)
-                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
-                    return (g_acc, loss_acc + loss, key), None
-
-                (grads, loss_sum, _), _ = jax.lax.scan(
-                    body, (zeros, jnp.asarray(0.0, jnp.float32), rng), batch)
+            loss_sum, grads = accumulate(params, batch, rng, scale)
 
             # Unscale + average over microbatches. The reference's
             # prescale_gradients / gradient_predivide_factor knobs
@@ -508,6 +557,118 @@ class DeepSpeedEngine:
         # outputs are pinned by the constrain_tree calls above, so plain jit
         # with donation suffices.
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def _make_onebit_train_step(self):
+        """Compiled 1-bit Adam step: shard_map over the ``data`` axis so
+        each shard sees *local* gradients, which the optimizer averages
+        itself — densely (pmean) during warmup, with the 1-bit
+        error-feedback collective after ``freeze_step`` (the analog of the
+        reference disabling engine allreduce at onebit_adam.py:372 and
+        running its MPI data plane)."""
+        from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdamState
+
+        for ax, size in self.mesh.shape.items():
+            assert ax == "data" or size == 1, (
+                f"OneBitAdam supports pure data parallelism; mesh axis "
+                f"{ax!r} has size {size}")
+
+        accum = self._engine_accum_steps()
+        compute_dtype = self.compute_dtype
+        fp16 = self._config.fp16_enabled
+        clip = float(self._config.gradient_clipping or 0.0)
+        lr_fn = self._lr_fn
+        mom_fn = self._mom_fn
+        opt_update = self._opt_update
+        loss_fn = self.loss_fn
+        scale_args = self._scale_args()
+        dynamic = self.dynamic_loss_scale
+        static_scale = self.static_loss_scale
+        accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum)
+
+        def step_local(params, opt_state, dstate, batch, rng, lr_in):
+            scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
+                else jnp.asarray(static_scale, jnp.float32)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            loss_sum, grads = accumulate(params, batch, rng, scale)
+
+            denom = scale * accum
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / denom, grads)
+
+            # Cross-shard overflow vote (reference stage2.py:1527-1551).
+            overflow = check_overflow(grads) if fp16 else jnp.asarray(False)
+            overflow = jax.lax.pmax(overflow.astype(jnp.int32), "data") > 0
+            # Local-shard grad norm, averaged — a scalar-only diagnostic
+            # (a true global norm would need the dense allreduce this
+            # optimizer exists to avoid).
+            grad_norm = jax.lax.pmean(global_norm(grads), "data")
+            applied_norm = grad_norm
+            if clip > 0:
+                # Clip by the *max* local norm so every shard scales its
+                # grads by the same factor (rank-consistent params), and
+                # conservatively: the max bounds the true global norm of
+                # the averaged gradient.
+                norm_max = jax.lax.pmax(global_norm(grads), "data")
+                grads = clip_by_global_norm(grads, clip, norm=norm_max)
+                applied_norm = jax.lax.pmean(global_norm(grads), "data")
+
+            lr = lr_fn(dstate.global_step) if lr_fn is not None else lr_in
+            beta1 = mom_fn(dstate.global_step)
+            new_params, new_opt = opt_update(params, grads, opt_state, lr,
+                                             beta1)
+
+            def select(old, new):
+                return jax.tree_util.tree_map(
+                    lambda o, n: jnp.where(overflow, o, n), old, new)
+            params_out = select(params, new_params)
+            opt_out = OnebitAdamState(
+                m=select(opt_state.m, new_opt.m),
+                v=select(opt_state.v, new_opt.v),
+                step=jnp.where(overflow, opt_state.step, new_opt.step),
+                worker_error=select(opt_state.worker_error,
+                                    new_opt.worker_error),
+                server_error=select(opt_state.server_error,
+                                    new_opt.server_error))
+
+            if fp16 and dynamic:
+                new_scale = update_loss_scale(dstate.loss_scale, overflow,
+                                              **scale_args)
+            else:
+                new_scale = dstate.loss_scale
+            dstate_out = DeviceState(
+                loss_scale=new_scale,
+                global_step=dstate.global_step + 1,
+                skipped_steps=dstate.skipped_steps +
+                overflow.astype(jnp.int32))
+            metrics = {
+                "loss": jax.lax.pmean(loss_sum / accum, "data"),
+                "grad_norm": grad_norm,
+                "applied_grad_norm": applied_norm,
+                "lr": lr,
+                "loss_scale": scale,
+                "overflow": overflow,
+            }
+            return params_out, opt_out, dstate_out, metrics
+
+        P = PartitionSpec
+        rep = P()
+        opt_specs = OnebitAdamState(
+            m=jax.tree_util.tree_map(lambda _: rep, self.opt_state.m),
+            v=jax.tree_util.tree_map(lambda _: rep, self.opt_state.v),
+            step=rep, worker_error=P("data", None), server_error=P("data"))
+        param_specs = jax.tree_util.tree_map(lambda _: rep, self.params)
+        dstate_specs = jax.tree_util.tree_map(lambda _: rep,
+                                              self.device_state)
+        metrics_specs = {k: rep for k in ("loss", "grad_norm",
+                                          "applied_grad_norm", "lr",
+                                          "loss_scale", "overflow")}
+        mapped = jax.shard_map(
+            step_local, mesh=self.mesh,
+            in_specs=(param_specs, opt_specs, dstate_specs, P(None, "data"),
+                      rep, rep),
+            out_specs=(param_specs, opt_specs, dstate_specs, metrics_specs),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0, 1, 2))
 
     def _shard_batch(self, batch):
         """Host-side: this process's batch rows → [accum, per_step_global, ...]
@@ -768,11 +929,30 @@ class DeepSpeedEngine:
 
     def _opt_state_to_tree(self):
         s = self.opt_state
-        return {"m": s.m, "v": s.v, "step": s.step}
+        tree = {"m": s.m, "v": s.v, "step": s.step}
+        if hasattr(s, "worker_error"):
+            tree["worker_error"] = s.worker_error
+            tree["server_error"] = s.server_error
+        return tree
 
     def _opt_state_from_tree(self, tree, template):
+        extra = {}
+        if hasattr(template, "worker_error"):
+            we, se = tree["worker_error"], tree["server_error"]
+            if tuple(np.shape(we)) != tuple(template.worker_error.shape):
+                # Elastic dp resize: the error-feedback buffers are shaped
+                # by the saved world size and can't be repartitioned —
+                # restart error feedback from zero (one step of extra
+                # compression noise, then back on track).
+                logger.warning(
+                    "onebit error buffers saved for a different dp world "
+                    "size; resetting error feedback to zero")
+                we = jnp.zeros(template.worker_error.shape, jnp.float32)
+                se = jnp.zeros(template.server_error.shape, jnp.float32)
+            extra = {"worker_error": we, "server_error": se}
         return type(template)(m=tree["m"], v=tree["v"],
-                              step=jnp.asarray(tree["step"], jnp.int32))
+                              step=jnp.asarray(tree["step"], jnp.int32),
+                              **extra)
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True,
