@@ -1,0 +1,123 @@
+"""DeepSpeedCPUAdam: the host-RAM optimizer behind ZeRO-Offload.
+
+Analog of the reference's ``DeepSpeedCPUAdam``
+(`deepspeed/ops/adam/cpu_adam.py:12`, kernel `csrc/adam/cpu_adam.cpp`):
+fp32 master weights and Adam moments live in host memory; each step runs
+the AVX/OpenMP C++ kernel over one flat buffer, then hands back a bf16 (or
+fp32) copy for the device upload — the analog of the reference's fused
+fp16 param copy-back on a side stream.
+"""
+
+import ctypes
+import itertools
+
+import numpy as np
+import jax
+
+from deepspeed_tpu.ops.op_builder.builder import CPUAdamBuilder
+
+_ids = itertools.count()
+
+
+def _fptr(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    """Flat-buffer host AdamW over a params pytree.
+
+    ``params`` (pytree of arrays) seeds the fp32 masters. ``step(grads)``
+    takes the matching gradient pytree (device or host), updates masters in
+    C++, and returns the updated params pytree as numpy fp32 views (zero
+    copy) — callers device_put them at whatever dtype they need.
+    """
+
+    optimizer_id = None
+
+    def __init__(self, params, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, bias_correction=True, adamw_mode=True,
+                 amsgrad=False):
+        if amsgrad:
+            raise RuntimeError("CPUAdam does not support the AMSGrad variant.")
+        self.lr = lr
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.lib = CPUAdamBuilder().load()
+        self.opt_id = next(_ids)
+        self.lib.ds_create_adam(
+            self.opt_id, ctypes.c_float(lr), ctypes.c_float(betas[0]),
+            ctypes.c_float(betas[1]), ctypes.c_float(eps),
+            ctypes.c_float(weight_decay), int(adamw_mode),
+            int(bias_correction))
+
+        leaves, self.treedef = jax.tree_util.tree_flatten(params)
+        self.shapes = [np.shape(l) for l in leaves]
+        self.sizes = [int(np.size(l)) for l in leaves]
+        self.total = sum(self.sizes)
+        self.offsets = np.cumsum([0] + self.sizes).tolist()
+        # One contiguous fp32 master buffer + moment buffers.
+        self.master = np.empty(self.total, np.float32)
+        for leaf, off, size in zip(leaves, self.offsets, self.sizes):
+            self.master[off:off + size] = np.asarray(
+                leaf, np.float32).reshape(-1)
+        self.exp_avg = np.zeros(self.total, np.float32)
+        self.exp_avg_sq = np.zeros(self.total, np.float32)
+        self._step = 0
+        self._grad_buf = np.empty(self.total, np.float32)
+
+    def __del__(self):
+        try:
+            self.lib.ds_destroy_adam(self.opt_id)
+        except Exception:
+            pass
+
+    # -- core --------------------------------------------------------------
+    def step(self, grads, lr=None, beta1=None):
+        """One Adam step; returns the updated params pytree (numpy fp32
+        views into the master buffer). ``lr``/``beta1`` override the
+        constructor values (schedule support)."""
+        g_leaves = self.treedef.flatten_up_to(grads)
+        for leaf, off, size in zip(g_leaves, self.offsets, self.sizes):
+            self._grad_buf[off:off + size] = np.asarray(
+                leaf, np.float32).reshape(-1)
+        self._step += 1
+        rc = self.lib.ds_adam_step(
+            self.opt_id, ctypes.c_int64(self._step),
+            ctypes.c_float(-1.0 if lr is None else lr),
+            ctypes.c_float(-1.0 if beta1 is None else beta1),
+            _fptr(self.master), _fptr(self._grad_buf), _fptr(self.exp_avg),
+            _fptr(self.exp_avg_sq), ctypes.c_int64(self.total))
+        assert rc == 0, f"ds_adam_step failed with {rc}"
+        return self.params()
+
+    def params(self):
+        """Current masters as a pytree of fp32 numpy views (no copy)."""
+        leaves = [self.master[off:off + size].reshape(shape)
+                  for off, size, shape in zip(self.offsets, self.sizes,
+                                              self.shapes)]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def params_bf16_flat(self):
+        """Masters converted to bf16 by the fused C++ kernel, as one flat
+        uint16 buffer (bit pattern of bf16) ready for device upload."""
+        import ml_dtypes
+        out = np.empty(self.total, np.uint16)
+        self.lib.ds_fp32_to_bf16(
+            _fptr(self.master),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            ctypes.c_int64(self.total))
+        return out.view(ml_dtypes.bfloat16)
+
+    # -- checkpoint --------------------------------------------------------
+    def state_dict(self):
+        return {"master": self.master.copy(), "exp_avg": self.exp_avg.copy(),
+                "exp_avg_sq": self.exp_avg_sq.copy(), "step": self._step}
+
+    def load_state_dict(self, state):
+        self.master[:] = np.asarray(state["master"], np.float32).reshape(-1)
+        self.exp_avg[:] = np.asarray(state["exp_avg"],
+                                     np.float32).reshape(-1)
+        self.exp_avg_sq[:] = np.asarray(state["exp_avg_sq"],
+                                        np.float32).reshape(-1)
+        self._step = int(state["step"])

@@ -1,0 +1,11 @@
+"""Native-op registry (reference ``op_builder/__init__.py:12-20`` ALL_OPS)."""
+
+from deepspeed_tpu.ops.op_builder.builder import (
+    CPUAdamBuilder, OpBuilder, UtilsBuilder)
+
+ALL_OPS = {
+    CPUAdamBuilder.NAME: CPUAdamBuilder,
+    UtilsBuilder.NAME: UtilsBuilder,
+}
+
+__all__ = ["OpBuilder", "CPUAdamBuilder", "UtilsBuilder", "ALL_OPS"]

@@ -58,6 +58,64 @@ class DeviceState(NamedTuple):
     skipped_steps: jnp.ndarray   # i32 — overflow-skipped steps
 
 
+def grad_epilogue(grads, scale, accum, fp16, clip, constrain=None,
+                  vote=None, norm_reduce=None, clip_norm_reduce=None):
+    """Shared post-gradient block for every train-step flavor: unscale and
+    average over microbatches → optional sharding constraint → overflow
+    check (optionally cross-shard voted) → grad norms → clipping.
+
+    Returns ``(grads, overflow, grad_norm, applied_norm)``. ``norm_reduce``
+    maps a local norm to the reported one (identity for GSPMD steps, pmean
+    under shard_map); ``clip_norm_reduce`` picks the norm the clip factor is
+    computed from (must be rank-consistent under shard_map)."""
+    denom = scale * accum
+    grads = jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32) / denom, grads)
+    if constrain is not None:
+        grads = constrain(grads)
+    overflow = check_overflow(grads) if fp16 else jnp.asarray(False)
+    if vote is not None:
+        overflow = vote(overflow)
+    nr = norm_reduce if norm_reduce is not None else (lambda n: n)
+    cnr = clip_norm_reduce if clip_norm_reduce is not None else (lambda n: n)
+    local_norm = global_norm(grads)
+    grad_norm = nr(local_norm)
+    applied_norm = grad_norm
+    if clip > 0:
+        grads = clip_by_global_norm(grads, clip, norm=cnr(local_norm))
+        applied_norm = nr(global_norm(grads))
+    return grads, overflow, grad_norm, applied_norm
+
+
+def loss_scale_epilogue(dstate, overflow, fp16, dynamic, scale_args):
+    """Dynamic-loss-scale update + step/skip counters (reference
+    stage2.py:1341-1362 overflow-skip semantics), shared by all steps."""
+    if fp16 and dynamic:
+        new_scale = update_loss_scale(dstate.loss_scale, overflow,
+                                      **scale_args)
+    else:
+        new_scale = dstate.loss_scale
+    return DeviceState(
+        loss_scale=new_scale,
+        global_step=dstate.global_step + 1,
+        skipped_steps=dstate.skipped_steps + overflow.astype(jnp.int32))
+
+
+def step_metrics(loss_sum, accum, grad_norm, applied_norm, lr, scale,
+                 overflow, loss_reduce=None):
+    loss = loss_sum / accum
+    if loss_reduce is not None:
+        loss = loss_reduce(loss)
+    return {
+        "loss": loss,
+        "grad_norm": grad_norm,
+        "applied_grad_norm": applied_norm,
+        "lr": lr,
+        "loss_scale": scale,
+        "overflow": overflow,
+    }
+
+
 def make_grad_accumulator(loss_fn, compute_dtype, accum):
     """Build ``accumulate(params, batch, rng, scale) -> (loss_sum, grads)``:
     scaled-loss value-and-grad over one microbatch, or a ``lax.scan`` over
@@ -171,15 +229,43 @@ class DeepSpeedEngine:
             jax.tree_util.tree_map(lambda _: PartitionSpec(), params)
         self._shardings = build_zero_shardings(
             params, base_specs, self.mesh, self.zero_optimization_stage())
-        # Copy (never alias) the caller's params: the compiled train step
-        # donates the engine's buffers, and donating the caller's arrays
-        # would delete them out from under the caller.
-        fp32 = jax.tree_util.tree_map(
-            lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params)
-        self.params = jax.device_put(fp32, self._shardings["param"])
-        self.opt_state = jax.jit(
-            self.opt_init_fn,
-            out_shardings=self._opt_state_shardings())(self.params)
+        self._offload = bool(self._config.zero_enabled and
+                             self._config.zero_config.cpu_offload)
+        if self._offload:
+            # ZeRO-Offload (reference stage2.py cpu_offload + csrc cpu_adam):
+            # fp32 masters + moments live in host RAM inside the C++
+            # DeepSpeedCPUAdam; the device holds compute-dtype params only,
+            # and the compiled step produces gradients, not updates.
+            assert self.optimizer_name in (ADAM_OPTIMIZER, "adamw"), (
+                f"cpu_offload supports adam/adamw, got {self.optimizer_name}")
+            assert jax.process_count() == 1, (
+                "cpu_offload fetches the full gradient to this host's RAM; "
+                "multi-process (multi-host) offload with per-process shards "
+                "is not implemented yet")
+            from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+            opt_params = dict(self._config.optimizer_params or {})
+            self.cpu_optimizer = DeepSpeedCPUAdam(
+                params,
+                lr=opt_params.get("lr", self._base_lr),
+                betas=self._betas,
+                eps=opt_params.get("eps", 1e-8),
+                weight_decay=opt_params.get("weight_decay", 0.0),
+                bias_correction=opt_params.get("bias_correction", True),
+                adamw_mode=opt_params.get("adam_w_mode",
+                                          self.optimizer_name == "adamw"))
+            self.params = self._upload_offload_params()
+            self.opt_state = None
+        else:
+            self.cpu_optimizer = None
+            # Copy (never alias) the caller's params: the compiled train
+            # step donates the engine's buffers, and donating the caller's
+            # arrays would delete them out from under the caller.
+            fp32 = jax.tree_util.tree_map(
+                lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params)
+            self.params = jax.device_put(fp32, self._shardings["param"])
+            self.opt_state = jax.jit(
+                self.opt_init_fn,
+                out_shardings=self._opt_state_shardings())(self.params)
         self.device_state = self._init_device_state()
 
         # --- data --------------------------------------------------------
@@ -505,18 +591,10 @@ class DeepSpeedEngine:
             # keep fp16 reductions in range; here the cross-replica mean is
             # computed by XLA in fp32, so they are accepted for config
             # compatibility but are intentionally no-ops.
-            denom = scale * accum
-            grads = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) / denom), grads)
-            if grad_shardings is not None:
-                grads = constrain_tree(grads, grad_shardings)
-
-            overflow = check_overflow(grads) if fp16 else jnp.asarray(False)
-            grad_norm = global_norm(grads)
-            applied_norm = grad_norm
-            if clip > 0:
-                grads = clip_by_global_norm(grads, clip, norm=grad_norm)
-                applied_norm = global_norm(grads)
+            grads, overflow, grad_norm, applied_norm = grad_epilogue(
+                grads, scale, accum, fp16, clip,
+                constrain=(lambda g: constrain_tree(g, grad_shardings))
+                if grad_shardings is not None else None)
 
             lr = lr_fn(dstate.global_step) if lr_fn is not None else lr_in
             beta1 = mom_fn(dstate.global_step)
@@ -533,30 +611,87 @@ class DeepSpeedEngine:
                 v=constrain_tree(select(opt_state.v, new_opt.v), opt_shardings),
                 step=jnp.where(overflow, opt_state.step, new_opt.step))
 
-            if fp16 and dynamic:
-                new_scale = update_loss_scale(dstate.loss_scale, overflow,
-                                              **scale_args)
-            else:
-                new_scale = dstate.loss_scale
-            dstate_out = DeviceState(
-                loss_scale=new_scale,
-                global_step=dstate.global_step + 1,
-                skipped_steps=dstate.skipped_steps +
-                overflow.astype(jnp.int32))
-            metrics = {
-                "loss": loss_sum / accum,
-                "grad_norm": grad_norm,
-                "applied_grad_norm": applied_norm,
-                "lr": lr,
-                "loss_scale": scale,
-                "overflow": overflow,
-            }
+            dstate_out = loss_scale_epilogue(dstate, overflow, fp16, dynamic,
+                                             scale_args)
+            metrics = step_metrics(loss_sum, accum, grad_norm, applied_norm,
+                                   lr, scale, overflow)
             return params_out, opt_out, dstate_out, metrics
 
         # Inputs arrive pre-placed (device_put with committed shardings);
         # outputs are pinned by the constrain_tree calls above, so plain jit
         # with donation suffices.
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def _upload_offload_params(self):
+        """Device copy of the host fp32 masters at compute dtype. Under
+        bf16 the conversion runs in the fused C++ kernel on one flat buffer
+        (the reference's fused fp16 copy-back, csrc/adam/cpu_adam.cpp)."""
+        opt = self.cpu_optimizer
+        if self.compute_dtype == jnp.bfloat16:
+            flat = opt.params_bf16_flat()
+            leaves = [flat[off:off + size].reshape(shape)
+                      for off, size, shape in zip(opt.offsets, opt.sizes,
+                                                  opt.shapes)]
+        else:
+            leaves = []
+            for off, size, shape in zip(opt.offsets, opt.sizes, opt.shapes):
+                view = opt.master[off:off + size].reshape(shape)
+                if self.compute_dtype != jnp.float32:
+                    view = view.astype(self.compute_dtype)
+                leaves.append(view)
+        tree = jax.tree_util.tree_unflatten(opt.treedef, leaves)
+        return jax.device_put(tree, self._shardings["param"])
+
+    def _make_offload_grad_step(self):
+        """Compiled gradient-only step for ZeRO-Offload: loss/grads/
+        overflow/clip/loss-scale on device, the optimizer update on the
+        host C++ Adam (reference stage2.py:1410-1423)."""
+        accum = self._engine_accum_steps()
+        fp16 = self._config.fp16_enabled
+        clip = float(self._config.gradient_clipping or 0.0)
+        lr_fn = self._lr_fn
+        mom_fn = self._mom_fn
+        loss_fn = self.loss_fn
+        scale_args = self._scale_args()
+        dynamic = self.dynamic_loss_scale
+        static_scale = self.static_loss_scale
+        accumulate = make_grad_accumulator(loss_fn, self.compute_dtype,
+                                           accum)
+
+        def grad_step(params, dstate, batch, rng, lr_in):
+            scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
+                else jnp.asarray(static_scale, jnp.float32)
+            loss_sum, grads = accumulate(params, batch, rng, scale)
+            # No ZeRO grad-sharding constraint here: the full gradient is
+            # about to be fetched to host RAM anyway (the partitioned-
+            # offload variant would fetch per-process shards; this engine
+            # scopes offload to single-process runs, asserted at init).
+            grads, overflow, grad_norm, applied_norm = grad_epilogue(
+                grads, scale, accum, fp16, clip)
+            lr = lr_fn(dstate.global_step) if lr_fn is not None else lr_in
+            beta1 = mom_fn(dstate.global_step)
+            dstate_out = loss_scale_epilogue(dstate, overflow, fp16, dynamic,
+                                             scale_args)
+            metrics = step_metrics(loss_sum, accum, grad_norm, applied_norm,
+                                   lr, scale, overflow)
+            metrics["beta1"] = beta1
+            return grads, dstate_out, metrics
+
+        return jax.jit(grad_step, donate_argnums=(1,))
+
+    def _train_batch_offload(self, placed, step_rng, lr_in):
+        """Host half of the offload step: pull grads, C++ Adam update on
+        the masters, push compute-dtype params back (the reference's
+        async_accumulate + CPUAdam.step + copy-back, stage2.py:793-1423)."""
+        grads, self.device_state, metrics = self._compiled_train_step(
+            self.params, self.device_state, placed, step_rng, lr_in)
+        if not bool(metrics["overflow"]):
+            host_grads = jax.tree_util.tree_map(
+                lambda g: np.asarray(g), grads)
+            self.cpu_optimizer.step(host_grads, lr=float(metrics["lr"]),
+                                    beta1=float(metrics["beta1"]))
+            self.params = self._upload_offload_params()
+        return metrics
 
     def _make_onebit_train_step(self):
         """Compiled 1-bit Adam step: shard_map over the ``data`` axis so
@@ -591,26 +726,16 @@ class DeepSpeedEngine:
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
             loss_sum, grads = accumulate(params, batch, rng, scale)
 
-            denom = scale * accum
-            grads = jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32) / denom, grads)
-
-            # Cross-shard overflow vote (reference stage2.py:1527-1551).
-            overflow = check_overflow(grads) if fp16 else jnp.asarray(False)
-            overflow = jax.lax.pmax(overflow.astype(jnp.int32), "data") > 0
-            # Local-shard grad norm, averaged — a scalar-only diagnostic
-            # (a true global norm would need the dense allreduce this
-            # optimizer exists to avoid).
-            grad_norm = jax.lax.pmean(global_norm(grads), "data")
-            applied_norm = grad_norm
-            if clip > 0:
-                # Clip by the *max* local norm so every shard scales its
-                # grads by the same factor (rank-consistent params), and
-                # conservatively: the max bounds the true global norm of
-                # the averaged gradient.
-                norm_max = jax.lax.pmax(global_norm(grads), "data")
-                grads = clip_by_global_norm(grads, clip, norm=norm_max)
-                applied_norm = jax.lax.pmean(global_norm(grads), "data")
+            # Cross-shard overflow vote (reference stage2.py:1527-1551);
+            # norms are pmean'd local-shard diagnostics (a true global norm
+            # would need the dense allreduce this optimizer avoids), and
+            # clipping scales by the pmax norm so every shard applies the
+            # same (conservative, rank-consistent) factor.
+            grads, overflow, grad_norm, applied_norm = grad_epilogue(
+                grads, scale, accum, fp16, clip,
+                vote=lambda o: jax.lax.pmax(o.astype(jnp.int32), "data") > 0,
+                norm_reduce=lambda n: jax.lax.pmean(n, "data"),
+                clip_norm_reduce=lambda n: jax.lax.pmax(n, "data"))
 
             lr = lr_fn(dstate.global_step) if lr_fn is not None else lr_in
             beta1 = mom_fn(dstate.global_step)
@@ -630,24 +755,11 @@ class DeepSpeedEngine:
                 server_error=select(opt_state.server_error,
                                     new_opt.server_error))
 
-            if fp16 and dynamic:
-                new_scale = update_loss_scale(dstate.loss_scale, overflow,
-                                              **scale_args)
-            else:
-                new_scale = dstate.loss_scale
-            dstate_out = DeviceState(
-                loss_scale=new_scale,
-                global_step=dstate.global_step + 1,
-                skipped_steps=dstate.skipped_steps +
-                overflow.astype(jnp.int32))
-            metrics = {
-                "loss": jax.lax.pmean(loss_sum / accum, "data"),
-                "grad_norm": grad_norm,
-                "applied_grad_norm": applied_norm,
-                "lr": lr,
-                "loss_scale": scale,
-                "overflow": overflow,
-            }
+            dstate_out = loss_scale_epilogue(dstate, overflow, fp16, dynamic,
+                                             scale_args)
+            metrics = step_metrics(
+                loss_sum, accum, grad_norm, applied_norm, lr, scale,
+                overflow, loss_reduce=lambda l: jax.lax.pmean(l, "data"))
             return params_out, opt_out, dstate_out, metrics
 
         P = PartitionSpec
@@ -712,7 +824,8 @@ class DeepSpeedEngine:
                 "no training_data given; pass a batch explicitly"
             batch = next(self._data_iter)
         if self._compiled_train_step is None:
-            self._compiled_train_step = self._make_train_step()
+            self._compiled_train_step = self._make_offload_grad_step() \
+                if self._offload else self._make_train_step()
 
         if self.wall_clock_breakdown():
             self.timers("train_batch").start()
@@ -720,10 +833,13 @@ class DeepSpeedEngine:
         placed = self._shard_batch(batch)
         self._rng, step_rng = jax.random.split(self._rng)
         lr_in = jnp.asarray(self._current_host_lr(), jnp.float32)
-        self.params, self.opt_state, self.device_state, metrics = \
-            self._compiled_train_step(self.params, self.opt_state,
-                                      self.device_state, placed, step_rng,
-                                      lr_in)
+        if self._offload:
+            metrics = self._train_batch_offload(placed, step_rng, lr_in)
+        else:
+            self.params, self.opt_state, self.device_state, metrics = \
+                self._compiled_train_step(self.params, self.opt_state,
+                                          self.device_state, placed,
+                                          step_rng, lr_in)
         self.tput_timer.stop()
         if self.wall_clock_breakdown():
             self.timers("train_batch").stop()
@@ -893,8 +1009,13 @@ class DeepSpeedEngine:
 
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
+        # Under cpu_offload the device params are a compute-dtype copy;
+        # checkpoint the fp32 host masters instead so no precision is lost
+        # (parity with the non-offload fp32 param save).
+        ckpt_params = self.cpu_optimizer.params() if self._offload \
+            else self.params
         state = {
-            "params": self.params,
+            "params": ckpt_params,
             "opt_state": self._opt_state_to_tree(),
             "device_state": {
                 "cur_scale": self.device_state.loss_scale.cur_scale,
@@ -928,6 +1049,9 @@ class DeepSpeedEngine:
         return True
 
     def _opt_state_to_tree(self):
+        if self._offload:
+            # Host C++ optimizer owns masters + moments (flat fp32).
+            return dict(self.cpu_optimizer.state_dict())
         s = self.opt_state
         tree = {"m": s.m, "v": s.v, "step": s.step}
         if hasattr(s, "worker_error"):
@@ -977,15 +1101,30 @@ class DeepSpeedEngine:
         # Re-place on the *current* mesh/shardings: the elastic-checkpoint
         # capability (reference stage1.py:1030 re-partitions for a new dp
         # world size) comes for free from resharding on load.
-        self.params = jax.device_put(
-            jax.tree_util.tree_map(jnp.asarray, restored["params"]),
-            self._shardings["param"])
-        if load_optimizer_states:
-            opt_tree = jax.tree_util.tree_map(jnp.asarray,
-                                              restored["opt_state"])
-            self.opt_state = jax.device_put(
-                self._opt_state_from_tree(opt_tree, self.opt_state),
-                self._opt_state_shardings())
+        if self._offload:
+            if load_optimizer_states:
+                self.cpu_optimizer.load_state_dict(
+                    jax.tree_util.tree_map(np.asarray,
+                                           restored["opt_state"]))
+            else:
+                # Reseed the masters from the checkpointed params only.
+                flat_leaves = jax.tree_util.tree_leaves(restored["params"])
+                opt = self.cpu_optimizer
+                for leaf, off, size in zip(flat_leaves, opt.offsets,
+                                           opt.sizes):
+                    opt.master[off:off + size] = np.asarray(
+                        leaf, np.float32).reshape(-1)
+            self.params = self._upload_offload_params()
+        else:
+            self.params = jax.device_put(
+                jax.tree_util.tree_map(jnp.asarray, restored["params"]),
+                self._shardings["param"])
+            if load_optimizer_states:
+                opt_tree = jax.tree_util.tree_map(jnp.asarray,
+                                                  restored["opt_state"])
+                self.opt_state = jax.device_put(
+                    self._opt_state_from_tree(opt_tree, self.opt_state),
+                    self._opt_state_shardings())
         ds = restored["device_state"]
         self.device_state = jax.device_put(
             DeviceState(
