@@ -199,6 +199,12 @@ void ds_fp32_to_bf16(const float* src, uint16_t* dst, int64_t n) {
   for (int64_t i = 0; i < n; ++i) {
     uint32_t bits;
     std::memcpy(&bits, &src[i], sizeof(bits));
+    if ((bits & 0x7fffffffu) > 0x7f800000u) {
+      // NaN: rounding carry would overflow the exponent (NaN -> Inf/-0);
+      // keep a quiet NaN with the sign preserved.
+      dst[i] = static_cast<uint16_t>((bits >> 16) | 0x0040u);
+      continue;
+    }
     uint32_t lsb = (bits >> 16) & 1u;
     bits += 0x7fffu + lsb;  // round to nearest even
     dst[i] = static_cast<uint16_t>(bits >> 16);

@@ -89,7 +89,10 @@ class OpBuilder:
                    [str(s) for s in self.sources()] + ["-o", str(out)])
             if verbose:
                 logger.info(f"building op {self.NAME}: {' '.join(cmd)}")
-            tmp = out.with_suffix(".so.tmp")
+            # Per-process tmp name: concurrent builders (pytest workers,
+            # launcher ranks) must not interleave writes before the atomic
+            # rename.
+            tmp = out.with_suffix(f".so.tmp.{os.getpid()}")
             try:
                 subprocess.run(cmd[:-1] + [str(tmp)], check=True,
                                capture_output=True, text=True)

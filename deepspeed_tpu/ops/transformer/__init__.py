@@ -1,0 +1,6 @@
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer,
+    init_transformer_layer)
+
+__all__ = ["DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer",
+           "init_transformer_layer"]

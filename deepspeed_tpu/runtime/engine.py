@@ -632,14 +632,11 @@ class DeepSpeedEngine:
             leaves = [flat[off:off + size].reshape(shape)
                       for off, size, shape in zip(opt.offsets, opt.sizes,
                                                   opt.shapes)]
+            tree = jax.tree_util.tree_unflatten(opt.treedef, leaves)
         else:
-            leaves = []
-            for off, size, shape in zip(opt.offsets, opt.sizes, opt.shapes):
-                view = opt.master[off:off + size].reshape(shape)
-                if self.compute_dtype != jnp.float32:
-                    view = view.astype(self.compute_dtype)
-                leaves.append(view)
-        tree = jax.tree_util.tree_unflatten(opt.treedef, leaves)
+            tree = jax.tree_util.tree_map(
+                lambda v: v if self.compute_dtype == jnp.float32
+                else v.astype(self.compute_dtype), opt.params())
         return jax.device_put(tree, self._shardings["param"])
 
     def _make_offload_grad_step(self):
@@ -1050,8 +1047,12 @@ class DeepSpeedEngine:
 
     def _opt_state_to_tree(self):
         if self._offload:
-            # Host C++ optimizer owns masters + moments (flat fp32).
-            return dict(self.cpu_optimizer.state_dict())
+            # Moments + counter only: the masters are already the
+            # checkpoint's "params" entry (saving both would double the
+            # parameter bytes on disk).
+            state = self.cpu_optimizer.state_dict()
+            state.pop("master")
+            return state
         s = self.opt_state
         tree = {"m": s.m, "v": s.v, "step": s.step}
         if hasattr(s, "worker_error"):
@@ -1102,18 +1103,20 @@ class DeepSpeedEngine:
         # capability (reference stage1.py:1030 re-partitions for a new dp
         # world size) comes for free from resharding on load.
         if self._offload:
+            # Masters come from the checkpoint's fp32 "params" entry;
+            # the opt_state tree carries moments + step only.
+            opt = self.cpu_optimizer
+            flat_leaves = jax.tree_util.tree_leaves(restored["params"])
+            for leaf, off, size in zip(flat_leaves, opt.offsets, opt.sizes):
+                opt.master[off:off + size] = np.asarray(
+                    leaf, np.float32).reshape(-1)
             if load_optimizer_states:
-                self.cpu_optimizer.load_state_dict(
-                    jax.tree_util.tree_map(np.asarray,
-                                           restored["opt_state"]))
-            else:
-                # Reseed the masters from the checkpointed params only.
-                flat_leaves = jax.tree_util.tree_leaves(restored["params"])
-                opt = self.cpu_optimizer
-                for leaf, off, size in zip(flat_leaves, opt.offsets,
-                                           opt.sizes):
-                    opt.master[off:off + size] = np.asarray(
-                        leaf, np.float32).reshape(-1)
+                saved = restored["opt_state"]
+                opt.exp_avg[:] = np.asarray(saved["exp_avg"],
+                                            np.float32).reshape(-1)
+                opt.exp_avg_sq[:] = np.asarray(saved["exp_avg_sq"],
+                                               np.float32).reshape(-1)
+                opt._step = int(saved["step"])
             self.params = self._upload_offload_params()
         else:
             self.params = jax.device_put(
