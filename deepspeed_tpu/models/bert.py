@@ -1,0 +1,185 @@
+"""BERT model family, TPU-first, built on DeepSpeedTransformerLayer.
+
+The reference's flagship workload is BERT pretraining with the fused CUDA
+transformer kernel (`docs/_tutorials/bert-pretraining.md`; in-repo fixtures
+`tests/unit/modeling.py:1578` / `modelingpreln.py:1673` are the post-LN and
+pre-LN HF-style variants). This module is the equivalent in-framework
+model: embeddings + N fused blocks + MLM head, post-LN (classic BERT) or
+pre-LN, bf16-ready, with tensor-parallel PartitionSpecs over the ``model``
+mesh axis.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from flax.traverse_util import flatten_dict, unflatten_dict
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.transformer import (
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    initializer_range: float = 0.02
+    pre_layer_norm: bool = False      # classic BERT is post-LN
+    dtype: Any = jnp.float32
+    use_flash_attention: bool = False
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_large(**kw):
+    kw.setdefault("hidden_size", 1024)
+    kw.setdefault("num_hidden_layers", 24)
+    kw.setdefault("num_attention_heads", 16)
+    kw.setdefault("intermediate_size", 4096)
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw):
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    return BertConfig(**kw)
+
+
+def _ds_layer_config(cfg: BertConfig) -> DeepSpeedTransformerConfig:
+    return DeepSpeedTransformerConfig(
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        heads=cfg.num_attention_heads,
+        attn_dropout_ratio=cfg.attention_probs_dropout_prob,
+        hidden_dropout_ratio=cfg.hidden_dropout_prob,
+        num_hidden_layers=cfg.num_hidden_layers,
+        initializer_range=cfg.initializer_range,
+        pre_layer_norm=cfg.pre_layer_norm,
+        fp16=cfg.dtype == jnp.float16)
+
+
+class BertEmbeddings(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        word = self.param("word_embeddings",
+                          nn.initializers.normal(cfg.initializer_range),
+                          (cfg.vocab_size, cfg.hidden_size))
+        pos = self.param("position_embeddings",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.max_position_embeddings, cfg.hidden_size))
+        tok = self.param("token_type_embeddings",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.type_vocab_size, cfg.hidden_size))
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = word[input_ids] + pos[None, :T] + tok[token_type_ids]
+        x = nn.LayerNorm(epsilon=1e-12, name="LayerNorm")(x)
+        x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic)
+        return x.astype(cfg.dtype)
+
+
+class BertModel(nn.Module):
+    """Embeddings + encoder stack of DeepSpeedTransformerLayers."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        x = BertEmbeddings(cfg, name="embeddings")(
+            input_ids, token_type_ids, deterministic)
+        additive_mask = None
+        if attention_mask is not None:
+            additive_mask = jnp.where(
+                attention_mask.astype(bool), 0.0, -10000.0
+            )[:, None, None, :].astype(jnp.float32)
+        ds_cfg = _ds_layer_config(cfg)
+        for i in range(cfg.num_hidden_layers):
+            x = DeepSpeedTransformerLayer(
+                ds_cfg, use_flash_attention=cfg.use_flash_attention,
+                name=f"layer_{i}")(x, additive_mask, deterministic)
+        return x
+
+
+class BertForMaskedLM(nn.Module):
+    """MLM head over the encoder (BERT-pretraining objective — the
+    reference's bert-pretraining workload)."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        x = BertModel(cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="transform")(x)
+        x = jax.nn.gelu(x, approximate=False)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype,
+                         name="transform_ln")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, name="decoder")(x)
+        return logits
+
+
+def make_bert_mlm_loss_fn(model: BertForMaskedLM):
+    """loss_fn(params, batch, rng): batch has input_ids [B,T], labels [B,T]
+    with -100 at unmasked positions, optional attention_mask [B,T]."""
+    from deepspeed_tpu.models.gpt2 import cross_entropy_loss
+
+    def loss_fn(params, batch, rng=None):
+        rngs = {"dropout": rng} if rng is not None else {}
+        logits = model.apply(
+            {"params": params}, batch["input_ids"],
+            batch.get("attention_mask"), batch.get("token_type_ids"),
+            deterministic=rng is None, rngs=rngs)
+        return cross_entropy_loss(logits, batch["labels"])
+
+    return loss_fn
+
+
+def init_bert_params(model, rng, batch_size=2, seq_len=16):
+    dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
+    return model.init({"params": rng, "dropout": rng}, dummy)["params"]
+
+
+def bert_partition_specs(params, model_axis="model"):
+    """Megatron-style TP specs over the ``model`` axis: QKV/intermediate
+    column-parallel, output projections row-parallel, embeddings
+    vocab-sharded."""
+    flat = flatten_dict(params)
+    specs = {}
+    for path, leaf in flat.items():
+        name = "/".join(str(p) for p in path)
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim <= 1:
+            specs[path] = P()
+        elif name.endswith("word_embeddings"):
+            specs[path] = P(model_axis, None)
+        elif "attn_qkvw" in name or "inter_w" in name:
+            specs[path] = P(None, model_axis)
+        elif "attn_ow" in name or "output_w" in name:
+            specs[path] = P(model_axis, None)
+        elif "decoder" in name and name.endswith("kernel"):
+            specs[path] = P(None, model_axis)
+        else:
+            specs[path] = P()
+    return unflatten_dict(specs)

@@ -1,0 +1,103 @@
+"""BERT family tests: MLM training end-to-end through the engine (the
+reference's bert-pretraining workload in miniature), masking semantics,
+and tensor-parallel spec coverage."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.bert import (
+    BertForMaskedLM, bert_partition_specs, bert_tiny, init_bert_params,
+    make_bert_mlm_loss_fn)
+
+
+def _mlm_batch(rng, B=8, T=32, vocab=256, mask_frac=0.15):
+    ids = rng.integers(5, vocab, (B, T)).astype(np.int32)
+    labels = np.full((B, T), -100, np.int32)
+    mask = rng.random((B, T)) < mask_frac
+    labels[mask] = ids[mask]
+    ids[mask] = 3   # [MASK]
+    return {"input_ids": ids, "labels": labels,
+            "attention_mask": np.ones((B, T), np.int32)}
+
+
+def test_bert_forward_shapes():
+    cfg = bert_tiny()
+    model = BertForMaskedLM(cfg)
+    params = init_bert_params(model, jax.random.PRNGKey(0))
+    logits = model.apply({"params": params},
+                         jnp.zeros((2, 16), jnp.int32))
+    assert logits.shape == (2, 16, cfg.vocab_size)
+
+
+def test_bert_attention_mask_matters():
+    """Padding tokens must not influence unpadded positions."""
+    cfg = bert_tiny()
+    model = BertForMaskedLM(cfg)
+    params = init_bert_params(model, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(5, 250, (1, 16)).astype(np.int32)
+    mask = np.ones((1, 16), np.int32)
+    mask[0, 8:] = 0
+    out1 = model.apply({"params": params}, jnp.asarray(ids),
+                       jnp.asarray(mask))
+    ids2 = ids.copy()
+    ids2[0, 8:] = rng.integers(5, 250, 8)   # change only padded tokens
+    out2 = model.apply({"params": params}, jnp.asarray(ids2),
+                       jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(out1[0, :8]),
+                               np.asarray(out2[0, :8]), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_bert_mlm_trains_through_engine():
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+    }
+    model = BertForMaskedLM(bert_tiny(dtype=jnp.bfloat16))
+    params = init_bert_params(model, jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, loss_fn=make_bert_mlm_loss_fn(model), params=params)
+    rng = np.random.default_rng(1)
+    fixed = _mlm_batch(rng)
+    losses = [float(engine.train_batch(fixed)) for _ in range(10)]
+    assert losses[-1] < losses[0], f"BERT MLM loss not decreasing: {losses}"
+
+
+def test_bert_partition_specs_cover_params():
+    from jax.sharding import PartitionSpec as P
+    model = BertForMaskedLM(bert_tiny())
+    params = init_bert_params(model, jax.random.PRNGKey(0))
+    specs = bert_partition_specs(params)
+    flat_p = jax.tree_util.tree_leaves(params)
+    flat_s = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    assert len(flat_p) == len(flat_s)
+    sharded = [s for s in flat_s if any(a is not None for a in s)]
+    assert len(sharded) >= 4 * 2 + 1   # qkv/inter/ow/out per layer + embed
+
+
+def test_bert_tp_runs_on_mesh():
+    """bert + TP specs compile and run under a model-parallel mesh and
+    match the single-device forward."""
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from jax.sharding import NamedSharding
+    model = BertForMaskedLM(bert_tiny())
+    params = init_bert_params(model, jax.random.PRNGKey(0))
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(5, 250, (4, 16)), jnp.int32)
+    ref = model.apply({"params": params}, ids)
+
+    mesh = build_mesh({"model": 2, "data": 4})
+    specs = bert_partition_specs(params)
+    sharded = jax.device_put(
+        params, jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)))
+    out = jax.jit(lambda p, i: model.apply({"params": p}, i))(sharded, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
