@@ -1,0 +1,3 @@
+from deepspeed_tpu.launcher.runner import (
+    fetch_hostfile, parse_inclusion_exclusion, encode_world_info,
+    decode_world_info)

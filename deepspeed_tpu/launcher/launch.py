@@ -1,0 +1,80 @@
+"""Per-node spawner: one worker process per TPU host.
+
+Analog of the reference's ``launcher/launch.py:23-132`` (the
+torch.distributed.launch-alike that spawns one process per GPU with
+RANK/LOCAL_RANK env). On TPU, JAX owns every chip on the host, so this
+spawns exactly ONE user process per node and provides the
+``jax.distributed`` rendezvous env instead:
+
+  DS_TPU_COORDINATOR  host:port of process 0
+  DS_TPU_NUM_PROCESSES  total hosts
+  DS_TPU_PROCESS_ID     this host's index
+  RANK / WORLD_SIZE     kept for user-script compatibility
+
+`deepspeed_tpu.parallel.mesh.initialize_distributed` consumes these.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.runner import decode_world_info
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", type=str, default=None,
+                        help="base64 {host: [slots]} (multi-node)")
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--nnodes", type=int, default=-1)
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def build_env(args):
+    env = dict(os.environ)
+    if args.world_info:
+        world = decode_world_info(args.world_info)
+        nnodes = len(world)
+    else:
+        nnodes = max(args.nnodes, 1)
+    env["DS_TPU_COORDINATOR"] = f"{args.master_addr}:{args.master_port}"
+    env["DS_TPU_NUM_PROCESSES"] = str(nnodes)
+    env["DS_TPU_PROCESS_ID"] = str(args.node_rank)
+    # Compatibility names (one process per host ⇒ rank == node_rank).
+    env["RANK"] = str(args.node_rank)
+    env["LOCAL_RANK"] = "0"
+    env["WORLD_SIZE"] = str(nnodes)
+    env["MASTER_ADDR"] = args.master_addr
+    env["MASTER_PORT"] = str(args.master_port)
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    env = build_env(args)
+    cmd = [sys.executable, "-u", args.user_script] + list(args.user_args)
+    logger.info(f"launch node_rank={args.node_rank}: {' '.join(cmd)}")
+    process = subprocess.Popen(cmd, env=env)
+
+    def forward_signal(signum, frame):
+        process.send_signal(signum)
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, forward_signal)
+        except ValueError:
+            pass  # not in main thread (tests)
+    process.wait()
+    return process.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

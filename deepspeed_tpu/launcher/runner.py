@@ -1,0 +1,217 @@
+"""deepspeed_tpu launcher: TPU-pod job runner.
+
+Analog of the reference launcher (`launcher/runner.py` — hostfile parsing
+:115, include/exclude filtering, world-info encoding; `bin/deepspeed`).
+Differences forced by the platform: a TPU host runs ONE process that owns
+all its local chips (JAX's process model), so "slots" count chips per host
+for accounting/filtering but spawning is per-host, and the rendezvous is
+``jax.distributed.initialize(coordinator_address, num_processes,
+process_id)`` rather than MASTER_ADDR/RANK env rendezvous.
+"""
+
+import argparse
+import base64
+import collections
+import json
+import os
+import shlex
+import subprocess
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "JAX_", "XLA_", "TPU_", "LIBTPU_"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+DEFAULT_COORDINATOR_PORT = 29500
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher (reference launcher/runner.py)")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="MPI-style hostfile: '<host> slots=<n>' lines")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="NODE[:SLOT,SLOT]@NODE... inclusion filter")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="NODE[:SLOT,SLOT]@NODE... exclusion filter")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="limit to first N nodes")
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1,
+                        dest="num_gpus",
+                        help="limit chips per node (slot count)")
+    parser.add_argument("--master_addr", type=str, default="",
+                        help="coordinator address (default: first host)")
+    parser.add_argument("--master_port", type=int,
+                        default=DEFAULT_COORDINATOR_PORT)
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "pdsh", "gcloud"],
+                        help="multi-node transport")
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str,
+                        help="training script to launch")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """Parse an MPI-style hostfile into an ordered {host: slots} dict
+    (reference ``fetch_hostfile``, runner.py:115)."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning(f"Unable to find hostfile at {hostfile_path}")
+        return None
+    resource_pool = collections.OrderedDict()
+    with open(hostfile_path, "r") as fd:
+        for line in fd:
+            line = line.strip()
+            if line == "" or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError:
+                raise ValueError(
+                    f"Hostfile is not formatted correctly, got line: "
+                    f"{line!r} (expected '<host> slots=<n>')")
+            if hostname in resource_pool:
+                raise ValueError(
+                    f"Hostfile contains duplicate hosts: {hostname}")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _parse_filter(filter_str):
+    """'host1:0,2@host2' → {host1: [0, 2], host2: []}"""
+    mapping = collections.OrderedDict()
+    if not filter_str:
+        return mapping
+    for term in filter_str.split("@"):
+        term = term.strip()
+        if ":" in term:
+            host, slots = term.split(":")
+            mapping[host] = [int(s) for s in slots.split(",")]
+        else:
+            mapping[term] = []
+    return mapping
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    """Apply --include/--exclude NODE:SLOT filters (reference
+    runner.py:120-250 semantics): include and exclude are mutually
+    exclusive; bare NODE means every slot on it."""
+    active = collections.OrderedDict(
+        (host, list(range(slots))) for host, slots in resource_pool.items())
+    inc = _parse_filter(inclusion)
+    exc = _parse_filter(exclusion)
+    if inc and exc:
+        raise ValueError("--include and --exclude are mutually exclusive")
+
+    if inc:
+        filtered = collections.OrderedDict()
+        for host, slots in inc.items():
+            if host not in active:
+                raise ValueError(f"include host {host} not in hostfile")
+            bad = [s for s in slots if s not in active[host]]
+            if bad:
+                raise ValueError(f"include slots {bad} not on host {host}")
+            filtered[host] = slots if slots else active[host]
+        return filtered
+
+    for host, slots in exc.items():
+        if host not in active:
+            raise ValueError(f"exclude host {host} not in hostfile")
+        if not slots:
+            del active[host]
+        else:
+            bad = [s for s in slots if s not in active[host]]
+            if bad:
+                raise ValueError(f"exclude slots {bad} not on host {host}")
+            active[host] = [s for s in active[host] if s not in slots]
+            if not active[host]:
+                del active[host]
+    return active
+
+
+def encode_world_info(active_resources):
+    """base64(json({host: [slots]})) — the reference's world_info wire
+    format (runner.py / launch.py)."""
+    world_info = json.dumps(
+        {host: slots for host, slots in active_resources.items()})
+    return base64.urlsafe_b64encode(world_info.encode()).decode()
+
+
+def decode_world_info(encoded):
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def load_deepspeed_env(base_dir=None):
+    """Read ``.deepspeed_env`` (KEY=VALUE lines) for propagation to remote
+    hosts (reference runner.py:26-30)."""
+    candidates = [base_dir or os.getcwd(), os.path.expanduser("~")]
+    env = collections.OrderedDict()
+    for d in candidates:
+        path = os.path.join(d, DEEPSPEED_ENVIRONMENT_NAME)
+        if os.path.isfile(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and "=" in line and not line.startswith("#"):
+                        key, val = line.split("=", 1)
+                        env[key] = val
+            break
+    return env
+
+
+def apply_node_limits(resource_pool, num_nodes, num_slots):
+    """--num_nodes/--num_gpus truncation (reference runner.py)."""
+    pool = collections.OrderedDict(resource_pool)
+    if num_nodes > 0:
+        pool = collections.OrderedDict(list(pool.items())[:num_nodes])
+    if num_slots > 0:
+        pool = collections.OrderedDict(
+            (h, min(s, num_slots)) for h, s in pool.items())
+    return pool
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if resource_pool is None:
+        # Single node, all local chips: exec in-process launcher.
+        from deepspeed_tpu.launcher import launch
+        cmd_args = ["--node_rank", "0", "--nnodes", "1"]
+        if args.master_addr:
+            cmd_args += ["--master_addr", args.master_addr]
+        cmd_args += ["--master_port", str(args.master_port),
+                     args.user_script] + args.user_args
+        return launch.main(cmd_args)
+
+    resource_pool = apply_node_limits(resource_pool, args.num_nodes,
+                                      args.num_gpus)
+    active = parse_inclusion_exclusion(resource_pool, args.include,
+                                       args.exclude)
+    if not active:
+        raise ValueError("no resources left after include/exclude filters")
+    master_addr = args.master_addr or next(iter(active))
+
+    from deepspeed_tpu.launcher.multinode_runner import (
+        GCloudRunner, PDSHRunner, SSHRunner)
+    runner_cls = {"ssh": SSHRunner, "pdsh": PDSHRunner,
+                  "gcloud": GCloudRunner}[args.launcher]
+    runner = runner_cls(args, world_info=encode_world_info(active),
+                        master_addr=master_addr,
+                        master_port=args.master_port)
+    env = dict(os.environ)
+    env.update(load_deepspeed_env())
+    cmd = runner.get_cmd(env, active)
+    logger.info(f"launcher cmd: {' '.join(map(shlex.quote, cmd))}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    return result.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

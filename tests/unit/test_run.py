@@ -1,0 +1,154 @@
+"""Launcher tests — analog of the reference's `tests/unit/test_run.py`
+(108 LoC: pure parsing, no processes): hostfile parsing, include/exclude
+filters, world-info encoding, runner command construction, env report."""
+
+import io
+import os
+
+import pytest
+
+from deepspeed_tpu.launcher import launch, multinode_runner, runner
+
+
+def _write(tmp_path, text, name="hostfile"):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_fetch_hostfile(tmp_path):
+    path = _write(tmp_path, """
+worker-0 slots=4
+worker-1 slots=8
+
+# comment
+worker-2 slots=2
+""")
+    pool = runner.fetch_hostfile(path)
+    assert list(pool.items()) == [("worker-0", 4), ("worker-1", 8),
+                                  ("worker-2", 2)]
+
+
+def test_fetch_hostfile_bad_lines(tmp_path):
+    with pytest.raises(ValueError):
+        runner.fetch_hostfile(_write(tmp_path, "worker-0 slots=four\n"))
+    with pytest.raises(ValueError):
+        runner.fetch_hostfile(_write(tmp_path, "worker-0\n"))
+    with pytest.raises(ValueError):
+        runner.fetch_hostfile(
+            _write(tmp_path, "worker-0 slots=2\nworker-0 slots=2\n"))
+    assert runner.fetch_hostfile(str(tmp_path / "missing")) is None
+
+
+POOL = {"worker-0": 4, "worker-1": 4, "worker-2": 4}
+
+
+def test_include_filters():
+    got = runner.parse_inclusion_exclusion(POOL, "worker-0@worker-2:1,3", "")
+    assert got == {"worker-0": [0, 1, 2, 3], "worker-2": [1, 3]}
+    with pytest.raises(ValueError):
+        runner.parse_inclusion_exclusion(POOL, "worker-9", "")
+    with pytest.raises(ValueError):
+        runner.parse_inclusion_exclusion(POOL, "worker-0:7", "")
+
+
+def test_exclude_filters():
+    got = runner.parse_inclusion_exclusion(POOL, "", "worker-1")
+    assert list(got) == ["worker-0", "worker-2"]
+    got = runner.parse_inclusion_exclusion(POOL, "", "worker-0:0,1")
+    assert got["worker-0"] == [2, 3]
+    # excluding every slot removes the host
+    got = runner.parse_inclusion_exclusion(POOL, "", "worker-0:0,1,2,3")
+    assert "worker-0" not in got
+    with pytest.raises(ValueError):
+        runner.parse_inclusion_exclusion(POOL, "worker-0", "worker-1")
+
+
+def test_no_filters_passthrough():
+    got = runner.parse_inclusion_exclusion(POOL, "", "")
+    assert got == {h: [0, 1, 2, 3] for h in POOL}
+
+
+def test_world_info_roundtrip():
+    active = {"a": [0, 1], "b": [0]}
+    assert runner.decode_world_info(runner.encode_world_info(active)) == \
+        active
+
+
+def test_apply_node_limits():
+    pool = runner.apply_node_limits(POOL, num_nodes=2, num_slots=2)
+    assert pool == {"worker-0": 2, "worker-1": 2}
+    assert runner.apply_node_limits(POOL, -1, -1) == POOL
+
+
+def test_deepspeed_env_propagation(tmp_path, monkeypatch):
+    (tmp_path / runner.DEEPSPEED_ENVIRONMENT_NAME).write_text(
+        "JAX_TRACEBACK=off\nMY_VAR=1\n# comment\n")
+    env = runner.load_deepspeed_env(str(tmp_path))
+    assert env == {"JAX_TRACEBACK": "off", "MY_VAR": "1"}
+
+
+def test_launch_env_construction():
+    args = launch.parse_args([
+        "--node_rank", "2", "--nnodes", "4", "--master_addr", "10.0.0.1",
+        "--master_port", "29501", "train.py", "--lr", "0.1"])
+    env = launch.build_env(args)
+    assert env["DS_TPU_COORDINATOR"] == "10.0.0.1:29501"
+    assert env["DS_TPU_NUM_PROCESSES"] == "4"
+    assert env["DS_TPU_PROCESS_ID"] == "2"
+    assert env["RANK"] == "2" and env["WORLD_SIZE"] == "4"
+    assert args.user_args == ["--lr", "0.1"]
+
+
+def _runner_args(extra=()):
+    return runner.parse_args(list(extra) + ["train.py", "--foo", "1"])
+
+
+def test_ssh_runner_cmds():
+    args = _runner_args()
+    active = {"h0": [0, 1], "h1": [0, 1]}
+    r = multinode_runner.SSHRunner(args, runner.encode_world_info(active),
+                                   "h0", 29500)
+    cmds = r.get_all_cmds({"PYTHONPATH": "/x", "SECRET": "no"}, active)
+    assert len(cmds) == 2
+    assert cmds[0][0] == "ssh" and "h0" in cmds[0]
+    joined = " ".join(cmds[1])
+    assert "--node_rank=1" in joined
+    assert "PYTHONPATH" in joined and "SECRET" not in joined
+    assert "train.py" in joined and "--foo" in joined
+
+
+def test_pdsh_runner_cmd():
+    args = _runner_args()
+    active = {"h0": [0], "h1": [0]}
+    r = multinode_runner.PDSHRunner(args, runner.encode_world_info(active),
+                                    "h0", 29500)
+    cmd = r.get_cmd({}, active)
+    assert cmd[0] == "pdsh"
+    assert "h0,h1" in cmd
+    assert "%n" in " ".join(cmd)   # pdsh node-rank expansion
+
+
+def test_gcloud_runner_cmd(monkeypatch):
+    monkeypatch.setenv("TPU_NAME", "my-pod")
+    monkeypatch.setenv("TPU_ZONE", "us-central2-b")
+    args = _runner_args()
+    active = {"t0": [0]}
+    r = multinode_runner.GCloudRunner(
+        args, runner.encode_world_info(active), "t0", 29500)
+    cmd = r.get_cmd({}, active)
+    joined = " ".join(cmd)
+    assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh"]
+    assert "my-pod" in cmd and "--worker=all" in cmd
+    assert "--zone=us-central2-b" in cmd
+    assert "$TPU_WORKER_ID" in joined
+
+
+def test_env_report_smoke():
+    from deepspeed_tpu import env_report
+    buf = io.StringIO()
+    rows = env_report.op_report(out=buf)
+    assert {name for name, *_ in rows} >= {"cpu_adam", "utils"}
+    env_report.debug_report(out=buf)
+    text = buf.getvalue()
+    assert "cpu_adam" in text and "jax version" in text
