@@ -36,7 +36,10 @@ def main():
     else:  # CPU smoke mode
         cfg_fn, batch_size, seq_len, steps = gpt2_125m, 2, 128, 2
 
-    cfg = cfg_fn(n_positions=seq_len, remat=on_tpu)
+    # 125M @ bs8/seq1024 fits HBM without remat; flash attention keeps the
+    # attention working set in VMEM (Pallas kernel on TPU).
+    cfg = cfg_fn(n_positions=seq_len, remat=False,
+                 use_flash_attention=on_tpu)
     model = GPT2LMHead(cfg)
     params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
     loss_fn = make_gpt2_loss_fn(model)
@@ -62,10 +65,11 @@ def main():
     # Prefer XLA's own FLOP count for the compiled step when available.
     xla_flops = None
     try:
+        import jax.numpy as jnp
         ca = engine._compiled_train_step.lower(
             engine.params, engine.opt_state, engine.device_state,
-            engine._shard_batch(batch),
-            jax.random.PRNGKey(1)).compile().cost_analysis()
+            engine._shard_batch(batch), jax.random.PRNGKey(1),
+            jnp.asarray(1e-4, jnp.float32)).compile().cost_analysis()
         xla_flops = ca.get("flops")
     except Exception:
         pass

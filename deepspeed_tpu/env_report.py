@@ -15,7 +15,6 @@ NO = f"{RED}[NO]{END}"
 
 def op_report(out=sys.stdout):
     from deepspeed_tpu.ops.op_builder import ALL_OPS
-    max_dots = 23
     print("-" * 64, file=out)
     print("deepspeed_tpu native op report", file=out)
     print("-" * 64, file=out)
@@ -77,10 +76,6 @@ def debug_report(out=sys.stdout):
 def main(out=sys.stdout):
     op_report(out=out)
     debug_report(out=out)
-
-
-def cli_main():
-    main()
 
 
 if __name__ == "__main__":

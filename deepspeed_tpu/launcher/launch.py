@@ -15,7 +15,6 @@ spawns exactly ONE user process per node and provides the
 """
 
 import argparse
-import json
 import os
 import signal
 import subprocess

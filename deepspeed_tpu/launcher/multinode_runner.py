@@ -22,13 +22,16 @@ class MultiNodeRunner:
         self.master_port = master_port
         self.user_script = args.user_script
         self.user_args = list(args.user_args)
+        self.ds_env = {}   # .deepspeed_env vars, set by runner.main
 
     def exports(self, env):
-        """Env vars worth forwarding to remote shells (reference
-        EXPORT_ENVS + .deepspeed_env propagation)."""
+        """Env vars worth forwarding to remote shells: the EXPORT_ENVS
+        prefix allowlist plus every .deepspeed_env key (reference
+        runner.py:26-30 propagates the user's file verbatim)."""
         out = {}
         for key, val in env.items():
-            if any(key == e or key.startswith(e) for e in EXPORT_ENVS):
+            if key in self.ds_env or \
+                    any(key == e or key.startswith(e) for e in EXPORT_ENVS):
                 out[key] = val
         return out
 
@@ -83,7 +86,8 @@ class PDSHRunner(MultiNodeRunner):
         return which("pdsh") is not None
 
     def get_cmd(self, env, active_resources):
-        env = dict(env)
+        # Mutates the caller's env: Popen must see PDSH_RCMD_TYPE or pdsh
+        # falls back to its compiled default (rsh).
         env["PDSH_RCMD_TYPE"] = "ssh"
         hosts = ",".join(active_resources.keys())
         exports = " ".join(f"export {k}={shlex.quote(v)};"
@@ -113,9 +117,16 @@ class GCloudRunner(MultiNodeRunner):
     def get_cmd(self, env, active_resources):
         exports = " ".join(f"export {k}={shlex.quote(v)};"
                            for k, v in self.exports(env).items())
-        # On each worker the agent env provides its index.
-        worker = " ".join(map(shlex.quote, self._worker_cmd(
-            "$TPU_WORKER_ID")))
+        # On each worker the agent env provides its index. The node-rank
+        # token must stay double-quoted (NOT shlex-quoted) so the remote
+        # shell expands $TPU_WORKER_ID.
+        parts = []
+        for tok in self._worker_cmd("$TPU_WORKER_ID"):
+            if "$TPU_WORKER_ID" in tok:
+                parts.append('"--node_rank=$TPU_WORKER_ID"')
+            else:
+                parts.append(shlex.quote(tok))
+        worker = " ".join(parts)
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", self.tpu_name,
                "--worker=all"]
         if self.zone:

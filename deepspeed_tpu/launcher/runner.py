@@ -205,12 +205,23 @@ def main(args=None):
                         master_addr=master_addr,
                         master_port=args.master_port)
     env = dict(os.environ)
-    env.update(load_deepspeed_env())
-    cmd = runner.get_cmd(env, active)
-    logger.info(f"launcher cmd: {' '.join(map(shlex.quote, cmd))}")
-    result = subprocess.Popen(cmd, env=env)
-    result.wait()
-    return result.returncode
+    runner.ds_env = load_deepspeed_env()
+    env.update(runner.ds_env)
+    if isinstance(runner, SSHRunner):
+        # One connection per host — every node must be spawned, not just
+        # rank 0, or the jax.distributed rendezvous waits forever.
+        cmds = runner.get_all_cmds(env, active)
+    else:
+        cmds = [runner.get_cmd(env, active)]
+    procs = []
+    for cmd in cmds:
+        logger.info(f"launcher cmd: {' '.join(map(shlex.quote, cmd))}")
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,127 @@
+"""GPT-2 MoE variant: expert-parallel FFNs on alternating blocks.
+
+The mixture-of-experts flagship for the ``expert`` mesh axis (beyond the
+v0.3.2 reference, which has no MoE). Dense blocks reuse
+`models/gpt2.py`; MoE blocks replace the MLP with
+:class:`deepspeed_tpu.moe.MoE` and the loss carries the load-balancing
+auxiliary term.
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from flax.traverse_util import flatten_dict, unflatten_dict
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.gpt2 import (
+    Block, CausalSelfAttention, GPT2Config, cross_entropy_loss)
+from deepspeed_tpu.moe.layer import MoE, MoEConfig, moe_param_spec
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2MoEConfig(GPT2Config):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2            # every Nth block is MoE (GShard style)
+    aux_loss_weight: float = 0.01
+
+
+def gpt2_moe_tiny(**kw):
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("n_positions", 64)
+    kw.setdefault("n_embd", 64)
+    kw.setdefault("n_layer", 2)
+    kw.setdefault("n_head", 4)
+    kw.setdefault("num_experts", 4)
+    return GPT2MoEConfig(**kw)
+
+
+class MoEBlock(nn.Module):
+    config: GPT2MoEConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        moe_cfg = MoEConfig(num_experts=cfg.num_experts, top_k=cfg.top_k,
+                            capacity_factor=cfg.capacity_factor,
+                            aux_loss_weight=cfg.aux_loss_weight,
+                            dtype=cfg.dtype)
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
+        y, aux = MoE(moe_cfg, hidden_dim=4 * cfg.n_embd, name="moe")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
+        return x + y, aux
+
+
+class GPT2MoELMHead(nn.Module):
+    """Decoder LM with MoE FFNs every ``moe_every`` blocks. Returns
+    (logits, total_aux_loss)."""
+    config: GPT2MoEConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
+        x = wte[input_ids].astype(cfg.dtype) + wpe[None, :T].astype(cfg.dtype)
+        aux_total = jnp.asarray(0.0, jnp.float32)
+        for i in range(cfg.n_layer):
+            if cfg.moe_every > 0 and i % cfg.moe_every == cfg.moe_every - 1:
+                x, aux = MoEBlock(cfg, name=f"h_{i}")(x, deterministic)
+                aux_total = aux_total + aux
+            else:
+                x = Block(cfg, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = x @ wte.T.astype(cfg.dtype)
+        return logits, aux_total
+
+
+def make_gpt2_moe_loss_fn(model: GPT2MoELMHead):
+    """Cross-entropy + load-balancing aux loss."""
+
+    def loss_fn(params, batch, rng=None):
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [input_ids[:, 1:],
+                 jnp.full((input_ids.shape[0], 1), -100, input_ids.dtype)],
+                axis=1)
+        rngs = {"dropout": rng} if rng is not None else {}
+        logits, aux = model.apply({"params": params}, input_ids,
+                                  deterministic=rng is None, rngs=rngs)
+        return cross_entropy_loss(logits, labels) + aux
+
+    return loss_fn
+
+
+def init_gpt2_moe_params(model, rng, batch_size=2, seq_len=None):
+    cfg = model.config
+    T = seq_len or min(cfg.n_positions, 64)
+    dummy = jnp.zeros((batch_size, T), jnp.int32)
+    return model.init({"params": rng}, dummy)["params"]
+
+
+def gpt2_moe_partition_specs(params, expert_axis="expert",
+                             model_axis="model"):
+    """TP specs for dense weights (as `gpt2_partition_specs`) + expert-axis
+    sharding for the MoE banks."""
+    from deepspeed_tpu.models.gpt2 import gpt2_partition_specs
+    base = flatten_dict(gpt2_partition_specs(params, model_axis=model_axis))
+    flat = flatten_dict(params)
+    specs = {}
+    for path, leaf in flat.items():
+        name = path[-1]
+        if "moe" in path:
+            specs[path] = moe_param_spec(name, leaf,
+                                         expert_axis=expert_axis)
+        else:
+            specs[path] = base[path]
+    return unflatten_dict(specs)

@@ -1,0 +1,5 @@
+from deepspeed_tpu.moe.layer import (MoE, MoEConfig, compute_capacity,
+                                     moe_param_spec, top_k_gating)
+
+__all__ = ["MoE", "MoEConfig", "compute_capacity", "moe_param_spec",
+           "top_k_gating"]

@@ -1,0 +1,156 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` mesh axis.
+
+The reference at v0.3.2 has no MoE (DeepSpeed-MoE arrived later); this
+module provides the capability TPU-first so the framework's 5-axis mesh
+(``data/model/pipe/seq/expert``, `parallel/mesh.py`) is fully usable:
+
+- GShard/Switch-style static-shape dispatch: top-k routing with a fixed
+  per-expert capacity, expressed as one-hot dispatch/combine einsums so
+  every op is a dense MXU matmul (no gather/scatter, no dynamic shapes
+  under jit);
+- expert parallelism = sharding the expert-banked weights ``[E, ...]`` and
+  the dispatched activations ``[B, E, C, M]`` over the ``expert`` axis —
+  GSPMD inserts the all_to_all that hand-written MoE frameworks code
+  explicitly;
+- Switch-transformer load-balancing auxiliary loss.
+
+Shapes: tokens [B, S, M], E experts, capacity C = ceil(k * S * cf / E).
+"""
+
+import dataclasses
+import math
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2                   # 1 = Switch, 2 = GShard
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.float32
+
+
+def compute_capacity(seq_len, cfg: MoEConfig, deterministic):
+    cf = cfg.eval_capacity_factor if deterministic else cfg.capacity_factor
+    cap = max(cfg.min_capacity,
+              int(math.ceil(cfg.top_k * seq_len * cf / cfg.num_experts)))
+    return min(cap, seq_len)
+
+
+def top_k_gating(logits, top_k, capacity):
+    """Static-shape top-k routing.
+
+    ``logits`` [B, S, E] → (dispatch [B, S, E, C] one-hot, combine
+    [B, S, E, C] gate-weighted, aux_loss scalar). Tokens over capacity are
+    dropped (their combine weight is zero) — Switch/GShard semantics.
+    """
+    B, S, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    dispatch = jnp.zeros((B, S, E), jnp.float32)
+    gates = jnp.zeros((B, S, E), jnp.float32)
+    masked = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)                     # [B, S]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        dispatch = dispatch + onehot
+        gates = gates + probs * onehot
+        masked = masked * (1.0 - onehot)
+
+    # Position of each token within its expert's queue (per batch row,
+    # sequence order — the deterministic tie-break the papers use).
+    position_in_expert = (jnp.cumsum(dispatch, axis=1) - 1.0) * dispatch
+    within_capacity = (position_in_expert < capacity) * dispatch
+    gates = gates * within_capacity
+
+    if top_k > 1:
+        # Renormalize kept gates over the selected experts (GShard top-2).
+        denom = gates.sum(-1, keepdims=True)
+        gates = gates / jnp.maximum(denom, 1e-9)
+    # top_k == 1 keeps the raw router probability (Switch): scaling the
+    # expert output by it is what routes task-loss gradient into the gate.
+
+    pos = jax.nn.one_hot(position_in_expert.astype(jnp.int32), capacity,
+                         dtype=jnp.float32) * within_capacity[..., None]
+    dispatch_tensor = pos                                    # [B,S,E,C]
+    combine_tensor = gates[..., None] * pos                  # [B,S,E,C]
+
+    # Switch aux loss: E * Σ_e fraction_dispatched_e * mean_prob_e
+    # (computed on the pre-capacity top-1 assignment).
+    top1 = jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=jnp.float32)
+    fraction = top1.mean(axis=(0, 1))
+    mean_prob = probs.mean(axis=(0, 1))
+    aux_loss = E * jnp.sum(fraction * mean_prob)
+    return dispatch_tensor, combine_tensor, aux_loss
+
+
+class MoE(nn.Module):
+    """Expert-parallel MoE FFN block.
+
+    ``__call__(x, deterministic)`` with x [B, S, M] → (y [B, S, M],
+    aux_loss). Expert weights are banked on a leading E dim; shard it over
+    the ``expert`` axis with :func:`moe_partition_specs`.
+    """
+
+    config: MoEConfig
+    hidden_dim: int              # expert FFN hidden size
+    activation: Callable = jax.nn.gelu
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        B, S, M = x.shape
+        E = cfg.num_experts
+        C = compute_capacity(S, cfg, deterministic)
+        dtype = cfg.dtype
+
+        wg = self.param("gate", nn.initializers.normal(0.02), (M, E))
+        w1 = self.param("expert_w1", nn.initializers.normal(0.02),
+                        (E, M, self.hidden_dim))
+        b1 = self.param("expert_b1", nn.initializers.zeros,
+                        (E, self.hidden_dim))
+        w2 = self.param("expert_w2", nn.initializers.normal(0.02),
+                        (E, self.hidden_dim, M))
+        b2 = self.param("expert_b2", nn.initializers.zeros, (E, M))
+
+        logits = x.astype(jnp.float32) @ wg
+        dispatch, combine, aux = top_k_gating(logits, cfg.top_k, C)
+        dispatch = dispatch.astype(dtype)
+        combine = combine.astype(dtype)
+        xc = x.astype(dtype)
+
+        # Token dispatch / expert FFN / combine — all dense einsums. With
+        # w*/[B,E,C,M] sharded over ``expert``, GSPMD lowers the transitions
+        # to all_to_all over the expert axis.
+        de = jnp.einsum("bsec,bsm->becm", dispatch, xc)
+        h = self.activation(
+            jnp.einsum("becm,emh->bech", de, w1.astype(dtype)) +
+            b1.astype(dtype)[None, :, None])
+        eo = jnp.einsum("bech,ehm->becm", h, w2.astype(dtype)) + \
+            b2.astype(dtype)[None, :, None]
+        y = jnp.einsum("bsec,becm->bsm", combine, eo)
+        return y.astype(x.dtype), cfg.aux_loss_weight * aux
+
+
+def moe_param_spec(name, leaf, expert_axis="expert", model_axis=None):
+    """PartitionSpec for one MoE param leaf (by reference-free naming
+    convention: 'gate', 'expert_*')."""
+    ndim = getattr(leaf, "ndim", 0)
+    if name.startswith("expert_") and ndim >= 2:
+        # Bank dim over the expert axis; optionally shard the FFN hidden
+        # dim over model too (expert + tensor parallel compose).
+        spec = [expert_axis] + [None] * (ndim - 1)
+        if model_axis is not None and ndim == 3:
+            spec[2 if name.endswith("w1") else 1] = model_axis
+        return P(*spec)
+    if name.startswith("expert_") and ndim >= 1:
+        return P(expert_axis)
+    return P()

@@ -123,10 +123,24 @@ def test_pdsh_runner_cmd():
     active = {"h0": [0], "h1": [0]}
     r = multinode_runner.PDSHRunner(args, runner.encode_world_info(active),
                                     "h0", 29500)
-    cmd = r.get_cmd({}, active)
+    env = {}
+    cmd = r.get_cmd(env, active)
     assert cmd[0] == "pdsh"
     assert "h0,h1" in cmd
     assert "%n" in " ".join(cmd)   # pdsh node-rank expansion
+    # the transport env Popen sees must select ssh
+    assert env["PDSH_RCMD_TYPE"] == "ssh"
+
+
+def test_ds_env_vars_are_exported():
+    args = _runner_args()
+    active = {"h0": [0], "h1": [0]}
+    r = multinode_runner.SSHRunner(args, runner.encode_world_info(active),
+                                   "h0", 29500)
+    r.ds_env = {"WANDB_API_KEY": "k"}
+    cmds = r.get_all_cmds({"WANDB_API_KEY": "k", "OTHER": "x"}, active)
+    joined = " ".join(cmds[0])
+    assert "WANDB_API_KEY" in joined and "OTHER" not in joined
 
 
 def test_gcloud_runner_cmd(monkeypatch):
@@ -141,7 +155,10 @@ def test_gcloud_runner_cmd(monkeypatch):
     assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh"]
     assert "my-pod" in cmd and "--worker=all" in cmd
     assert "--zone=us-central2-b" in cmd
-    assert "$TPU_WORKER_ID" in joined
+    # node rank must be double-quoted, not shlex-escaped, so the remote
+    # shell expands the worker index
+    assert '"--node_rank=$TPU_WORKER_ID"' in cmd[-1]
+    assert "'--node_rank=$TPU_WORKER_ID'" not in cmd[-1]
 
 
 def test_env_report_smoke():
