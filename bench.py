@@ -7,12 +7,21 @@ the engine's compiled train step). vs_baseline compares achieved model
 TFLOPS/chip against the reference's best published per-GPU number
 (64 TFLOPS on V100, `docs/_tutorials/bert-pretraining.md:387` — see
 BASELINE.md).
+
+Robustness contract (VERDICT r1 item 1b): the axon TPU tunnel is flaky, so
+backend init is retried with backoff; any failure still prints one JSON line
+with an "error" field instead of a raw traceback. An OOM at the flagship
+config falls back to remat=True and a smaller batch rather than dying.
 """
 
 import json
+import os
 import time
+import traceback
 
 import numpy as np
+
+BASELINE_TFLOPS = 64.0  # reference best published per-GPU (V100)
 
 
 def model_flops_per_token(cfg, seq_len):
@@ -23,22 +32,42 @@ def model_flops_per_token(cfg, seq_len):
     return 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * seq_len
 
 
-def main():
+def emit(payload):
+    print(json.dumps(payload), flush=True)
+
+
+def init_backend_with_retry(retries=5, delay=10.0):
+    """jax.devices() with retries — the axon TPU tunnel can be transiently
+    UNAVAILABLE (BENCH_r01: rc=1 on first touch). Falls back to whatever
+    backend is available if the preferred one never comes up."""
     import jax
+
+    last = None
+    for attempt in range(retries):
+        try:
+            devices = jax.devices()
+            return jax, devices
+        except Exception as e:  # backend init failure — retry
+            last = e
+            time.sleep(delay * (1 + attempt))
+    # Final fallback: let jax pick anything it can (e.g. CPU). The env var
+    # is captured into jax.config at import time, so mutate the config.
+    try:
+        import jax.extend
+
+        jax.config.update("jax_platforms", None)
+        jax.extend.backend.clear_backends()
+        return jax, jax.devices()
+    except Exception:
+        raise last
+
+
+def run_once(jax, cfg_fn, batch_size, seq_len, steps, remat, on_tpu):
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import (
-        GPT2LMHead, gpt2_125m, gpt2_350m, init_gpt2_params, make_gpt2_loss_fn)
+        GPT2LMHead, init_gpt2_params, make_gpt2_loss_fn)
 
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
-    if on_tpu:
-        cfg_fn, batch_size, seq_len, steps = gpt2_125m, 8, 1024, 30
-    else:  # CPU smoke mode
-        cfg_fn, batch_size, seq_len, steps = gpt2_125m, 2, 128, 2
-
-    # 125M @ bs8/seq1024 fits HBM without remat; flash attention keeps the
-    # attention working set in VMEM (Pallas kernel on TPU).
-    cfg = cfg_fn(n_positions=seq_len, remat=False,
+    cfg = cfg_fn(n_positions=seq_len, remat=remat,
                  use_flash_attention=on_tpu)
     model = GPT2LMHead(cfg)
     params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
@@ -85,14 +114,60 @@ def main():
         tflops = xla_flops * steps / dt / 1e12
     else:
         tflops = tokens_per_sec * model_flops_per_token(cfg, seq_len) / 1e12
-    baseline_tflops = 64.0  # reference best published per-GPU (V100)
-    print(json.dumps({
-        "metric": f"GPT-2 {'125M' if on_tpu else '125M(cpu-smoke)'} train "
-                  f"tokens/sec/chip (bf16, seq{seq_len})",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": round(tflops / baseline_tflops, 3),
-    }))
+    return tokens_per_sec, tflops
+
+
+def main():
+    try:
+        jax, devices = init_backend_with_retry()
+    except Exception as e:
+        emit({"metric": "GPT-2 125M train tokens/sec/chip", "value": 0,
+              "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+              "error": f"backend init failed after retries: {e!r}"})
+        return
+
+    platform = devices[0].platform
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        cfg_name, batch_size, seq_len, steps = "125M", 8, 1024, 30
+    else:  # CPU smoke mode
+        cfg_name, batch_size, seq_len, steps = "125M(cpu-smoke)", 2, 128, 2
+
+    from deepspeed_tpu.models.gpt2 import gpt2_125m
+
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    attempts = [(batch_size, remat), (batch_size, True), (batch_size // 2, True)]
+    attempts = list(dict.fromkeys(attempts))  # dedupe when BENCH_REMAT=1
+    err = tb = None
+    for bs, rm in attempts:
+        try:
+            tokens_per_sec, tflops = run_once(
+                jax, gpt2_125m, bs, seq_len, steps, rm, on_tpu)
+            out = {
+                "metric": f"GPT-2 {cfg_name} train tokens/sec/chip "
+                          f"(bf16, seq{seq_len}, bs{bs}"
+                          f"{', remat' if rm else ''})",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(tflops / BASELINE_TFLOPS, 3),
+            }
+            if err is not None:
+                first = attempts[0]
+                out["note"] = (
+                    f"fell back from bs{first[0]}"
+                    f"{'/remat' if first[1] else ''} to bs{bs}"
+                    f"{'/remat' if rm else ''}: {err}")
+            emit(out)
+            return
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            tb = traceback.format_exc(limit=5)
+            if "RESOURCE_EXHAUSTED" not in str(e) and not isinstance(
+                    e, MemoryError):
+                break  # non-OOM failure: don't mask it with fallbacks
+    emit({"metric": f"GPT-2 {cfg_name} train tokens/sec/chip", "value": 0,
+          "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+          "error": err, "traceback": tb})
 
 
 if __name__ == "__main__":
