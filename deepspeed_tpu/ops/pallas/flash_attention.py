@@ -7,14 +7,18 @@ re-designed as an online-softmax tiled kernel so the [T, T] score matrix
 never materializes in HBM.
 
 Implementations:
-- ``pallas``: TPU Pallas forward kernel (online softmax over KV tiles,
-  MXU-tiled, fp32 accumulators in VMEM scratch).
+- ``pallas``: TPU Pallas forward + backward kernels (online softmax over
+  KV tiles, MXU-tiled, fp32 accumulators in VMEM scratch). The forward
+  also emits the per-row logsumexp; the backward is the FlashAttention-2
+  split — one kernel accumulating dQ over KV tiles, one accumulating
+  dK/dV over Q tiles — so the [T, T] score matrix never materializes in
+  either direction.
 - ``xla``: blockwise lax.scan with the same online-softmax math — runs
   everywhere (CPU test meshes), differentiable, memory O(T·block).
 - ``dense``: plain softmax attention (reference math for parity tests).
 
-``flash_attention`` routes: TPU → pallas forward with a custom VJP whose
-backward uses the blockwise XLA path; other platforms → xla path.
+``flash_attention`` routes: TPU → pallas kernels; other platforms → xla
+path (or pallas in interpreter mode when explicitly requested).
 """
 
 import functools
@@ -94,10 +98,30 @@ def _blockwise_attention(q, k, v, causal, sm_scale, block_k=256):
 
 
 # ---------------------------------------------------------------------------
-# Pallas TPU forward kernel
+# Pallas TPU kernels (forward + FlashAttention-2-style backward)
 # ---------------------------------------------------------------------------
 
-def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _to_bh(x):
+    """[B, T, H, D] → [B*H, T, D]: heads fold into the grid's leading dim so
+    block shapes end in (seq_tile, D) — the TPU-tileable layout."""
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _from_bh(x, B, H):
+    BH, T, D = x.shape
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+# TPU vector lanes: narrow per-row scalars (lse, delta) are stored broadcast
+# along a trailing lane dim so their blocks satisfy the (8, 128) tiling rule.
+_LANES = 128
+
+
+def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                interpret=False):
+    """Returns (out [B,T,H,D], lse [B*H,T,_LANES]) — lse is the softmax row
+    logsumexp residual (lane-broadcast) consumed by the backward kernels."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -110,14 +134,9 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     n_q = T // block_q
     n_k = S // block_k
 
-    # [B, T, H, D] → [B*H, T, D]: heads fold into the grid's leading dim so
-    # block shapes end in (seq_tile, D) — the TPU-tileable layout.
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+    q, k, v = _to_bh(q), _to_bh(k), _to_bh(v)
 
-    q, k, v = to_bh(q), to_bh(k), to_bh(v)
-
-    def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
         qi = pl.program_id(1)
         ki = pl.program_id(2)
 
@@ -160,9 +179,11 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k):
         def _finish():
             o_ref[0] = (acc_ref[:] /
                         l_ref[:, 0][:, None]).astype(o_ref.dtype)
+            lse = m_ref[:, 0] + jnp.log(l_ref[:, 0])
+            lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, _LANES))
 
     grid = (B * H, n_q, n_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -170,40 +191,212 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k):
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, _LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
+        interpret=interpret,
     )(q, k, v)
-    # [B*H, T, D] → [B, T, H, D]
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return _from_bh(out, B, H), lse
+
+
+def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
+                interpret=False):
+    """FlashAttention-2 backward. Two kernels:
+
+    - dQ: grid (BH, n_q, n_k), accumulates dq over KV tiles in VMEM.
+    - dK/dV: grid (BH, n_k, n_q), accumulates dk, dv over Q tiles in VMEM.
+
+    delta = rowsum(dO ⊙ O) is precomputed in XLA (it is a cheap fused
+    elementwise+reduce). All matmuls run in fp32 on the MXU.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+    n_q = T // block_q
+    n_k = S // block_k
+
+    in_dtype = q.dtype
+    qh, kh, vh = _to_bh(q), _to_bh(k), _to_bh(v)
+    oh, gh = _to_bh(out), _to_bh(g)
+    delta = jnp.sum(gh.astype(jnp.float32) * oh.astype(jnp.float32),
+                    axis=-1)                               # [BH, T]
+    delta = jnp.broadcast_to(delta[..., None],
+                             delta.shape + (_LANES,))      # lane-padded
+
+    def scores(q_ref, k_ref, qi, ki):
+        qb = q_ref[0].astype(jnp.float32)                  # [bq, D]
+        kb = k_ref[0].astype(jnp.float32)                  # [bk, D]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, DEFAULT_MASK_VALUE)
+        return s
+
+    def dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                  dq_ref, dq_acc):
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            dq_acc[:] = jnp.zeros_like(dq_acc)
+
+        run = True
+        if causal:
+            run = (ki * block_k) <= (qi * block_q + block_q - 1)
+
+        @pl.when(run if causal else True)
+        def _compute():
+            s = scores(q_ref, k_ref, qi, ki)
+            lse = lse_ref[0][:, :1]                        # [bq, 1]
+            p = jnp.exp(s - lse)                           # [bq, bk]
+            gb = g_ref[0].astype(jnp.float32)              # [bq, D]
+            vb = v_ref[0].astype(jnp.float32)              # [bk, D]
+            dp = jax.lax.dot_general(
+                gb, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [bq, bk]
+            ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
+            kb = k_ref[0].astype(jnp.float32)
+            dq_acc[:] += jax.lax.dot_general(
+                ds, kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [bq, D]
+
+        @pl.when(ki == n_k - 1)
+        def _finish():
+            dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qh.shape, in_dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qh, kh, vh, gh, lse, delta)
+
+    def dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc):
+        ki = pl.program_id(1)
+        qi = pl.program_id(2)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        run = True
+        if causal:
+            # Q tiles strictly above the diagonal see nothing of this KV tile.
+            run = (ki * block_k) <= (qi * block_q + block_q - 1)
+
+        @pl.when(run if causal else True)
+        def _compute():
+            s = scores(q_ref, k_ref, qi, ki)
+            p = jnp.exp(s - lse_ref[0][:, :1])             # [bq, bk]
+            gb = g_ref[0].astype(jnp.float32)              # [bq, D]
+            dv_acc[:] += jax.lax.dot_general(
+                p, gb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [bk, D]
+            vb = v_ref[0].astype(jnp.float32)
+            dp = jax.lax.dot_general(
+                gb, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [bq, bk]
+            ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
+            qb = q_ref[0].astype(jnp.float32)
+            dk_acc[:] += jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [bk, D]
+
+        @pl.when(qi == n_q - 1)
+        def _finish():
+            dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bh, ki, qi: (bh, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kh.shape, in_dtype),
+            jax.ShapeDtypeStruct(vh.shape, in_dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh, gh, lse, delta)
+
+    return (_from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H))
 
 
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_pallas(q, k, v, causal, sm_scale, block_q, block_k):
-    return _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_pallas(q, k, v, causal, sm_scale, block_q, block_k,
+                  interpret=False):
+    out, _ = _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                         interpret)
+    return out
 
 
-def _flash_pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    out = _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k)
-    return out, (q, k, v)
+def _flash_pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                      interpret):
+    out, lse = _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                           interpret)
+    return out, (q, k, v, out, lse)
 
 
-def _flash_pallas_bwd(causal, sm_scale, block_q, block_k, res, g):
-    # Backward via the blockwise XLA path (Pallas bwd kernel is a planned
-    # upgrade); recomputes attention flash-style, so still O(T·block) memory.
-    q, k, v = res
-    def f(q, k, v):
-        return _blockwise_attention(q, k, v, causal, sm_scale, block_k)
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+def _flash_pallas_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    return _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale,
+                       block_q, block_k, interpret)
 
 
 _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
@@ -213,14 +406,14 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
                     block_q=512, block_k=512, implementation="auto"):
     """Memory-efficient attention; q,k,v: [B, T, H, D] → [B, T, H, D].
 
-    ``implementation``: "auto" (pallas on TPU, xla elsewhere), "pallas",
-    "xla", or "dense".
+    ``implementation``: "auto" (pallas on TPU, xla elsewhere), "pallas"
+    (interpreter mode off-TPU — slow, for parity tests), "xla", or "dense".
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    on_tpu = jax.devices()[0].platform == "tpu"
     if implementation == "auto":
-        platform = jax.devices()[0].platform
-        implementation = "pallas" if platform == "tpu" else "xla"
+        implementation = "pallas" if on_tpu else "xla"
     if implementation == "dense":
         return dense_attention(q, k, v, causal, sm_scale)
     if implementation == "xla":
@@ -232,5 +425,6 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
         # Fall back when shapes don't tile cleanly.
         if T % bq != 0 or k.shape[1] % bk != 0:
             return _blockwise_attention(q, k, v, causal, sm_scale)
-        return _flash_pallas(q, k, v, causal, sm_scale, bq, bk)
+        return _flash_pallas(q, k, v, causal, sm_scale, bq, bk,
+                             not on_tpu)
     raise ValueError(f"unknown implementation {implementation!r}")
