@@ -65,18 +65,21 @@ def top_k_gating(logits, top_k, capacity):
         gates = gates + probs * onehot
         masked = masked * (1.0 - onehot)
 
+    if top_k > 1:
+        # GShard top-2: renormalize over the *selected* experts BEFORE
+        # capacity dropping, so a token whose first choice overflows still
+        # routes through its second choice with the proportional weight
+        # (not an inflated 1.0) — the dropped mass is lost, as in GShard.
+        denom = gates.sum(-1, keepdims=True)
+        gates = gates / jnp.maximum(denom, 1e-9)
+    # top_k == 1 keeps the raw router probability (Switch): scaling the
+    # expert output by it is what routes task-loss gradient into the gate.
+
     # Position of each token within its expert's queue (per batch row,
     # sequence order — the deterministic tie-break the papers use).
     position_in_expert = (jnp.cumsum(dispatch, axis=1) - 1.0) * dispatch
     within_capacity = (position_in_expert < capacity) * dispatch
     gates = gates * within_capacity
-
-    if top_k > 1:
-        # Renormalize kept gates over the selected experts (GShard top-2).
-        denom = gates.sum(-1, keepdims=True)
-        gates = gates / jnp.maximum(denom, 1e-9)
-    # top_k == 1 keeps the raw router probability (Switch): scaling the
-    # expert output by it is what routes task-loss gradient into the gate.
 
     pos = jax.nn.one_hot(position_in_expert.astype(jnp.int32), capacity,
                          dtype=jnp.float32) * within_capacity[..., None]

@@ -55,8 +55,11 @@ class OpBuilder:
         if "avx512f" in cpuinfo:
             flags.append("-mavx512f")
         if "avx2" in cpuinfo:
-            flags.append("-mavx2")
-        if "fma" in cpuinfo:
+            # The AVX2 kernels use _mm256_fmadd/_fnmadd, which need FMA;
+            # every AVX2 CPU has it, but containers can mask the cpuinfo
+            # flag — always pair -mfma with -mavx2.
+            flags += ["-mavx2", "-mfma"]
+        elif "fma" in cpuinfo:
             flags.append("-mfma")
         return flags
 

@@ -116,11 +116,18 @@ def step_metrics(loss_sum, accum, grad_norm, applied_norm, lr, scale,
     }
 
 
-def make_grad_accumulator(loss_fn, compute_dtype, accum):
+def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None):
     """Build ``accumulate(params, batch, rng, scale) -> (loss_sum, grads)``:
     scaled-loss value-and-grad over one microbatch, or a ``lax.scan`` over
     ``accum`` microbatches (batch leading dim = accum). Shared by the dense
-    and the 1-bit (shard_map) train steps."""
+    and the 1-bit (shard_map) train steps.
+
+    ``constrain`` (grad pytree → grad pytree) pins the gradient layout —
+    under ZeRO-2 the scan *carry* is constrained to the sharded-gradient
+    layout, so the replicated full gradient never materializes across
+    microbatches (the IPG-partition contract of reference stage2.py:613-738;
+    constraining only after the scan would leave the carry layout to XLA's
+    guess)."""
 
     def cast_params(p):
         return jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
@@ -139,12 +146,16 @@ def make_grad_accumulator(loss_fn, compute_dtype, accum):
             return micro_grads(params, micro, rng, scale)
         zeros = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        if constrain is not None:
+            zeros = constrain(zeros)
 
         def body(carry, micro):
             g_acc, loss_acc, key = carry
             key, sub = jax.random.split(key)
             loss, g = micro_grads(params, micro, sub, scale)
             g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+            if constrain is not None:
+                g_acc = constrain(g_acc)
             return (g_acc, loss_acc + loss, key), None
 
         (grads, loss_sum, _), _ = jax.lax.scan(
@@ -561,6 +572,8 @@ class DeepSpeedEngine:
     def _make_train_step(self):
         if self.optimizer_name == ONEBIT_ADAM_OPTIMIZER:
             return self._make_onebit_train_step()
+        if self.sparse_gradients_enabled():
+            return self._make_sparse_grad_train_step()
         accum = self._engine_accum_steps()
         compute_dtype = self.compute_dtype
         fp16 = self._config.fp16_enabled
@@ -578,7 +591,10 @@ class DeepSpeedEngine:
         scale_args = self._scale_args()
         dynamic = self.dynamic_loss_scale
         static_scale = self.static_loss_scale
-        accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum)
+        grad_constrain = (lambda g: constrain_tree(g, grad_shardings)) \
+            if grad_shardings is not None else None
+        accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum,
+                                           constrain=grad_constrain)
 
         def train_step(params, opt_state, dstate, batch, rng, lr_in):
             scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
@@ -592,9 +608,7 @@ class DeepSpeedEngine:
             # computed by XLA in fp32, so they are accepted for config
             # compatibility but are intentionally no-ops.
             grads, overflow, grad_norm, applied_norm = grad_epilogue(
-                grads, scale, accum, fp16, clip,
-                constrain=(lambda g: constrain_tree(g, grad_shardings))
-                if grad_shardings is not None else None)
+                grads, scale, accum, fp16, clip, constrain=grad_constrain)
 
             lr = lr_fn(dstate.global_step) if lr_fn is not None else lr_in
             beta1 = mom_fn(dstate.global_step)
@@ -689,6 +703,148 @@ class DeepSpeedEngine:
                                     beta1=float(metrics["beta1"]))
             self.params = self._upload_offload_params()
         return metrics
+
+    def _sparse_grad_flags(self):
+        """Pytree of bools (params structure): which leaves take the CSR
+        sparse-gradient path. The reference auto-detects ``nn.Embedding``
+        modules when ``sparse_gradients`` is on (engine.py:177-183); a
+        functional engine has no modules, so detection is by param path —
+        2-D leaves whose path mentions an embedding-ish name. Override per
+        engine with ``engine.sparse_grad_predicate = lambda names, leaf:
+        ...`` before the first ``train_batch``."""
+        import re
+
+        pat = re.compile(r"embed|wte|wpe|vocab|token|lookup", re.I)
+        pred = getattr(self, "sparse_grad_predicate", None) or (
+            lambda names, leaf: leaf.ndim == 2 and
+            any(pat.search(n) for n in names))
+
+        def flag(path, leaf):
+            names = [str(getattr(p, "key", getattr(p, "idx", p)))
+                     for p in path]
+            return bool(pred(names, leaf))
+
+        return jax.tree_util.tree_map_with_path(flag, self.params)
+
+    def _make_sparse_grad_train_step(self):
+        """Compiled step with CSR sparse embedding-gradient communication
+        (reference `runtime/engine.py:177-183` auto-conversion and
+        `engine.py:1157-1213` sparse allreduce).
+
+        shard_map over the ``data`` axis: each shard takes local grads;
+        embedding leaves are sparsified to their top-``k`` rows by L1 mass
+        (``k`` = the shard's token budget, a static over-bound on touched
+        rows, so the result is exact — the analog of the reference padding
+        ranks to the max nnz) and exchanged by index/value all_gather;
+        every other leaf takes a dense pmean.
+
+        Exactness caveat: a *tied* embedding (also used as the output head,
+        e.g. GPT-2 wte) gets a dense gradient through the softmax — more
+        touched rows than the token budget. The step therefore reports the
+        L1 mass the top-``k`` truncation dropped (``sparse_grad_dropped``
+        metric) and ``train_batch`` warns when it is nonzero; use
+        ``engine.sparse_grad_predicate`` to exclude such leaves."""
+        from deepspeed_tpu.runtime.csr_tensor import (csr_allreduce,
+                                                      dense_to_csr)
+
+        for ax, size in self.mesh.shape.items():
+            assert ax == "data" or size == 1, (
+                f"sparse_gradients supports pure data parallelism; mesh "
+                f"axis {ax!r} has size {size}")
+        assert self.zero_optimization_stage() == 0, (
+            "sparse_gradients is incompatible with ZeRO (the reference's "
+            "CSR path is the non-ZeRO allreduce fallback, engine.py:1127)")
+
+        accum = self._engine_accum_steps()
+        compute_dtype = self.compute_dtype
+        fp16 = self._config.fp16_enabled
+        clip = float(self._config.gradient_clipping or 0.0)
+        lr_fn = self._lr_fn
+        mom_fn = self._mom_fn
+        opt_update = self._opt_update
+        loss_fn = self.loss_fn
+        scale_args = self._scale_args()
+        dynamic = self.dynamic_loss_scale
+        static_scale = self.static_loss_scale
+        accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum)
+        sparse_flags = self._sparse_grad_flags()
+
+        def step_local(params, opt_state, dstate, batch, rng, lr_in):
+            scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
+                else jnp.asarray(static_scale, jnp.float32)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            loss_sum, grads = accumulate(params, batch, rng, scale)
+
+            # Static token budget: rows touched locally per boundary is
+            # bounded by the number of id elements in the local batch.
+            tokens = sum(
+                leaf.size for leaf in jax.tree_util.tree_leaves(batch)
+                if jnp.issubdtype(leaf.dtype, jnp.integer))
+
+            dropped = jnp.asarray(0.0, jnp.float32)
+
+            def reduce_leaf(is_sparse, g):
+                nonlocal dropped
+                if is_sparse and 0 < tokens < g.shape[0]:
+                    csr = dense_to_csr(g, min(tokens, g.shape[0]))
+                    # L1 mass the static top-k truncation lost (nonzero ⇒
+                    # this leaf's grad was denser than the token budget,
+                    # e.g. a tied embedding — surfaced as a metric).
+                    dropped += (jnp.abs(g).sum() -
+                                jnp.abs(csr.values).sum()).astype(jnp.float32)
+                    return csr_allreduce(csr, "data").to_dense()
+                return jax.lax.pmean(g, "data")
+
+            grads = jax.tree_util.tree_map(reduce_leaf, sparse_flags, grads)
+            dropped = jax.lax.pmax(dropped, "data")
+
+            # Grads are now replicated-global, so no cross-shard vote or
+            # norm reduction is needed past this point.
+            grads, overflow, grad_norm, applied_norm = grad_epilogue(
+                grads, scale, accum, fp16, clip)
+
+            lr = lr_fn(dstate.global_step) if lr_fn is not None else lr_in
+            beta1 = mom_fn(dstate.global_step)
+            new_params, new_opt = opt_update(params, grads, opt_state, lr,
+                                             beta1)
+
+            def select(old, new):
+                return jax.tree_util.tree_map(
+                    lambda o, n: jnp.where(overflow, o, n), old, new)
+            params_out = select(params, new_params)
+            opt_out = type(opt_state)(
+                m=select(opt_state.m, new_opt.m),
+                v=select(opt_state.v, new_opt.v),
+                step=jnp.where(overflow, opt_state.step, new_opt.step))
+
+            dstate_out = loss_scale_epilogue(dstate, overflow, fp16, dynamic,
+                                             scale_args)
+            metrics = step_metrics(
+                loss_sum, accum, grad_norm, applied_norm, lr, scale,
+                overflow, loss_reduce=lambda l: jax.lax.pmean(l, "data"))
+            metrics["sparse_grad_dropped"] = dropped
+            return params_out, opt_out, dstate_out, metrics
+
+        P = PartitionSpec
+        rep = P()
+        param_specs = jax.tree_util.tree_map(lambda _: rep, self.params)
+        opt_specs = type(self.opt_state)(
+            m=jax.tree_util.tree_map(lambda _: rep, self.opt_state.m),
+            v=jax.tree_util.tree_map(lambda _: rep, self.opt_state.v),
+            step=rep)
+        dstate_specs = jax.tree_util.tree_map(lambda _: rep,
+                                              self.device_state)
+        metrics_specs = {k: rep for k in ("loss", "grad_norm",
+                                          "applied_grad_norm", "lr",
+                                          "loss_scale", "overflow",
+                                          "sparse_grad_dropped")}
+        mapped = jax.shard_map(
+            step_local, mesh=self.mesh,
+            in_specs=(param_specs, opt_specs, dstate_specs, P(None, "data"),
+                      rep, rep),
+            out_specs=(param_specs, opt_specs, dstate_specs, metrics_specs),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0, 1, 2))
 
     def _make_onebit_train_step(self):
         """Compiled 1-bit Adam step: shard_map over the ``data`` axis so
@@ -842,6 +998,22 @@ class DeepSpeedEngine:
             self.timers("train_batch").stop()
             self.timers.log(["train_batch"],
                             memory_breakdown=self.memory_breakdown())
+
+        # Only inspect the (device-resident) truncation metric on the first
+        # step and at print boundaries — float() here would otherwise force
+        # a host sync every step and defeat async dispatch.
+        if "sparse_grad_dropped" in metrics and \
+                not getattr(self, "_warned_sparse_dropped", False) and \
+                (self.global_steps == 0 or (self.global_steps + 1) %
+                 self._config.steps_per_print == 0):
+            if float(metrics["sparse_grad_dropped"]) > 1e-7:
+                self._warned_sparse_dropped = True
+                logger.warning(
+                    "sparse_gradients dropped %.3e of gradient L1 mass: an "
+                    "embedding leaf's gradient is denser than the token "
+                    "budget (tied output head?). Exclude it via "
+                    "engine.sparse_grad_predicate.",
+                    float(metrics["sparse_grad_dropped"]))
 
         self.micro_steps += self._config.gradient_accumulation_steps
         self.global_steps += 1
@@ -1107,7 +1279,18 @@ class DeepSpeedEngine:
             # the opt_state tree carries moments + step only.
             opt = self.cpu_optimizer
             flat_leaves = jax.tree_util.tree_leaves(restored["params"])
-            for leaf, off, size in zip(flat_leaves, opt.offsets, opt.sizes):
+            if len(flat_leaves) != len(opt.sizes):
+                raise ValueError(
+                    f"checkpoint has {len(flat_leaves)} param leaves but "
+                    f"offload optimizer expects {len(opt.sizes)}; "
+                    "checkpoint is from a different model")
+            for i, (leaf, off, size) in enumerate(
+                    zip(flat_leaves, opt.offsets, opt.sizes)):
+                if int(np.size(leaf)) != int(size):
+                    raise ValueError(
+                        f"checkpoint param leaf {i} has {np.size(leaf)} "
+                        f"elements, expected {size}; checkpoint is from a "
+                        "different model shape")
                 opt.master[off:off + size] = np.asarray(
                     leaf, np.float32).reshape(-1)
             if load_optimizer_states:

@@ -98,3 +98,91 @@ def test_csr_flows_through_jit():
     csr = CSRTensor(jnp.asarray([1, 2], jnp.int32),
                     jnp.ones((2, 3)), dense_rows=8)
     assert float(f(csr)) == 6.0
+
+
+# ---------------------------------------------------------------------------
+# engine integration: `sparse_gradients: true` (VERDICT r1 missing #3)
+# ---------------------------------------------------------------------------
+
+def _embed_params(rng, vocab=64, d=16):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "embedding": {"table": jax.random.normal(k1, (vocab, d)) * 0.1},
+        "head": {"kernel": jax.random.normal(k2, (d, vocab)) * 0.1},
+    }
+
+
+def _embed_loss(params, batch, rng=None):
+    """Tiny LM: lookup → mean-pool → logits → xent on next id."""
+    x = params["embedding"]["table"][batch["ids"]]          # [B, T, d]
+    logits = x.mean(axis=1) @ params["head"]["kernel"]       # [B, vocab]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, batch["label"][:, None],
+                                         axis=1))
+
+
+def _train_embed(sparse, steps=5, seed=0):
+    import deepspeed_tpu
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "sparse_gradients": sparse,
+        "steps_per_print": 1000,
+    }
+    params = _embed_params(jax.random.PRNGKey(seed))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, loss_fn=_embed_loss, params=params)
+    rng = np.random.default_rng(0)
+    batch = {"ids": rng.integers(0, 64, size=(16, 8)).astype(np.int32),
+             "label": rng.integers(0, 64, size=(16,)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(steps)]
+    return losses, engine
+
+
+def test_sparse_gradients_engine_matches_dense_path():
+    """`sparse_gradients: true` routes embedding grads through the CSR
+    collective inside the compiled step — numerics must match the dense
+    engine path exactly (reference auto-conversion, engine.py:177-183)."""
+    dense_losses, _ = _train_embed(sparse=False)
+    sparse_losses, engine = _train_embed(sparse=True)
+    assert engine.sparse_gradients_enabled()
+    np.testing.assert_allclose(sparse_losses, dense_losses, rtol=2e-5)
+    assert sparse_losses[-1] < sparse_losses[0]
+
+
+def test_sparse_grad_flags_detects_embedding():
+    _, engine = _train_embed(sparse=True, steps=1)
+    flags = engine._sparse_grad_flags()
+    assert flags["embedding"]["table"] is True
+    assert flags["head"]["kernel"] is False
+
+
+def test_sparse_gradients_tied_embedding_reports_dropped_mass():
+    """A tied embedding (used as output head) has a dense gradient; the
+    static top-k truncation must be *surfaced*, not silent."""
+    import deepspeed_tpu
+
+    def tied_loss(params, batch, rng=None):
+        table = params["embedding"]["table"]
+        x = table[batch["ids"]].mean(axis=1)         # lookup (sparse grad)
+        logits = x @ table.T                         # tied head (dense grad)
+        lp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(lp, batch["label"][:, None],
+                                             axis=1))
+
+    cfg = {"train_batch_size": 16, "optimizer":
+           {"type": "Adam", "params": {"lr": 1e-2}},
+           "sparse_gradients": True, "steps_per_print": 1000}
+    params = {"embedding": {"table":
+              jax.random.normal(jax.random.PRNGKey(0), (256, 16)) * 0.1}}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, loss_fn=tied_loss, params=params)
+    rng = np.random.default_rng(0)
+    batch = {"ids": rng.integers(0, 256, (16, 4)).astype(np.int32),
+             "label": rng.integers(0, 256, (16,)).astype(np.int32)}
+    engine.train_batch(batch)
+    # 16*4=64 token budget < 256 dense rows → truncation happened and the
+    # metric + warn-once flag must say so.
+    assert float(engine._last_metrics["sparse_grad_dropped"]) > 0
+    assert getattr(engine, "_warned_sparse_dropped", False)
