@@ -54,7 +54,10 @@ def gpt2_tiny(**kw):
     """Test-size model (the `SimpleModel` analog for LM tests)."""
     kw.setdefault("vocab_size", 256)
     kw.setdefault("n_positions", 64)
-    return GPT2Config(n_embd=64, n_layer=2, n_head=4, **kw)
+    kw.setdefault("n_embd", 64)
+    kw.setdefault("n_layer", 2)
+    kw.setdefault("n_head", 4)
+    return GPT2Config(**kw)
 
 
 class CausalSelfAttention(nn.Module):
@@ -112,15 +115,42 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
+    """Transformer block, optionally with progressive layer drop.
+
+    PLD (reference `runtime/progressive_layer_drop.py:5` + the engine's
+    per-forward theta kwarg injection, reference engine.py:791-792): when
+    ``pld_theta`` is given and training, each sublayer executes with
+    probability ``1 - (l/L)(1 - theta)`` (deeper layers dropped more, the
+    paper's depth schedule). The skip is a ``lax.cond``, so a dropped
+    sublayer costs nothing at runtime on TPU — the paper's compute saving,
+    not just its regularization."""
     config: GPT2Config
+    layer_idx: int = 0
+    n_layers: int = 1
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, pld_theta=None):
         cfg = self.config
-        x = x + CausalSelfAttention(cfg, name="attn")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
-        x = x + MLP(cfg, name="mlp")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
+        attn = CausalSelfAttention(cfg, name="attn")
+        mlp = MLP(cfg, name="mlp")
+        ln1 = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")
+        ln2 = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")
+
+        if pld_theta is None or deterministic:
+            x = x + attn(ln1(x), deterministic)
+            x = x + mlp(ln2(x), deterministic)
+            return x
+
+        keep_p = 1.0 - (self.layer_idx + 1) / self.n_layers * \
+            (1.0 - pld_theta)
+        coin_a = jax.random.bernoulli(self.make_rng("pld"), keep_p)
+        coin_m = jax.random.bernoulli(self.make_rng("pld"), keep_p)
+        x = jax.lax.cond(coin_a,
+                         lambda h: h + attn(ln1(h), deterministic),
+                         lambda h: h, x)
+        x = jax.lax.cond(coin_m,
+                         lambda h: h + mlp(ln2(h), deterministic),
+                         lambda h: h, x)
         return x
 
 
@@ -129,7 +159,7 @@ class GPT2LMHead(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, deterministic=True):
+    def __call__(self, input_ids, deterministic=True, pld_theta=None):
         cfg = self.config
         B, T = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
@@ -144,7 +174,8 @@ class GPT2LMHead(nn.Module):
         if cfg.remat:
             block_cls = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.n_layer):
-            x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+            x = block_cls(cfg, layer_idx=i, n_layers=cfg.n_layer,
+                          name=f"h_{i}")(x, deterministic, pld_theta)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = x @ wte.T.astype(cfg.dtype)
         return logits
@@ -176,7 +207,7 @@ def make_gpt2_loss_fn(model: GPT2LMHead):
     next-token shift) or explicit ``labels``.
     """
 
-    def loss_fn(params, batch, rng=None):
+    def loss_fn(params, batch, rng=None, pld_theta=None):
         input_ids = batch["input_ids"]
         labels = batch.get("labels")
         if labels is None:
@@ -184,9 +215,13 @@ def make_gpt2_loss_fn(model: GPT2LMHead):
                 [input_ids[:, 1:],
                  jnp.full((input_ids.shape[0], 1), -100, input_ids.dtype)],
                 axis=1)
-        rngs = {"dropout": rng} if rng is not None else {}
+        rngs = {}
+        if rng is not None:
+            d_rng, p_rng = jax.random.split(rng)
+            rngs = {"dropout": d_rng, "pld": p_rng}
         logits = model.apply({"params": params}, input_ids,
-                             deterministic=rng is None, rngs=rngs)
+                             deterministic=rng is None, rngs=rngs,
+                             pld_theta=pld_theta if rng is not None else None)
         return cross_entropy_loss(logits, labels)
 
     return loss_fn

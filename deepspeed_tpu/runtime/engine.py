@@ -132,18 +132,19 @@ def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None):
     def cast_params(p):
         return jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
 
-    def micro_grads(params, micro_batch, rng, scale):
+    def micro_grads(params, micro_batch, rng, scale, loss_kwargs):
         def scaled_loss(p):
-            loss = loss_fn(cast_params(p), micro_batch, rng)
+            loss = loss_fn(cast_params(p), micro_batch, rng, **loss_kwargs)
             return loss * scale, loss
         (_, loss), grads = jax.value_and_grad(
             scaled_loss, has_aux=True)(params)
         return loss, grads
 
-    def accumulate(params, batch, rng, scale):
+    def accumulate(params, batch, rng, scale, loss_kwargs=None):
+        loss_kwargs = loss_kwargs or {}
         if accum == 1:
             micro = jax.tree_util.tree_map(lambda x: x[0], batch)
-            return micro_grads(params, micro, rng, scale)
+            return micro_grads(params, micro, rng, scale, loss_kwargs)
         zeros = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
         if constrain is not None:
@@ -152,7 +153,7 @@ def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None):
         def body(carry, micro):
             g_acc, loss_acc, key = carry
             key, sub = jax.random.split(key)
-            loss, g = micro_grads(params, micro, sub, scale)
+            loss, g = micro_grads(params, micro, sub, scale, loss_kwargs)
             g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
             if constrain is not None:
                 g_acc = constrain(g_acc)
@@ -569,6 +570,24 @@ class DeepSpeedEngine:
         overrides to 1: its microbatching happens inside the pipeline."""
         return self._config.gradient_accumulation_steps
 
+    def _pld_theta_fn(self):
+        """Progressive-layer-drop theta(t) as a pure function of the device
+        step counter, folded into the compiled step. The reference advances
+        theta host-side and injects it into model kwargs every forward
+        (engine.py:791-792, progressive_layer_drop.py:5); here the same
+        schedule evaluates inside jit so no per-step recompile happens."""
+        if not self._config.pld_enabled:
+            return None
+        p = self._config.pld_params or {}
+        theta_bar = float(p.get("theta", 0.5))
+        gamma = float(p.get("gamma", 0.001))
+
+        def theta_fn(step):
+            return (1.0 - theta_bar) * jnp.exp(
+                -gamma * step.astype(jnp.float32)) + theta_bar
+
+        return theta_fn
+
     def _make_train_step(self):
         if self.optimizer_name == ONEBIT_ADAM_OPTIMIZER:
             return self._make_onebit_train_step()
@@ -595,11 +614,14 @@ class DeepSpeedEngine:
             if grad_shardings is not None else None
         accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum,
                                            constrain=grad_constrain)
+        pld_fn = self._pld_theta_fn()
 
         def train_step(params, opt_state, dstate, batch, rng, lr_in):
             scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
                 else jnp.asarray(static_scale, jnp.float32)
-            loss_sum, grads = accumulate(params, batch, rng, scale)
+            loss_kw = {"pld_theta": pld_fn(dstate.global_step)} \
+                if pld_fn is not None else None
+            loss_sum, grads = accumulate(params, batch, rng, scale, loss_kw)
 
             # Unscale + average over microbatches. The reference's
             # prescale_gradients / gradient_predivide_factor knobs
