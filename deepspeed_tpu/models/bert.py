@@ -37,6 +37,9 @@ class BertConfig:
     pre_layer_norm: bool = False      # classic BERT is post-LN
     dtype: Any = jnp.float32
     use_flash_attention: bool = False
+    # SparsityConfig instance → every layer's attention goes block-sparse
+    # (the SparseAttentionUtils adoption path; heads must match).
+    sparse_attention: Optional[Any] = None
 
 
 def bert_base(**kw):
@@ -117,6 +120,7 @@ class BertModel(nn.Module):
         for i in range(cfg.num_hidden_layers):
             x = DeepSpeedTransformerLayer(
                 ds_cfg, use_flash_attention=cfg.use_flash_attention,
+                sparsity_config=cfg.sparse_attention,
                 name=f"layer_{i}")(x, additive_mask, deterministic)
         return x
 

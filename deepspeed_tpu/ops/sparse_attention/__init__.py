@@ -16,3 +16,9 @@ from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
 from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
     SparseSelfAttention,
 )
+from deepspeed_tpu.ops.sparse_attention.bert_sparse_self_attention import (
+    BertSparseSelfAttention,
+)
+from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+    SparseAttentionUtils,
+)

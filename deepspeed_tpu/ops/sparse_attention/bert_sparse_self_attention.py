@@ -1,0 +1,69 @@
+"""BertSparseSelfAttention: drop-in sparse replacement for a BERT
+self-attention sub-module.
+
+Analog of the reference's ``BertSparseSelfAttention``
+(`deepspeed/ops/sparse_attention/bert_sparse_self_attention.py:9-78`):
+BERT-named query/key/value projections feeding the layout-driven
+:class:`SparseSelfAttention` core, taking the standard BERT additive
+attention mask.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention,
+)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    FixedSparsityConfig,
+    SparsityConfig,
+)
+
+
+class BertSparseSelfAttention(nn.Module):
+    """``__call__(hidden_states, attention_mask)`` → context [B, T, H].
+
+    ``hidden_size`` must divide ``num_attention_heads``;
+    ``attention_mask`` is the BERT additive key-padding mask broadcastable
+    to [B, 1, 1, T] (0 keep / large-negative pad), or None.
+    """
+
+    hidden_size: int
+    num_attention_heads: int
+    sparsity_config: Optional[SparsityConfig] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None,
+                 deterministic=True):
+        H = self.hidden_size
+        heads = self.num_attention_heads
+        assert H % heads == 0, (
+            f"hidden_size {H} not a multiple of heads {heads}")
+        hd = H // heads
+        B, T, _ = hidden_states.shape
+        cfg = self.sparsity_config or FixedSparsityConfig(num_heads=heads)
+
+        x = hidden_states.astype(self.dtype)
+        q = nn.Dense(H, dtype=self.dtype, name="query")(x)
+        k = nn.Dense(H, dtype=self.dtype, name="key")(x)
+        v = nn.Dense(H, dtype=self.dtype, name="value")(x)
+
+        def heads_first(t):  # [B, T, H] → [B, heads, T, hd]
+            return t.reshape(B, T, heads, hd).transpose(0, 2, 1, 3)
+
+        key_padding_mask = None
+        if attention_mask is not None:
+            # Collapse the broadcastable additive mask to [B, T] (the
+            # sparse core's key_padding_mask, mode "add").
+            key_padding_mask = jnp.reshape(
+                jnp.broadcast_to(
+                    attention_mask.astype(jnp.float32),
+                    (B, 1, 1, T)), (B, T))
+
+        core = SparseSelfAttention(cfg, key_padding_mask_mode="add")
+        ctx = core(heads_first(q), heads_first(k), heads_first(v),
+                   key_padding_mask=key_padding_mask)
+        return ctx.transpose(0, 2, 1, 3).reshape(B, T, H)

@@ -1,0 +1,161 @@
+"""SparseAttentionUtils: adopt sparse attention in an existing model.
+
+Analog of the reference's ``SparseAttentionUtils``
+(`deepspeed/ops/sparse_attention/sparse_attention_utils.py:13-225`), whose
+capabilities are: extend position embeddings to a longer max length, bump
+the tokenizer's max length, swap a model's self-attention for sparse
+self-attention, and pad/unpad inputs to the sparsity block size.
+
+Functional-JAX differences: models are immutable (config + params pytree),
+so "surgery" returns *new* objects — ``replace_model_self_attention...``
+maps a model to an equivalent one whose config enables sparse attention
+(param shapes are unchanged, so the original params remain valid), and
+``extend_position_embedding`` returns a new params pytree.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import SparsityConfig
+from deepspeed_tpu.utils.logging import logger
+
+POSITION_EMBEDDING_NAMES = ("position_embeddings", "wpe")
+
+
+class SparseAttentionUtils:
+    """Utilities for integrating sparse attention into transformer models
+    (reference class docstring: `sparse_attention_utils.py:14-17`)."""
+
+    @staticmethod
+    def extend_position_embedding(params, max_position):
+        """Return a new params pytree whose position-embedding leaves are
+        extended to ``max_position`` rows by replicating the learned
+        weights (the reference's duplication scheme, which it reports works
+        better than random init, `sparse_attention_utils.py:19-66`).
+
+        Leaves are matched by path name (``position_embeddings`` / ``wpe``)
+        — covers this package's BERT/GPT-2 and HF flax checkpoints.
+        """
+        import jax
+
+        extended = []
+
+        def extend(path, leaf):
+            names = [str(getattr(p, "key", getattr(p, "idx", p)))
+                     for p in path]
+            if leaf.ndim == 2 and any(
+                    n in POSITION_EMBEDDING_NAMES for n in names):
+                orig = leaf.shape[0]
+                if max_position < orig:
+                    raise ValueError(
+                        f"max_position {max_position} < current {orig}")
+                reps = -(-max_position // orig)  # ceil
+                new = jnp.tile(leaf, (reps, 1))[:max_position]
+                extended.append(("/".join(names), orig, max_position))
+                return new
+            return leaf
+
+        new_params = jax.tree_util.tree_map_with_path(extend, params)
+        if not extended:
+            raise ValueError(
+                "no position-embedding leaves found; supported names: "
+                f"{POSITION_EMBEDDING_NAMES}")
+        for name, orig, new in extended:
+            logger.info(f"extended {name}: {orig} -> {new} positions")
+        return new_params
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position):
+        """Bump a (HF-style) tokenizer's max length to ``max_position``
+        (reference `sparse_attention_utils.py:68-83`)."""
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            model, max_position, sparsity_config=None):
+        """Return a model equivalent to ``model`` but with sparse
+        self-attention enabled and positions extended to ``max_position``
+        (reference `sparse_attention_utils.py:85-121`, which mutates HF
+        BERT/RoBERTa layers in place; here config replacement does it for
+        every layer at once — param shapes are unchanged).
+
+        Supported: this package's ``BertModel`` / ``BertForMaskedLM``.
+        """
+        from deepspeed_tpu.models.bert import BertForMaskedLM, BertModel
+
+        if isinstance(model, (BertModel, BertForMaskedLM)):
+            if sparsity_config is None:
+                from deepspeed_tpu.ops.sparse_attention.sparsity_config \
+                    import FixedSparsityConfig
+                sparsity_config = FixedSparsityConfig(
+                    num_heads=model.config.num_attention_heads)
+            assert isinstance(sparsity_config, SparsityConfig)
+            new_cfg = dataclasses.replace(
+                model.config, sparse_attention=sparsity_config,
+                max_position_embeddings=max_position)
+            return type(model)(new_cfg)
+        raise ValueError(
+            f"{type(model).__name__} is not supported: only the in-package "
+            "BERT family can be sparsified (the reference supports HF "
+            "BERT/RoBERTa the same way)")
+
+    @staticmethod
+    def replace_self_attention_layer_with_sparse_self_attention_layer(
+            hidden_size, num_attention_heads, sparsity_config,
+            dtype=jnp.float32):
+        """Build a :class:`BertSparseSelfAttention` layer with the given
+        geometry (reference `sparse_attention_utils.py:123-149`, which
+        rewires each HF layer's ``attention.self``)."""
+        from deepspeed_tpu.ops.sparse_attention.bert_sparse_self_attention \
+            import BertSparseSelfAttention
+
+        return BertSparseSelfAttention(
+            hidden_size=hidden_size,
+            num_attention_heads=num_attention_heads,
+            sparsity_config=sparsity_config,
+            dtype=dtype)
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids, attention_mask=None,
+                          token_type_ids=None, position_ids=None,
+                          inputs_embeds=None, pad_token_id=0):
+        """Pad the sequence dim up to a multiple of ``block_size``
+        (reference `sparse_attention_utils.py:151-208`). Returns
+        ``(pad_len, input_ids, attention_mask, token_type_ids,
+        position_ids, inputs_embeds)`` with None passed through. Padded
+        key positions get ``attention_mask`` 0, so they are masked out.
+        """
+        seq_len = (input_ids if input_ids is not None
+                   else inputs_embeds).shape[1]
+        pad_len = (block_size - seq_len % block_size) % block_size
+        if pad_len == 0:
+            return (0, input_ids, attention_mask, token_type_ids,
+                    position_ids, inputs_embeds)
+
+        def pad2(x, value):
+            if x is None:
+                return None
+            return jnp.pad(x, ((0, 0), (0, pad_len)), constant_values=value)
+
+        input_ids = pad2(input_ids, pad_token_id)
+        attention_mask = pad2(attention_mask, 0)
+        token_type_ids = pad2(token_type_ids, 0)
+        position_ids = pad2(position_ids, 0)
+        if inputs_embeds is not None:
+            inputs_embeds = jnp.pad(
+                inputs_embeds, ((0, 0), (0, pad_len), (0, 0)))
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """Strip the padding added by :meth:`pad_to_block_size`
+        (reference `sparse_attention_utils.py:210-224`)."""
+        if pad_len:
+            sequence_output = sequence_output[:, :-pad_len]
+        return sequence_output

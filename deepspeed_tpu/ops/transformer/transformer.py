@@ -109,6 +109,9 @@ class DeepSpeedTransformerLayer(nn.Module):
 
     config: DeepSpeedTransformerConfig
     use_flash_attention: bool = False
+    # SparsityConfig instance → block-sparse attention core (the
+    # SparseAttentionUtils adoption path; layout heads must match).
+    sparsity_config: Optional[Any] = None
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None,
@@ -164,7 +167,21 @@ class DeepSpeedTransformerLayer(nn.Module):
             q = q.reshape(B, T, heads, hd)
             k = k.reshape(B, T, heads, hd)
             v = v.reshape(B, T, heads, hd)
-            if self.use_flash_attention and attention_mask is None:
+            if self.sparsity_config is not None:
+                from deepspeed_tpu.ops.sparse_attention import (
+                    SparseSelfAttention)
+                core = SparseSelfAttention(self.sparsity_config,
+                                           key_padding_mask_mode="add")
+                kpm = None
+                if attention_mask is not None:
+                    kpm = jnp.reshape(jnp.broadcast_to(
+                        attention_mask.astype(jnp.float32),
+                        (B, 1, 1, T)), (B, T))
+                ctx = core(q.transpose(0, 2, 1, 3),
+                           k.transpose(0, 2, 1, 3),
+                           v.transpose(0, 2, 1, 3),
+                           key_padding_mask=kpm).transpose(0, 2, 1, 3)
+            elif self.use_flash_attention and attention_mask is None:
                 from deepspeed_tpu.ops.pallas.flash_attention import (
                     flash_attention)
                 ctx = flash_attention(q, k, v, causal=False)

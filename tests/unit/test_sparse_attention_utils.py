@@ -1,0 +1,158 @@
+"""Sparse-attention adoption layer (VERDICT r1 missing #2): model surgery
+utils + BertSparseSelfAttention + end-to-end sparse BERT.
+
+Reference contracts: `deepspeed/ops/sparse_attention/sparse_attention_utils.py:19-224`,
+`bert_sparse_self_attention.py:9-78`.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.models.bert import (
+    BertForMaskedLM,
+    BertModel,
+    bert_tiny,
+    init_bert_params,
+    make_bert_mlm_loss_fn,
+)
+from deepspeed_tpu.ops.sparse_attention import (
+    BertSparseSelfAttention,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    SparseAttentionUtils,
+)
+
+
+def test_pad_unpad_roundtrip():
+    ids = jnp.arange(2 * 10, dtype=jnp.int32).reshape(2, 10)
+    mask = jnp.ones((2, 10), jnp.int32)
+    pad_len, pids, pmask, ptok, ppos, pemb = \
+        SparseAttentionUtils.pad_to_block_size(
+            16, ids, attention_mask=mask, pad_token_id=9)
+    assert pad_len == 6
+    assert pids.shape == (2, 16) and int(pids[0, -1]) == 9
+    assert pmask.shape == (2, 16) and int(pmask[0, -1]) == 0
+    assert ptok is None and ppos is None and pemb is None
+
+    out = jnp.ones((2, 16, 4))
+    unp = SparseAttentionUtils.unpad_sequence_output(pad_len, out)
+    assert unp.shape == (2, 10, 4)
+    # no-op when already aligned
+    pad_len2, ids2, *_ = SparseAttentionUtils.pad_to_block_size(5, ids)
+    assert pad_len2 == 0 and ids2 is ids
+
+
+def test_extend_position_embedding_replicates():
+    cfg = bert_tiny()
+    model = BertModel(cfg)
+    params = init_bert_params(model, jax.random.PRNGKey(0))
+    orig = params["embeddings"]["position_embeddings"]
+    new_params = SparseAttentionUtils.extend_position_embedding(params, 160)
+    new = new_params["embeddings"]["position_embeddings"]
+    assert new.shape == (160, orig.shape[1])
+    np.testing.assert_allclose(np.asarray(new[:orig.shape[0]]),
+                               np.asarray(orig))
+    np.testing.assert_allclose(np.asarray(new[orig.shape[0]:2 * orig.shape[0]]),
+                               np.asarray(orig))
+    with pytest.raises(ValueError):
+        SparseAttentionUtils.extend_position_embedding({"x": orig}, 160)
+
+
+def test_update_tokenizer_max_length():
+    class Tok:
+        model_max_length = 512
+        init_kwargs = {}
+
+    tok = SparseAttentionUtils.update_tokenizer_model_max_length(Tok(), 4096)
+    assert tok.model_max_length == 4096
+    assert tok.init_kwargs["model_max_length"] == 4096
+
+
+def test_bert_sparse_self_attention_dense_layout_matches_softmax():
+    """With the dense layout the sparse module must equal plain softmax
+    attention over the same projections."""
+    H, heads, B, T = 32, 2, 2, 64
+    layer = BertSparseSelfAttention(
+        hidden_size=H, num_attention_heads=heads,
+        sparsity_config=DenseSparsityConfig(num_heads=heads, block=16))
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, T, H))
+    params = layer.init(jax.random.PRNGKey(1), x)
+    out = layer.apply(params, x)
+
+    # oracle: same QKV params, standard attention
+    p = params["params"]
+    q = x @ p["query"]["kernel"] + p["query"]["bias"]
+    k = x @ p["key"]["kernel"] + p["key"]["bias"]
+    v = x @ p["value"]["kernel"] + p["value"]["bias"]
+    hd = H // heads
+
+    def hf(t):
+        return t.reshape(B, T, heads, hd).transpose(0, 2, 1, 3)
+
+    att = jnp.einsum("bhtd,bhsd->bhts", hf(q), hf(k)) / np.sqrt(hd)
+    probs = jax.nn.softmax(att, axis=-1)
+    ref = jnp.einsum("bhts,bhsd->bhtd", probs, hf(v))
+    ref = ref.transpose(0, 2, 1, 3).reshape(B, T, H)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_replace_model_with_sparse_self_attention():
+    """The surgery util returns a sparse model the original params slot
+    into; with a dense layout its output matches the original model."""
+    cfg = bert_tiny(max_position_embeddings=64)
+    model = BertForMaskedLM(cfg)
+    params = init_bert_params(model, jax.random.PRNGKey(0))
+
+    sparse_model = SparseAttentionUtils.\
+        replace_model_self_attention_with_sparse_self_attention(
+            model, 64, DenseSparsityConfig(num_heads=4, block=16))
+    assert sparse_model.config.sparse_attention is not None
+
+    ids = jnp.ones((2, 64), jnp.int32)
+    ref = model.apply({"params": params}, ids)
+    got = sparse_model.apply({"params": params}, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    with pytest.raises(ValueError):
+        SparseAttentionUtils.\
+            replace_model_self_attention_with_sparse_self_attention(
+                object(), 64)
+
+
+def test_sparse_bert_trains_end_to_end():
+    """BERT with a truly sparse (fixed) layout trains through the engine —
+    the full adoption path: surgery → pad → train."""
+    import deepspeed_tpu
+
+    cfg = bert_tiny(
+        max_position_embeddings=64,
+        sparse_attention=FixedSparsityConfig(
+            num_heads=4, block=16, num_local_blocks=2,
+            num_global_blocks=1, attention="bidirectional"))
+    model = BertForMaskedLM(cfg)
+    params = init_bert_params(model, jax.random.PRNGKey(0), seq_len=64)
+
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "steps_per_print": 1000}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=config, loss_fn=make_bert_mlm_loss_fn(model), params=params)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 255, (8, 60)).astype(np.int32)
+    # pad to the sparsity block size, as a real adopter would
+    pad_len, ids_p, mask_p, *_ = SparseAttentionUtils.pad_to_block_size(
+        16, jnp.asarray(ids), attention_mask=jnp.ones((8, 60), jnp.int32))
+    assert pad_len == 4
+    labels = np.full((8, 64), -100, np.int64)
+    labels[:, :8] = rng.integers(0, 255, (8, 8))
+    batch = {"input_ids": np.asarray(ids_p),
+             "attention_mask": np.asarray(mask_p),
+             "labels": labels}
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
