@@ -15,6 +15,7 @@ import flax.linen as nn
 
 from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
     SparseSelfAttention,
+    collapse_additive_mask,
 )
 from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
     FixedSparsityConfig,
@@ -25,7 +26,7 @@ from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
 class BertSparseSelfAttention(nn.Module):
     """``__call__(hidden_states, attention_mask)`` → context [B, T, H].
 
-    ``hidden_size`` must divide ``num_attention_heads``;
+    ``num_attention_heads`` must divide ``hidden_size``;
     ``attention_mask`` is the BERT additive key-padding mask broadcastable
     to [B, 1, 1, T] (0 keep / large-negative pad), or None.
     """
@@ -56,12 +57,7 @@ class BertSparseSelfAttention(nn.Module):
 
         key_padding_mask = None
         if attention_mask is not None:
-            # Collapse the broadcastable additive mask to [B, T] (the
-            # sparse core's key_padding_mask, mode "add").
-            key_padding_mask = jnp.reshape(
-                jnp.broadcast_to(
-                    attention_mask.astype(jnp.float32),
-                    (B, 1, 1, T)), (B, T))
+            key_padding_mask = collapse_additive_mask(attention_mask, B, T)
 
         core = SparseSelfAttention(cfg, key_padding_mask_mode="add")
         ctx = core(heads_first(q), heads_first(k), heads_first(v),

@@ -77,12 +77,18 @@ class SparseAttentionUtils:
 
     @staticmethod
     def replace_model_self_attention_with_sparse_self_attention(
-            model, max_position, sparsity_config=None):
+            model, max_position, sparsity_config=None, params=None):
         """Return a model equivalent to ``model`` but with sparse
-        self-attention enabled and positions extended to ``max_position``
-        (reference `sparse_attention_utils.py:85-121`, which mutates HF
-        BERT/RoBERTa layers in place; here config replacement does it for
-        every layer at once — param shapes are unchanged).
+        self-attention enabled and ``max_position`` positions (reference
+        `sparse_attention_utils.py:85-121`, which mutates HF BERT/RoBERTa
+        layers in place; here config replacement does it for every layer
+        at once — attention param shapes are unchanged).
+
+        Pass ``params`` to also get a matching params pytree back —
+        ``(model, params)`` — with the position embeddings extended via
+        :meth:`extend_position_embedding`. Without ``params``, the caller
+        must extend any existing params themselves before applying the
+        returned model beyond their original position count.
 
         Supported: this package's ``BertModel`` / ``BertForMaskedLM``.
         """
@@ -98,7 +104,13 @@ class SparseAttentionUtils:
             new_cfg = dataclasses.replace(
                 model.config, sparse_attention=sparsity_config,
                 max_position_embeddings=max_position)
-            return type(model)(new_cfg)
+            new_model = type(model)(new_cfg)
+            if params is not None:
+                if max_position > model.config.max_position_embeddings:
+                    params = SparseAttentionUtils.extend_position_embedding(
+                        params, max_position)
+                return new_model, params
+            return new_model
         raise ValueError(
             f"{type(model).__name__} is not supported: only the in-package "
             "BERT family can be sparsified (the reference supports HF "

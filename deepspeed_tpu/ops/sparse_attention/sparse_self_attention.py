@@ -18,6 +18,15 @@ from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
 )
 
 
+def collapse_additive_mask(attention_mask, B, T):
+    """BERT-style additive mask broadcastable to [B, 1, 1, T] → the [B, T]
+    key-padding mask the sparse core consumes (mode "add"). Shared by the
+    sparse entry points so mask semantics can't diverge."""
+    return jnp.reshape(
+        jnp.broadcast_to(attention_mask.astype(jnp.float32),
+                         (B, 1, 1, T)), (B, T))
+
+
 class SparseSelfAttention:
     """Efficient sparse self attention (Generative Modeling with Sparse
     Transformers, arXiv:1904.10509).

@@ -170,13 +170,13 @@ class DeepSpeedTransformerLayer(nn.Module):
             if self.sparsity_config is not None:
                 from deepspeed_tpu.ops.sparse_attention import (
                     SparseSelfAttention)
+                from deepspeed_tpu.ops.sparse_attention.\
+                    sparse_self_attention import collapse_additive_mask
                 core = SparseSelfAttention(self.sparsity_config,
                                            key_padding_mask_mode="add")
                 kpm = None
                 if attention_mask is not None:
-                    kpm = jnp.reshape(jnp.broadcast_to(
-                        attention_mask.astype(jnp.float32),
-                        (B, 1, 1, T)), (B, T))
+                    kpm = collapse_additive_mask(attention_mask, B, T)
                 ctx = core(q.transpose(0, 2, 1, 3),
                            k.transpose(0, 2, 1, 3),
                            v.transpose(0, 2, 1, 3),

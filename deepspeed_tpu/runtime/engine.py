@@ -132,7 +132,16 @@ def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None):
     def cast_params(p):
         return jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
 
+    # A loss_fn may carry a hand-written (loss, grads) implementation that
+    # cannot be expressed as jax.grad of a scalar function — the executed
+    # 1F1B pipeline (pipe/pipeline.py:make_pipeline_value_and_grad_fn)
+    # interleaves forward and backward ticks, which AD cannot.
+    direct = getattr(loss_fn, "direct_value_and_grad", None)
+
     def micro_grads(params, micro_batch, rng, scale, loss_kwargs):
+        if direct is not None:
+            return direct(params, micro_batch, rng, scale, **loss_kwargs)
+
         def scaled_loss(p):
             loss = loss_fn(cast_params(p), micro_batch, rng, **loss_kwargs)
             return loss * scale, loss

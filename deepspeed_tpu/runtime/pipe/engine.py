@@ -2,12 +2,16 @@
 
 Analog of the reference's ``PipelineEngine`` (`runtime/pipe/engine.py:152` —
 ``train_batch``:229, ``eval_batch``:305, ``_exec_schedule``:1144). The
-reference interprets instruction lists per rank; here the whole 1F1B train
-batch compiles into one XLA program (see `runtime/pipe/pipeline.py`): the
-forward wavefront is a ``lax.scan`` of stage computations + ``ppermute``
-rotations, and the backward pipeline is its derivative. The instruction
-schedules in `runtime/pipe/schedule.py` remain the introspectable
-specification of that order.
+reference interprets instruction lists per rank; here the whole train
+batch compiles into one XLA program (see `runtime/pipe/pipeline.py`).
+Training executes the hand-scheduled **1F1B** interleave
+(``make_pipeline_value_and_grad_fn``: forward and backward ticks in one
+``lax.scan``, O(num_stages) activation memory independent of the
+microbatch count — the buffer bound of reference `schedule.py:243-247`,
+proven by ``test_pipe.py::test_1f1b_memory_independent_of_microbatches``);
+eval runs the forward-only GPipe wavefront. The instruction schedules in
+`runtime/pipe/schedule.py` remain the introspectable specification of the
+executed order.
 
 Everything else — optimizer, ZeRO shardings of the per-stage params, mixed
 precision, dynamic loss scale, checkpointing — is inherited from
@@ -16,6 +20,7 @@ internals shard compute over ``pipe``.
 """
 
 import jax
+import jax.numpy as jnp
 
 from deepspeed_tpu.parallel.mesh import build_mesh
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
@@ -24,6 +29,7 @@ from deepspeed_tpu.runtime.pipe.module import PipelineModule
 from deepspeed_tpu.runtime.pipe.pipeline import (
     build_pipeline_parts,
     make_pipeline_loss_fn,
+    make_pipeline_value_and_grad_fn,
 )
 from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule, InferenceSchedule
 from deepspeed_tpu.utils.logging import log_dist
@@ -101,6 +107,14 @@ class PipelineEngine(DeepSpeedEngine):
         loss_fn = make_pipeline_loss_fn(
             self.pipeline_parts, mesh, self.micro_batches,
             remat=model.activation_checkpoint_interval > 0)
+        # Training runs the hand-scheduled 1F1B (loss, grads) program —
+        # O(num_stages) activation memory independent of micro_batches;
+        # the GPipe loss above remains the eval/forward-only path.
+        compute_dtype = jnp.bfloat16 if probe.bf16_enabled else (
+            jnp.float16 if probe.fp16_enabled else None)
+        loss_fn.direct_value_and_grad = make_pipeline_value_and_grad_fn(
+            self.pipeline_parts, mesh, self.micro_batches,
+            compute_dtype=compute_dtype)
 
         super().__init__(args=args,
                          model=model,

@@ -3,11 +3,19 @@
 The reference interprets instruction lists rank-by-rank, sending activations
 through 2-rank NCCL groups (`runtime/pipe/engine.py:1144`, `pipe/p2p.py`).
 The TPU-native execution model compiles the whole train batch into ONE XLA
-program: stages live at coordinates of the ``pipe`` mesh axis, microbatch
-activations rotate stage-to-stage with ``lax.ppermute`` over ICI, and the
-backward pipeline falls out of differentiating the rotation (ppermute's
-transpose is the reverse rotation — exactly SendGrad/RecvGrad of the
-instruction ISA in `schedule.py`).
+program: stages live at coordinates of the ``pipe`` mesh axis and
+microbatch activations rotate stage-to-stage with ``lax.ppermute`` over
+ICI. Two programs are provided:
+
+- :func:`make_pipeline_loss_fn` — a GPipe fill-drain wavefront; the
+  backward falls out of AD (ppermute's transpose is the reverse rotation).
+  Used for eval/forward-only, and differentiable for tests — but AD runs
+  all forwards before any backward, so its train memory is O(M) per stage.
+- :func:`make_pipeline_value_and_grad_fn` — the executed **1F1B**
+  schedule: one scan interleaving forward and backward ticks with an
+  O(S) activation ring buffer independent of M (the instruction ISA of
+  `schedule.py`, executed). This is what :class:`PipelineEngine` trains
+  with.
 
 Model contract: a :class:`~deepspeed_tpu.runtime.pipe.module.PipelineModule`
 whose specs decompose as ``prologue + body + epilogue``:
@@ -390,35 +398,285 @@ def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
             loss = lax.pmean(loss, axis_tail)
         return loss
 
-    batch_sharding = NamedSharding(mesh, P(None, "data"))
-
     def pipeline_loss(params, batch, rng):
-        def to_micro(a):
-            rows = a.shape[0]
-            assert rows % M == 0, (
-                f"batch rows {rows} not divisible by {M} microbatches")
-            return a.reshape((M, rows // M) + a.shape[1:])
-
-        batch_m = jax.tree_util.tree_map(to_micro, batch)
-        batch_m = jax.tree_util.tree_map(
-            lambda a: lax.with_sharding_constraint(a, batch_sharding),
-            batch_m)
-        rest = {k: params[k] for k in ("prologue", "epilogue", "tied")}
-        use_rng = rng is not None
-        key = rng if use_rng else jnp.zeros((2,), jnp.uint32)
-
-        body_specs = jax.tree_util.tree_map(
-            lambda a: P("pipe", *([None] * (a.ndim - 1))), params["body"])
-        rest_specs = jax.tree_util.tree_map(lambda _: P(), rest)
-        batch_specs = jax.tree_util.tree_map(
-            lambda _: P(None, "data"), batch_m)
-
-        fn = jax.shard_map(
-            partial(device_fn, use_rng=use_rng),
-            mesh=mesh,
-            in_specs=(body_specs, rest_specs, batch_specs, P()),
-            out_specs=P(),
-            check_vma=False)
-        return fn(params["body"], rest, batch_m, key)
+        return _call_pipeline(mesh, M, device_fn, params, batch, rng,
+                              out_specs=lambda body_specs, rest_specs: P())
 
     return pipeline_loss
+
+
+def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
+                   out_specs=None):
+    """Shared shard_map wrapper for the pipeline programs: microbatch the
+    batch rows, split off the replicated param groups, build the in/out
+    specs, and invoke ``device_fn`` over the mesh. ``out_specs`` is a
+    callable of (body_specs, rest_specs) so callers returning grads can
+    reuse the input layouts."""
+    batch_sharding = NamedSharding(mesh, P(None, "data"))
+
+    def to_micro(a):
+        rows = a.shape[0]
+        assert rows % M == 0, (
+            f"batch rows {rows} not divisible by {M} microbatches")
+        return a.reshape((M, rows // M) + a.shape[1:])
+
+    batch_m = jax.tree_util.tree_map(to_micro, batch)
+    batch_m = jax.tree_util.tree_map(
+        lambda a: lax.with_sharding_constraint(a, batch_sharding),
+        batch_m)
+    rest = {k: params[k] for k in ("prologue", "epilogue", "tied")}
+    use_rng = rng is not None
+    key = rng if use_rng else jnp.zeros((2,), jnp.uint32)
+
+    body_specs = jax.tree_util.tree_map(
+        lambda a: P("pipe", *([None] * (a.ndim - 1))), params["body"])
+    rest_specs = jax.tree_util.tree_map(lambda _: P(), rest)
+    batch_specs = jax.tree_util.tree_map(
+        lambda _: P(None, "data"), batch_m)
+
+    fn = jax.shard_map(
+        partial(device_fn, use_rng=use_rng),
+        mesh=mesh,
+        in_specs=(body_specs, rest_specs, batch_specs, P()) +
+        tuple(P() for _ in extra),
+        out_specs=out_specs(body_specs, rest_specs),
+        check_vma=False)
+    return fn(params["body"], rest, batch_m, key, *extra)
+
+
+# ---------------------------------------------------------------------------
+# executed 1F1B: interleaved forward/backward in ONE compiled scan
+# ---------------------------------------------------------------------------
+def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
+                                    num_micro: int, compute_dtype=None):
+    """Build ``vag(params, batch, rng, scale) -> (loss, grads)`` running a
+    hand-scheduled 1F1B pipeline (the reference's ``TrainSchedule``
+    interleave, `runtime/pipe/schedule.py:189-241`, executed rather than
+    differentiated).
+
+    Why not ``jax.grad`` of the GPipe rotation: AD runs every forward tick
+    before any backward tick, so each stage must hold O(M) microbatch
+    activations (the blow-up 1F1B exists to prevent — reference buffer
+    bound `runtime/pipe/schedule.py:243-247`). Here one ``lax.scan`` over
+    ``M + 2S - 2`` ticks interleaves them: at tick ``t`` stage ``s``
+    forwards microbatch ``t - s`` and backwards microbatch
+    ``t - (2S - 2 - s)`` — the cotangent for microbatch ``m`` reaches stage
+    ``s`` exactly ``2(S-1-s)+1`` ticks after its forward, so a ring buffer
+    of ``2S - 1`` stage-input activations suffices **independent of M**.
+    Stage internals rematerialize in the backward (one ``jax.vjp`` per
+    tick), the Megatron-style full-recompute tradeoff.
+
+    Gradient scaling: backward seeds are 1.0 per microbatch loss-sum; the
+    final grads are scaled by ``scale / total_weight`` (weighted losses) or
+    ``scale / (M * |data|)`` — weights (token counts) don't depend on
+    params, so this equals grad of ``scale * mean_loss``.
+    """
+    S = parts.num_stages
+    M = num_micro
+    T = M + 2 * S - 2
+    K = 2 * S - 1
+    axis_tail = tuple(a for a in mesh.axis_names
+                      if a not in ("pipe", "data"))
+    f32 = jnp.float32
+
+    def cast(tree):
+        if compute_dtype is None:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+    def device_fn(body_local, rest, batch_local, rng, scale, use_rng):
+        body_local = jax.tree_util.tree_map(lambda a: a[0], body_local)
+        s = lax.axis_index("pipe")
+
+        def micro_at(m):
+            return jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+                batch_local)
+
+        def mb_rng(m, section):
+            if not use_rng:
+                return None
+            key = jax.random.fold_in(jax.random.fold_in(rng, m), s)
+            return jax.random.fold_in(key, section)
+
+        def stage_fwd(body, x, key):
+            if not use_rng:
+                def layer(x, lp):
+                    return parts.body_apply(cast(lp), x, None), None
+                x, _ = lax.scan(layer, x, body)
+                return x
+
+            def layer(carry, lp):
+                x, k = carry
+                k, sub = jax.random.split(k)
+                return (parts.body_apply(cast(lp), x, sub), k), None
+            (x, _), _ = lax.scan(layer, (x, key if key is not None
+                                         else jnp.zeros((2,), jnp.uint32)),
+                                 body)
+            return x
+
+        def prologue(r, m):
+            return parts.prologue_apply(cast(r), micro_at(m), mb_rng(m, 0))
+
+        act = jax.eval_shape(lambda r: prologue(r, 0), rest)
+        zeros_act = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype), act)
+        loss_probe = jax.eval_shape(
+            lambda r, xx: parts.loss_fn(
+                parts.epilogue_apply(cast(r), xx, None), micro_at(0)),
+            rest, act)
+        weighted = isinstance(loss_probe, tuple)
+
+        zeros_body_g = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, f32), body_local)
+        zeros_rest_g = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, f32), rest)
+
+        def as_pair(res):
+            if weighted:
+                num, den = res
+                return num.astype(f32), den.astype(f32)
+            return res.astype(f32), jnp.asarray(1.0, f32)
+
+        def last_vjp(x_in, m):
+            """Full vjp through stage_fwd → epilogue → loss at the last
+            stage; seeds the backward wave."""
+            def f(b, r, xx):
+                y = stage_fwd(b, xx, mb_rng(m, 1))
+                out = parts.epilogue_apply(cast(r), y, mb_rng(m, 2))
+                return as_pair(parts.loss_fn(out, micro_at(m)))
+            (num, den), vjp = jax.vjp(f, body_local, rest, x_in)
+            # Seed with the loss scale so fp16 cotangents ride above the
+            # underflow floor through the whole backward (the reference
+            # scales the loss before backprop; scaling only at the end in
+            # fp32 would make dynamic loss scaling a numeric no-op).
+            gb, gr, gx = vjp((scale.astype(f32), jnp.asarray(0.0, f32)))
+            return gb, gr, gx, num, den
+
+        def mid_vjp(x_in, g, m):
+            def f(b, xx):
+                return stage_fwd(b, xx, mb_rng(m, 1))
+            _, vjp = jax.vjp(f, body_local, x_in)
+            gb, gx = vjp(g)
+            return (gb, zeros_rest_g, gx, jnp.asarray(0.0, f32),
+                    jnp.asarray(0.0, f32))
+
+        def prologue_vjp(gx, m):
+            _, vjp = jax.vjp(lambda r: prologue(r, m), rest)
+            (gr,) = vjp(gx)
+            return gr
+
+        def tick(carry, t):
+            x_recv, g_recv, buf, gb_acc, gr_acc, num_acc, den_acc = carry
+
+            # ---- forward half: microbatch mf = t - s -----------------
+            mf = t - s
+            valid_f = (mf >= 0) & (mf < M)
+            mf_c = jnp.clip(mf, 0, M - 1)
+            x_in = lax.cond(
+                valid_f,
+                lambda: lax.cond(s == 0,
+                                 lambda: prologue(rest, mf_c),
+                                 lambda: x_recv),
+                lambda: zeros_act)
+            slot_f = mf_c % K
+            buf = lax.cond(
+                valid_f,
+                lambda: jax.tree_util.tree_map(
+                    lambda b, xi: lax.dynamic_update_index_in_dim(
+                        b, xi, slot_f, 0), buf, x_in),
+                lambda: buf)
+            # only stages that send forward need y this half (the last
+            # stage consumes its x_in in the backward half, same tick)
+            y = lax.cond(valid_f & (s < S - 1),
+                         lambda: stage_fwd(body_local, x_in, mb_rng(mf_c, 1)),
+                         lambda: zeros_act)
+            x_next = jax.tree_util.tree_map(
+                lambda a: lax.ppermute(
+                    a, "pipe", [(i, (i + 1) % S) for i in range(S)]), y)
+
+            # ---- backward half: microbatch mb = t - (2S-2-s) ---------
+            mb_ = t - (2 * S - 2 - s)
+            valid_b = (mb_ >= 0) & (mb_ < M)
+            mb_c = jnp.clip(mb_, 0, M - 1)
+            x_b = jax.tree_util.tree_map(
+                lambda b: lax.dynamic_index_in_dim(b, mb_c % K, 0,
+                                                   keepdims=False), buf)
+
+            def do_bwd():
+                gb, gr, gx, num, den = lax.cond(
+                    s == S - 1,
+                    lambda: last_vjp(x_b, mb_c),
+                    lambda: mid_vjp(x_b, g_recv, mb_c))
+                gr = lax.cond(
+                    s == 0,
+                    lambda: jax.tree_util.tree_map(
+                        jnp.add, gr, prologue_vjp(gx, mb_c)),
+                    lambda: gr)
+                return gb, gr, gx, num, den
+
+            def no_bwd():
+                return (zeros_body_g, zeros_rest_g, zeros_act,
+                        jnp.asarray(0.0, f32), jnp.asarray(0.0, f32))
+
+            gb, gr, gx, num, den = lax.cond(valid_b, do_bwd, no_bwd)
+            gb_acc = jax.tree_util.tree_map(jnp.add, gb_acc, gb)
+            gr_acc = jax.tree_util.tree_map(jnp.add, gr_acc, gr)
+            num_acc = num_acc + num
+            den_acc = den_acc + den
+            g_next = jax.tree_util.tree_map(
+                lambda a: lax.ppermute(
+                    a, "pipe", [(i, (i - 1) % S) for i in range(S)]), gx)
+            return (x_next, g_next, buf, gb_acc, gr_acc, num_acc,
+                    den_acc), None
+
+        buf0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros((K,) + a.shape, a.dtype), zeros_act)
+        zero_f = jnp.asarray(0.0, f32)
+        carry0 = (zeros_act, zeros_act, buf0, zeros_body_g, zeros_rest_g,
+                  zero_f, zero_f)
+        (_, _, _, gb_acc, gr_acc, num_sum, den_sum), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+
+        # ---- reductions + scaling --------------------------------------
+        # (the loss scale is already in the accumulated grads via the vjp
+        # seed; here only the mean-normalization divides through, in fp32)
+        if weighted:
+            D = lax.psum(lax.psum(den_sum, "pipe"), "data")
+            D = jnp.maximum(D, 1.0)
+            loss = lax.psum(lax.psum(num_sum, "pipe"), "data") / D
+            gscale = 1.0 / D
+        else:
+            n_data = lax.axis_size("data")
+            loss = lax.pmean(lax.psum(num_sum, "pipe") / M, "data")
+            gscale = 1.0 / (M * n_data)
+        # body grads stay pipe-sharded; rest grads sum across the stages
+        # that touched them (the tied-weight allreduce, module.py:405-474)
+        gb_acc = jax.tree_util.tree_map(
+            lambda a: lax.psum(a, "data") * gscale, gb_acc)
+        gr_acc = jax.tree_util.tree_map(
+            lambda a: lax.psum(lax.psum(a, "pipe"), "data") * gscale,
+            gr_acc)
+        if axis_tail:
+            loss = lax.pmean(loss, axis_tail)
+            gb_acc = jax.tree_util.tree_map(
+                lambda a: lax.pmean(a, axis_tail), gb_acc)
+            gr_acc = jax.tree_util.tree_map(
+                lambda a: lax.pmean(a, axis_tail), gr_acc)
+        # restore the leading stage dim the shard_map out_spec strips
+        gb_acc = jax.tree_util.tree_map(lambda a: a[None], gb_acc)
+        return loss, gb_acc, gr_acc
+
+    def pipeline_value_and_grad(params, batch, rng, scale):
+        loss, gb, gr = _call_pipeline(
+            mesh, M, device_fn, params, batch, rng,
+            extra=(jnp.asarray(scale, jnp.float32),),
+            out_specs=lambda body_specs, rest_specs: (P(), body_specs,
+                                                      rest_specs))
+        grads = {"prologue": gr["prologue"], "body": gb,
+                 "epilogue": gr["epilogue"], "tied": gr["tied"]}
+        return loss, grads
+
+    return pipeline_value_and_grad
