@@ -1,0 +1,133 @@
+"""Tensor parallelism as a tested capability (VERDICT r1 item 10).
+
+Numerics: the TP-sharded block on a model=2 mesh must produce the SAME
+loss and gradients as the replicated oracle — sharding is a layout, not a
+math change. Plus model-level TP via gpt2_partition_specs through the
+engine.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.parallel.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    TPTransformerBlock,
+    partition_specs,
+    unbox_params,
+)
+
+
+def test_partition_specs_extracted_from_metadata():
+    block = TPTransformerBlock(n_head=4)
+    x = jnp.zeros((2, 8, 32))
+    variables = block.init(jax.random.PRNGKey(0), x)
+    specs = partition_specs(variables["params"])
+    params = unbox_params(variables["params"])
+    assert specs["attn"]["c_attn"]["kernel"] == P(None, "model")
+    assert specs["attn"]["c_proj"]["kernel"] == P("model", None)
+    assert specs["mlp"]["c_fc"]["kernel"] == P(None, "model")
+    assert specs["mlp"]["c_proj"]["kernel"] == P("model", None)
+    assert specs["ln_1"]["scale"] == P()
+    # unboxed params are raw arrays with matching shapes
+    assert params["attn"]["c_attn"]["kernel"].shape == (32, 96)
+
+
+def test_column_row_pair_matches_dense():
+    """column→row composition == one dense two-layer MLP (the psum GSPMD
+    inserts after the row-parallel matmul restores the full product)."""
+    mesh = build_mesh({"model": 4, "data": 2})
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+
+    col = ColumnParallelLinear(64, name="c")
+    row = RowParallelLinear(16, name="r")
+
+    cv = col.init(jax.random.PRNGKey(1), x)
+    rv = row.init(jax.random.PRNGKey(2), jnp.zeros((4, 64)))
+    cp, rp = unbox_params(cv["params"]), unbox_params(rv["params"])
+
+    def f(cp, rp, x):
+        return row.apply({"params": rp}, col.apply({"params": cp}, x))
+
+    ref = f(cp, rp, x)
+
+    cs = jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        cp, partition_specs(cv["params"]))
+    rs = jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        rp, partition_specs(rv["params"]))
+    got = jax.jit(f)(cs, rs, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_tp_block_loss_and_grads_match_replicated_oracle():
+    """Loss AND grads of the TP block on a dp×tp mesh == the replicated
+    single-device oracle (the reference's mpu contract, engine.py:513-524,
+    as a verified numerics property)."""
+    mesh = build_mesh({"model": 2, "data": 4})
+    block = TPTransformerBlock(n_head=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 16, 32))
+    variables = block.init(jax.random.PRNGKey(1), x)
+    params = unbox_params(variables["params"])
+    specs = partition_specs(variables["params"])
+
+    def loss_fn(p, x):
+        y = block.apply({"params": p}, x)
+        return jnp.mean(y.astype(jnp.float32) ** 2)
+
+    ref_loss, ref_grads = jax.value_and_grad(loss_fn)(params, x)
+
+    placed = jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        params, specs)
+    x_placed = jax.device_put(
+        x, NamedSharding(mesh, P("data", None, None)))
+    tp_loss, tp_grads = jax.jit(jax.value_and_grad(loss_fn))(placed,
+                                                             x_placed)
+
+    np.testing.assert_allclose(float(tp_loss), float(ref_loss), rtol=1e-5)
+    flat_t, _ = jax.tree_util.tree_flatten_with_path(tp_grads)
+    flat_r = jax.tree_util.tree_leaves(ref_grads)
+    for (path, a), b in zip(flat_t, flat_r):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
+        # sharded leaves really are sharded
+    qkv = tp_grads["attn"]["c_attn"]["kernel"]
+    assert not qkv.sharding.is_fully_replicated
+
+
+def test_gpt2_tp_training_matches_dp_through_engine():
+    """Model-level TP: GPT-2 trained with Megatron-style specs on a
+    model=2 mesh gives the same losses as pure data parallelism."""
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2LMHead, gpt2_partition_specs, gpt2_tiny, init_gpt2_params,
+        make_gpt2_loss_fn)
+
+    cfg_model = gpt2_tiny(dtype=jnp.float32)
+    model = GPT2LMHead(cfg_model)
+    base_params = init_gpt2_params(model, jax.random.PRNGKey(0))
+    loss_fn = make_gpt2_loss_fn(model)
+    config = {"train_batch_size": 8,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "steps_per_print": 1000}
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 255, (8, 16)).astype(np.int32)}
+
+    def run(mesh, specs):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=config, loss_fn=loss_fn, params=base_params,
+            param_specs=specs, mesh=mesh)
+        return [float(engine.train_batch(batch)) for _ in range(5)]
+
+    dp_losses = run(build_mesh({"data": 8}), None)
+    tp_losses = run(build_mesh({"model": 2, "data": 4}),
+                    gpt2_partition_specs(base_params))
+    np.testing.assert_allclose(tp_losses, dp_losses, rtol=2e-4)
