@@ -76,6 +76,8 @@ def debug_report(out=sys.stdout):
 def main(out=sys.stdout):
     op_report(out=out)
     debug_report(out=out)
+    from deepspeed_tpu.utils.profiler import device_report
+    device_report(out=out)
 
 
 if __name__ == "__main__":

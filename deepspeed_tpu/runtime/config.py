@@ -335,6 +335,10 @@ class DeepSpeedConfig:
             param_dict, WALL_CLOCK_BREAKDOWN, WALL_CLOCK_BREAKDOWN_DEFAULT)
         self.memory_breakdown = get_scalar_param(param_dict, MEMORY_BREAKDOWN,
                                                  MEMORY_BREAKDOWN_DEFAULT)
+        # device-time profiling window (jax.profiler trace; SURVEY §5.1's
+        # xprof equivalent) — {"trace_dir", "trace_start_step",
+        # "trace_num_steps"}
+        self.profiling_params = param_dict.get("profiling", None)
         if TENSORBOARD in param_dict:
             tb = param_dict[TENSORBOARD]
             self.tensorboard_enabled = get_scalar_param(tb, TENSORBOARD_ENABLED,

@@ -19,6 +19,7 @@ global batch).
 
 import os
 import json
+import time
 from typing import Any, Callable, NamedTuple, Optional
 
 import numpy as np
@@ -309,6 +310,9 @@ class DeepSpeedEngine:
             self._config.gradient_accumulation_steps,
             num_workers=self.dp_world_size,
             steps_per_output=self._config.steps_per_print)
+        from deepspeed_tpu.utils.profiler import TraceProfiler
+        self.trace_profiler = TraceProfiler(
+            **(self._config.profiling_params or {}))
         self.summary_writer = None
         if self._config.tensorboard_enabled and jax.process_index() == 0:
             self.summary_writer = self._get_summary_writer()
@@ -1011,6 +1015,9 @@ class DeepSpeedEngine:
             self._compiled_train_step = self._make_offload_grad_step() \
                 if self._offload else self._make_train_step()
 
+        self.trace_profiler.before_step(self.global_steps)
+        step_t0 = time.time() if (self.wall_clock_breakdown() or
+                                  self.trace_profiler.enabled) else None
         if self.wall_clock_breakdown():
             self.timers("train_batch").start()
         self.tput_timer.start()
@@ -1029,6 +1036,15 @@ class DeepSpeedEngine:
             self.timers("train_batch").stop()
             self.timers.log(["train_batch"],
                             memory_breakdown=self.memory_breakdown())
+        if step_t0 is not None:
+            # timers above synchronized (effects_barrier), so this wall
+            # delta is the per-step device-time-inclusive duration
+            if not self.wall_clock_breakdown():
+                jax.effects_barrier()
+            self.trace_profiler.after_step(self.global_steps,
+                                           time.time() - step_t0)
+        else:
+            self.trace_profiler.after_step(self.global_steps)
 
         # Only inspect the (device-resident) truncation metric on the first
         # step and at print boundaries — float() here would otherwise force
@@ -1061,6 +1077,12 @@ class DeepSpeedEngine:
             log_dist(f"step={self.global_steps}, skipped="
                      f"{self.skipped_steps}, lr={lr:.6g}, loss={loss:.5f}",
                      ranks=[0])
+            summ = self.trace_profiler.summary()
+            if summ is not None:
+                mean_s, min_s, max_s = summ
+                log_dist(f"device step time: mean={mean_s * 1e3:.1f}ms "
+                         f"min={min_s * 1e3:.1f}ms max={max_s * 1e3:.1f}ms",
+                         ranks=[0])
         if self.summary_writer is not None:
             self.summary_writer.add_scalar("Train/loss",
                                            float(metrics["loss"]),
