@@ -1,0 +1,75 @@
+"""Checkpoint-resume curve continuity (reference
+`tests/model/Megatron_GPT2/run_checkpoint_test.py`, 574 LoC): train N
+steps, save at N/2, resume in a fresh engine, and require the resumed
+curve to continue the uninterrupted one exactly."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import (
+    GPT2LMHead, gpt2_tiny, init_gpt2_params, make_gpt2_loss_fn)
+from tests.model.common import assert_curves_close, base_gpt2_config, \
+    fixed_batch
+
+pytestmark = pytest.mark.model
+
+
+def make_engine(config, seed=0):
+    model = GPT2LMHead(gpt2_tiny())
+    params = init_gpt2_params(model, jax.random.PRNGKey(seed))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=config, loss_fn=make_gpt2_loss_fn(model), params=params)
+    return engine
+
+
+@pytest.mark.parametrize("config_overrides", [
+    {},
+    {"bf16": {"enabled": True}, "zero_optimization": {"stage": 2}},
+    {"fp16": {"enabled": True, "initial_scale_power": 8},
+     "zero_optimization": {"stage": 1}},
+], ids=["fp32", "bf16-zero2", "fp16-zero1"])
+def test_resume_continues_curve(tmp_path, config_overrides):
+    config = base_gpt2_config(**config_overrides)
+    batch = fixed_batch()
+    total, half = 40, 20
+
+    # uninterrupted run
+    e_full = make_engine(config)
+    full_curve = [float(e_full.train_batch(batch)) for _ in range(total)]
+
+    # interrupted run: train half, save, resume in a FRESH engine
+    e_a = make_engine(config)
+    first_half = [float(e_a.train_batch(batch)) for _ in range(half)]
+    ckpt = str(tmp_path / "ckpt")
+    e_a.save_checkpoint(ckpt, tag="mid")
+
+    e_b = make_engine(config, seed=123)   # different init — must not matter
+    e_b.load_checkpoint(ckpt, tag="mid")
+    assert e_b.global_steps == half
+    second_half = [float(e_b.train_batch(batch)) for _ in range(total - half)]
+
+    assert_curves_close(full_curve[:half], first_half, rtol=0.0,
+                        name="pre-save")
+    # post-resume: bit-exact module state; rng stream is engine-local so
+    # allow tiny drift only for stochastic paths (none here → exact)
+    assert_curves_close(full_curve[half:], second_half, rtol=1e-6,
+                        name="post-resume")
+
+
+def test_resume_restores_loss_scale_and_counters(tmp_path):
+    config = base_gpt2_config(
+        fp16={"enabled": True, "initial_scale_power": 10})
+    batch = fixed_batch()
+    e = make_engine(config)
+    for _ in range(10):
+        e.train_batch(batch)
+    scale_before = float(e.loss_scale)
+    e.save_checkpoint(str(tmp_path), tag="s")
+
+    e2 = make_engine(config, seed=9)
+    e2.load_checkpoint(str(tmp_path), tag="s")
+    assert e2.global_steps == 10
+    assert float(e2.loss_scale) == scale_before
