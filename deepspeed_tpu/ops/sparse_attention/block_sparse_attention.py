@@ -13,14 +13,15 @@ built from the ``SparsityConfig`` layout) with online-softmax accumulation,
 so the sparse score matrix never exists in memory at all. Two
 implementations share the LUT:
 
-- ``pallas``: TPU kernel; the LUT rides in SMEM via scalar prefetch and
-  drives the k/v block index maps, acc/m/l accumulate in VMEM scratch.
+- ``pallas``: TPU kernels, forward AND backward; the LUT rides in SMEM
+  via scalar prefetch and drives the k/v block index maps, accumulators
+  live in VMEM scratch. The backward is the FlashAttention-2 split — dQ
+  walks the forward LUT, dK/dV walks a transposed LUT (each k-block's
+  nonzero q-blocks) — wired through ``jax.custom_vjp``.
 - ``xla``: per-head gather of the LUT's k/v blocks + masked softmax —
   runs everywhere (CPU test meshes), natively differentiable, and carries
   the rpe / key-padding-mask / attention-mask features of the reference
   softmax kernel.
-
-The pallas forward pairs with the xla backward through ``jax.custom_vjp``.
 """
 
 import functools
@@ -157,10 +158,16 @@ def _gather_attn(attn_add, lut_h, block, nq):
 
 
 # ---------------------------------------------------------------------------
-# Pallas TPU kernel (no-mask fast path)
+# Pallas TPU kernels (no-mask fast path), forward + backward
 # ---------------------------------------------------------------------------
 
-def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale, interpret=False):
+_LANES = 128  # lane-broadcast pad for per-row scalars (lse/delta blocks)
+
+
+def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
+                 interpret=False):
+    """Returns (out [B,T,H,D], lse [B*H,T,_LANES]) — the logsumexp residual
+    feeds the backward kernels."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -176,7 +183,7 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale, interpret=False):
     lut_flat = jnp.asarray(lut.reshape(H * nq * max_nnz), jnp.int32)
     nnz_flat = jnp.asarray(nnz.reshape(H * nq), jnp.int32)
 
-    def kernel(lut_ref, nnz_ref, q_ref, k_ref, v_ref, o_ref,
+    def kernel(lut_ref, nnz_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                acc_ref, m_ref, l_ref):
         bh = pl.program_id(0)
         qi = pl.program_id(1)
@@ -218,6 +225,10 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale, interpret=False):
         def _finish():
             l = jnp.maximum(l_ref[:, 0], 1e-30)
             o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+            # empty rows keep lse = -inf + log(1e-30): harmless, the bwd
+            # kernels never visit them (no LUT entries)
+            lse = m_ref[:, 0] + jnp.log(l)
+            lse_ref[0] = jnp.broadcast_to(lse[:, None], (block, _LANES))
 
     def k_index(bh, qi, j, lut_ref, nnz_ref):
         h = jax.lax.rem(bh, H)
@@ -232,44 +243,240 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale, interpret=False):
             pl.BlockSpec((1, block, D), k_index),
             pl.BlockSpec((1, block, D), k_index),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block, D), lambda bh, qi, j, lut_ref, nnz_ref: (bh, qi, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block, D),
+                         lambda bh, qi, j, lut_ref, nnz_ref: (bh, qi, 0)),
+            pl.BlockSpec((1, block, _LANES),
+                         lambda bh, qi, j, lut_ref, nnz_ref: (bh, qi, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block, D), jnp.float32),
             pltpu.VMEM((block, 1), jnp.float32),
             pltpu.VMEM((block, 1), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, _LANES), jnp.float32),
+        ],
         interpret=interpret,
     )(lut_flat, nnz_flat, q, k, v)
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3), lse
+
+
+def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
+                     causal, sm_scale, interpret=False):
+    """Block-sparse FlashAttention-2 backward: the dQ kernel walks each
+    q-block's nonzero k-blocks (forward LUT); the dK/dV kernel walks each
+    k-block's nonzero q-blocks (transposed LUT). The sparse [T, T] score
+    matrix never materializes in either direction."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    nq = T // block
+    nk = nq
+    max_nnz = lut.shape[-1]
+    max_nnz_t = lut_t.shape[-1]
+    in_dtype = q.dtype
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
+    oh, gh = to_bh(out), to_bh(g)
+    delta = jnp.sum(gh.astype(jnp.float32) * oh.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
+
+    lut_flat = jnp.asarray(lut.reshape(H * nq * max_nnz), jnp.int32)
+    nnz_flat = jnp.asarray(nnz.reshape(H * nq), jnp.int32)
+    lut_t_flat = jnp.asarray(lut_t.reshape(H * nk * max_nnz_t), jnp.int32)
+    nnz_t_flat = jnp.asarray(nnz_t.reshape(H * nk), jnp.int32)
+
+    def scores_block(q_blk, k_blk, qi, kblk):
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            k_pos = kblk * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            s = jnp.where(k_pos <= q_pos, s, DEFAULT_MASK_VALUE)
+        return s
+
+    # ---- dQ: grid (BH, nq, max_nnz) over the forward LUT ---------------
+    def dq_kernel(lut_ref, nnz_ref, q_ref, k_ref, v_ref, g_ref, lse_ref,
+                  delta_ref, dq_ref, dq_acc):
+        bh = pl.program_id(0)
+        qi = pl.program_id(1)
+        j = pl.program_id(2)
+        h = jax.lax.rem(bh, H)
+
+        @pl.when(j == 0)
+        def _init():
+            dq_acc[:] = jnp.zeros_like(dq_acc)
+
+        @pl.when(j < nnz_ref[h * nq + qi])
+        def _compute():
+            kblk = lut_ref[(h * nq + qi) * max_nnz + j]
+            qb = q_ref[0].astype(jnp.float32)
+            kb = k_ref[0].astype(jnp.float32)
+            s = scores_block(qb, kb, qi, kblk)
+            p = jnp.exp(s - lse_ref[0][:, :1])
+            gb = g_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            dp = jax.lax.dot_general(
+                gb, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
+            dq_acc[:] += jax.lax.dot_general(
+                ds, kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(j == max_nnz - 1)
+        def _finish():
+            dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+    def k_index(bh, qi, j, lut_ref, nnz_ref):
+        h = jax.lax.rem(bh, H)
+        return (bh, lut_ref[(h * nq + qi) * max_nnz + j], 0)
+
+    def q_row(bh, qi, j, lut_ref, nnz_ref):
+        return (bh, qi, 0)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * H, nq, max_nnz),
+            in_specs=[
+                pl.BlockSpec((1, block, D), q_row),
+                pl.BlockSpec((1, block, D), k_index),
+                pl.BlockSpec((1, block, D), k_index),
+                pl.BlockSpec((1, block, D), q_row),
+                pl.BlockSpec((1, block, _LANES), q_row),
+                pl.BlockSpec((1, block, _LANES), q_row),
+            ],
+            out_specs=pl.BlockSpec((1, block, D), q_row),
+            scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(qh.shape, in_dtype),
+        interpret=interpret,
+    )(lut_flat, nnz_flat, qh, kh, vh, gh, lse, delta)
+
+    # ---- dK/dV: grid (BH, nk, max_nnz_t) over the transposed LUT -------
+    def dkv_kernel(lut_t_ref, nnz_t_ref, q_ref, k_ref, v_ref, g_ref,
+                   lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc):
+        bh = pl.program_id(0)
+        ki = pl.program_id(1)
+        j = pl.program_id(2)
+        h = jax.lax.rem(bh, H)
+
+        @pl.when(j == 0)
+        def _init():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        @pl.when(j < nnz_t_ref[h * nk + ki])
+        def _compute():
+            qblk = lut_t_ref[(h * nk + ki) * max_nnz_t + j]
+            qb = q_ref[0].astype(jnp.float32)
+            kb = k_ref[0].astype(jnp.float32)
+            s = scores_block(qb, kb, qblk, ki)
+            p = jnp.exp(s - lse_ref[0][:, :1])
+            gb = g_ref[0].astype(jnp.float32)
+            dv_acc[:] += jax.lax.dot_general(
+                p, gb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            dp = jax.lax.dot_general(
+                gb, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
+            dk_acc[:] += jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(j == max_nnz_t - 1)
+        def _finish():
+            dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+    def q_via_lut_t(bh, ki, j, lut_t_ref, nnz_t_ref):
+        h = jax.lax.rem(bh, H)
+        return (bh, lut_t_ref[(h * nk + ki) * max_nnz_t + j], 0)
+
+    def k_row(bh, ki, j, lut_t_ref, nnz_t_ref):
+        return (bh, ki, 0)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B * H, nk, max_nnz_t),
+            in_specs=[
+                pl.BlockSpec((1, block, D), q_via_lut_t),
+                pl.BlockSpec((1, block, D), k_row),
+                pl.BlockSpec((1, block, D), k_row),
+                pl.BlockSpec((1, block, D), q_via_lut_t),
+                pl.BlockSpec((1, block, _LANES), q_via_lut_t),
+                pl.BlockSpec((1, block, _LANES), q_via_lut_t),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block, D), k_row),
+                pl.BlockSpec((1, block, D), k_row),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block, D), jnp.float32),
+                pltpu.VMEM((block, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(kh.shape, in_dtype),
+            jax.ShapeDtypeStruct(vh.shape, in_dtype),
+        ],
+        interpret=interpret,
+    )(lut_t_flat, nnz_t_flat, qh, kh, vh, gh, lse, delta)
+
+    def from_bh(x):
+        return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+    return from_bh(dq), from_bh(dk), from_bh(dv)
 
 
 @functools.lru_cache(maxsize=64)
 def _make_sparse_fn(layout_bytes, layout_shape, block, causal, sm_scale,
                     interpret):
     """Build (and cache) a differentiable block-sparse attention closure for
-    one static layout."""
+    one static layout. Both directions run the Pallas kernels: the
+    backward walks the forward LUT for dQ and a transposed LUT for
+    dK/dV."""
     lut, nnz = _build_lut_cached(layout_bytes, layout_shape)
+    layout = np.frombuffer(layout_bytes,
+                           dtype=np.int64).reshape(layout_shape)
+    lut_t, nnz_t = build_lut(layout.transpose(0, 2, 1))
 
     @jax.custom_vjp
     def f(q, k, v):
-        return _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
-                            interpret=interpret)
+        out, _ = _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
+                              interpret=interpret)
+        return out
 
     def f_fwd(q, k, v):
-        return f(q, k, v), (q, k, v)
+        out, lse = _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
+                                interpret=interpret)
+        return out, (q, k, v, out, lse)
 
     def f_bwd(res, g):
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda q, k, v: _xla_impl(q, k, v, lut, nnz, block, causal,
-                                      sm_scale), q, k, v)
-        return vjp(g)
+        q, k, v, out, lse = res
+        return _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t,
+                                nnz_t, block, causal, sm_scale,
+                                interpret=interpret)
 
     f.defvjp(f_fwd, f_bwd)
     return f, lut, nnz
