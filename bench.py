@@ -129,11 +129,14 @@ def main():
     platform = devices[0].platform
     on_tpu = platform == "tpu"
     if on_tpu:
-        cfg_name, batch_size, seq_len, steps = "125M", 8, 1024, 30
+        # 350M sustains the best measured MFU on one v5e chip (~46%,
+        # ~90 TFLOPS — the bs/model sweep lives in PROGRESS.jsonl);
+        # 760M OOMs without remat, 125M leaves MXU util on the table.
+        from deepspeed_tpu.models.gpt2 import gpt2_350m as cfg_fn
+        cfg_name, batch_size, seq_len, steps = "350M", 8, 1024, 20
     else:  # CPU smoke mode
+        from deepspeed_tpu.models.gpt2 import gpt2_125m as cfg_fn
         cfg_name, batch_size, seq_len, steps = "125M(cpu-smoke)", 2, 128, 2
-
-    from deepspeed_tpu.models.gpt2 import gpt2_125m
 
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
     attempts = [(batch_size, remat), (batch_size, True), (batch_size // 2, True)]
@@ -142,7 +145,7 @@ def main():
     for bs, rm in attempts:
         try:
             tokens_per_sec, tflops = run_once(
-                jax, gpt2_125m, bs, seq_len, steps, rm, on_tpu)
+                jax, cfg_fn, bs, seq_len, steps, rm, on_tpu)
             out = {
                 "metric": f"GPT-2 {cfg_name} train tokens/sec/chip "
                           f"(bf16, seq{seq_len}, bs{bs}"
