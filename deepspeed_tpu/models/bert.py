@@ -160,6 +160,45 @@ def make_bert_mlm_loss_fn(model: BertForMaskedLM):
     return loss_fn
 
 
+class BertForQuestionAnswering(nn.Module):
+    """Span-prediction head over the encoder — the reference's
+    BingBertSquad fine-tuning workload (its e2e accuracy gate,
+    `tests/model/BingBertSquad/test_e2e_squad.py`). Outputs
+    (start_logits, end_logits), each [B, T]."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        x = BertModel(cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        logits = nn.Dense(2, dtype=cfg.dtype, name="qa_outputs")(x)
+        start, end = jnp.split(logits, 2, axis=-1)
+        return start.squeeze(-1), end.squeeze(-1)
+
+
+def make_bert_qa_loss_fn(model: BertForQuestionAnswering):
+    """loss_fn(params, batch, rng): batch has input_ids [B,T],
+    start_positions/end_positions [B] token indices, optional
+    attention_mask — mean of start/end cross-entropies (SQuAD training
+    objective)."""
+    from deepspeed_tpu.models.gpt2 import cross_entropy_loss
+
+    def loss_fn(params, batch, rng=None):
+        rngs = {"dropout": rng} if rng is not None else {}
+        start_logits, end_logits = model.apply(
+            {"params": params}, batch["input_ids"],
+            batch.get("attention_mask"), batch.get("token_type_ids"),
+            deterministic=rng is None, rngs=rngs)
+        start_loss = cross_entropy_loss(start_logits,
+                                        batch["start_positions"])
+        end_loss = cross_entropy_loss(end_logits, batch["end_positions"])
+        return 0.5 * (start_loss + end_loss)
+
+    return loss_fn
+
+
 def init_bert_params(model, rng, batch_size=2, seq_len=16):
     dummy = jnp.zeros((batch_size, seq_len), jnp.int32)
     return model.init({"params": rng, "dropout": rng}, dummy)["params"]

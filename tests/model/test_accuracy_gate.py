@@ -45,3 +45,43 @@ def test_gpt2_memorizes_corpus():
     # eval path agrees with train-path loss on the same data
     eval_loss = float(engine.eval_batch(batch))
     assert abs(eval_loss - loss) < 0.5, (eval_loss, loss)
+
+
+def test_bert_qa_span_accuracy_gate():
+    """Span-prediction fine-tune gate (the BingBertSquad e2e analog,
+    reference `tests/model/BingBertSquad/test_e2e_squad.py`): after
+    fine-tuning on a synthetic span task, exact-match accuracy on the
+    training set must clear a hard bar."""
+    from deepspeed_tpu.models.bert import (
+        BertForQuestionAnswering, bert_tiny, init_bert_params,
+        make_bert_qa_loss_fn)
+
+    rng = np.random.default_rng(3)
+    N, T = 64, 32
+    ids = rng.integers(5, 250, (N, T)).astype(np.int32)
+    starts = rng.integers(0, T - 4, (N,)).astype(np.int32)
+    ends = (starts + rng.integers(1, 4, (N,))).astype(np.int32)
+    # plant a learnable signal: special tokens bracket the answer span
+    for i in range(N):
+        ids[i, starts[i]] = 1
+        ids[i, ends[i]] = 2
+
+    model = BertForQuestionAnswering(bert_tiny(max_position_embeddings=T))
+    params = init_bert_params(model, jax.random.PRNGKey(0), seq_len=T)
+    config = base_gpt2_config(
+        train_batch_size=N,
+        optimizer={"type": "Adam", "params": {"lr": 2e-3}})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=config, loss_fn=make_bert_qa_loss_fn(model), params=params)
+
+    batch = {"input_ids": ids, "start_positions": starts,
+             "end_positions": ends}
+    for _ in range(150):
+        loss = float(engine.train_batch(batch))
+    assert loss < 0.2, f"qa fine-tune failed the gate: loss {loss:.3f}"
+
+    start_logits, end_logits = model.apply(
+        {"params": jax.tree_util.tree_map(np.asarray, engine.params)}, ids)
+    em = np.mean((np.argmax(start_logits, -1) == starts) &
+                 (np.argmax(end_logits, -1) == ends))
+    assert em > 0.95, f"exact match {em:.2%} below the 95% gate"
