@@ -56,9 +56,10 @@ def run_once_bert(jax, bs, seq_len, steps):
         BertForMaskedLM, bert_large, init_bert_params,
         make_bert_mlm_loss_fn)
 
+    import jax.numpy as jnp
+
     cfg = bert_large(max_position_embeddings=max(512, seq_len),
-                     dtype=__import__("jax.numpy", fromlist=["x"]).bfloat16,
-                     use_flash_attention=True)
+                     dtype=jnp.bfloat16, use_flash_attention=True)
     model = BertForMaskedLM(cfg)
     params = init_bert_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
     config = {
@@ -213,6 +214,12 @@ def main():
 
     platform = devices[0].platform
     on_tpu = platform == "tpu"
+    if os.environ.get("BENCH_MODEL") == "bert_large" and not on_tpu:
+        emit({"metric": "BERT-Large MLM samples/sec/chip", "value": 0,
+              "unit": "samples/sec/chip", "vs_baseline": 0.0,
+              "error": f"BENCH_MODEL=bert_large requires a TPU; backend "
+                       f"is {platform!r}"})
+        return
     if on_tpu and os.environ.get("BENCH_MODEL") == "bert_large":
         # Head-to-head with the reference's headline claim: BERT-Large
         # MLM at seq128 (V100: 64 TFLOPS, 272 samples/s).

@@ -390,11 +390,15 @@ def _flash_pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
                       interpret):
     out, lse = _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
                            interpret)
-    return out, (q, k, v, out, lse)
+    # Store the residual compact [B*H, T]: holding the lane-broadcast
+    # [B*H, T, 128] form from forward to backward would be a 128x HBM
+    # blowup; the backward re-broadcasts it.
+    return out, (q, k, v, out, lse[..., 0])
 
 
 def _flash_pallas_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
+    lse = jnp.broadcast_to(lse[..., None], lse.shape + (_LANES,))
     return _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale,
                        block_q, block_k, interpret)
 

@@ -470,10 +470,13 @@ def _make_sparse_fn(layout_bytes, layout_shape, block, causal, sm_scale,
     def f_fwd(q, k, v):
         out, lse = _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
                                 interpret=interpret)
-        return out, (q, k, v, out, lse)
+        # residual stored compact [B*H, T] — the lane-broadcast form
+        # would hold 128x the bytes from forward to backward
+        return out, (q, k, v, out, lse[..., 0])
 
     def f_bwd(res, g):
         q, k, v, out, lse = res
+        lse = jnp.broadcast_to(lse[..., None], lse.shape + (_LANES,))
         return _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t,
                                 nnz_t, block, causal, sm_scale,
                                 interpret=interpret)

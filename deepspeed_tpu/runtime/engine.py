@@ -313,6 +313,9 @@ class DeepSpeedEngine:
         from deepspeed_tpu.utils.profiler import TraceProfiler
         self.trace_profiler = TraceProfiler(
             **(self._config.profiling_params or {}))
+        if self.trace_profiler.enabled:
+            import atexit
+            atexit.register(self.trace_profiler.close)
         self.summary_writer = None
         if self._config.tensorboard_enabled and jax.process_index() == 0:
             self.summary_writer = self._get_summary_writer()
@@ -1016,8 +1019,11 @@ class DeepSpeedEngine:
                 if self._offload else self._make_train_step()
 
         self.trace_profiler.before_step(self.global_steps)
-        step_t0 = time.time() if (self.wall_clock_breakdown() or
-                                  self.trace_profiler.enabled) else None
+        # sync-timing only for wall_clock_breakdown runs or steps inside
+        # the trace window — never run-wide for a windowed trace config
+        step_t0 = time.time() if (
+            self.wall_clock_breakdown() or
+            self.trace_profiler.in_window(self.global_steps)) else None
         if self.wall_clock_breakdown():
             self.timers("train_batch").start()
         self.tput_timer.start()

@@ -24,12 +24,20 @@ import collections
 from deepspeed_tpu.utils.logging import log_dist
 
 
+_KNOWN_KEYS = ("trace_dir", "trace_start_step", "trace_num_steps",
+               "history")
+
+
 class TraceProfiler:
     """Captures a ``jax.profiler`` trace for a configured step window and
     keeps a rolling record of synchronized per-step durations."""
 
     def __init__(self, trace_dir=None, trace_start_step=0,
-                 trace_num_steps=0, history=100):
+                 trace_num_steps=0, history=100, **unknown):
+        if unknown:
+            raise ValueError(
+                f"unknown 'profiling' config keys {sorted(unknown)}; "
+                f"supported: {list(_KNOWN_KEYS)}")
         self.trace_dir = trace_dir
         self.start_step = int(trace_start_step)
         self.num_steps = int(trace_num_steps)
@@ -40,10 +48,18 @@ class TraceProfiler:
     def enabled(self):
         return self.trace_dir is not None and self.num_steps > 0
 
+    def in_window(self, global_step):
+        """True only for steps inside the trace window — the engine syncs
+        per-step timing for these (plus wall_clock_breakdown runs), NOT
+        for the whole run."""
+        return self.enabled and (
+            self.start_step <= global_step <
+            self.start_step + self.num_steps)
+
     def before_step(self, global_step):
         if not self.enabled or self._active:
             return
-        if self.start_step <= global_step < self.start_step + self.num_steps:
+        if self.in_window(global_step):
             import jax
 
             jax.profiler.start_trace(self.trace_dir)
@@ -56,12 +72,20 @@ class TraceProfiler:
             self.step_times.append(duration)
         if self._active and \
                 global_step >= self.start_step + self.num_steps - 1:
-            import jax
+            self.close(global_step)
 
-            jax.profiler.stop_trace()
-            self._active = False
-            log_dist(f"profiler: trace stopped after step {global_step}",
-                     ranks=[0])
+    def close(self, global_step=None):
+        """Stop an in-flight trace (idempotent) — also called at interpreter
+        exit so a run ending inside the window still flushes xprof files."""
+        if not self._active:
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+        self._active = False
+        log_dist(f"profiler: trace stopped"
+                 f"{f' after step {global_step}' if global_step is not None else ''}",
+                 ranks=[0])
 
     def summary(self):
         """(mean, min, max) of recorded synchronized step seconds."""
