@@ -1,0 +1,8 @@
+"""Adam ops (reference `deepspeed/ops/adam/__init__.py` export surface)."""
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.ops.adam.fused_adam import (
+    AdamState, FusedAdam, adam_update, init_adam_state)
+
+__all__ = ["DeepSpeedCPUAdam", "FusedAdam", "AdamState", "adam_update",
+           "init_adam_state"]

@@ -1,0 +1,6 @@
+"""Lamb ops (reference `deepspeed/ops/lamb/__init__.py` export surface)."""
+
+from deepspeed_tpu.ops.lamb.fused_lamb import (
+    FusedLamb, LambState, init_lamb_state, lamb_update)
+
+__all__ = ["FusedLamb", "LambState", "init_lamb_state", "lamb_update"]

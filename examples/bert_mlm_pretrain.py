@@ -1,0 +1,53 @@
+"""BERT MLM pretraining on the fused transformer layer.
+
+Usage: python examples/bert_mlm_pretrain.py [--steps N]
+"""
+import argparse
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--large", action="store_true",
+                        help="BERT-Large instead of the tiny test size")
+    import deepspeed_tpu
+    deepspeed_tpu.add_config_arguments(parser)
+    args = parser.parse_args()
+
+    import jax
+    from deepspeed_tpu.models.bert import (
+        BertForMaskedLM, bert_large, bert_tiny, init_bert_params,
+        make_bert_mlm_loss_fn)
+
+    cfg = (bert_large if args.large else bert_tiny)(
+        max_position_embeddings=max(args.seq_len, 64))
+    model = BertForMaskedLM(cfg)
+    params = init_bert_params(model, jax.random.PRNGKey(0),
+                              seq_len=args.seq_len)
+    config = getattr(args, "deepspeed_config", None) or {
+        "train_batch_size": args.batch_size,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "steps_per_print": 10,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args, config=config, loss_fn=make_bert_mlm_loss_fn(model),
+        params=params)
+
+    rng = np.random.default_rng(0)
+    for step in range(args.steps):
+        ids = rng.integers(0, cfg.vocab_size,
+                           (args.batch_size, args.seq_len)).astype(np.int32)
+        labels = np.full_like(ids, -100, dtype=np.int64)
+        mask = rng.random(ids.shape) < 0.15
+        labels[mask] = ids[mask]
+        loss = engine.train_batch({"input_ids": ids, "labels": labels})
+    print(f"final loss after {args.steps} steps: {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

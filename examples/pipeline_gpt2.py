@@ -1,0 +1,49 @@
+"""GPT-2 over a pipe x data mesh: the compiled 1F1B pipeline.
+
+Usage: python examples/pipeline_gpt2.py [--pipe 2] [--steps N]
+(device count must be divisible by --pipe)
+"""
+import argparse
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--pipe", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--microbatches", type=int, default=4)
+    parser.add_argument("--seq_len", type=int, default=64)
+    import deepspeed_tpu
+    deepspeed_tpu.add_config_arguments(parser)
+    args = parser.parse_args()
+
+    import jax
+    from deepspeed_tpu.models.gpt2 import gpt2_tiny
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+
+    n_dev = jax.device_count()
+    assert n_dev % args.pipe == 0, (n_dev, args.pipe)
+    config = getattr(args, "deepspeed_config", None) or {
+        "train_batch_size": args.batch_size,
+        "gradient_accumulation_steps": args.microbatches,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10,
+        "mesh": {"pipe": args.pipe, "data": n_dev // args.pipe},
+    }
+    module = gpt2_pipeline_module(gpt2_tiny(n_layer=4),
+                                  seq_len=args.seq_len)
+    engine, _, _, _ = deepspeed_tpu.initialize(args=args, config=config,
+                                               model=module)
+
+    rng = np.random.default_rng(0)
+    for step in range(args.steps):
+        batch = {"input_ids": rng.integers(
+            0, 255, (args.batch_size, args.seq_len)).astype(np.int32)}
+        loss = engine.train_batch(batch)
+    print(f"final loss after {args.steps} steps: {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()
