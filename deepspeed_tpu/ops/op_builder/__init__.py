@@ -1,11 +1,13 @@
 """Native-op registry (reference ``op_builder/__init__.py:12-20`` ALL_OPS)."""
 
 from deepspeed_tpu.ops.op_builder.builder import (
-    CPUAdamBuilder, OpBuilder, UtilsBuilder)
+    CPUAdamBuilder, OpBuilder, SparseAttnBuilder, UtilsBuilder)
 
 ALL_OPS = {
     CPUAdamBuilder.NAME: CPUAdamBuilder,
+    SparseAttnBuilder.NAME: SparseAttnBuilder,
     UtilsBuilder.NAME: UtilsBuilder,
 }
 
-__all__ = ["OpBuilder", "CPUAdamBuilder", "UtilsBuilder", "ALL_OPS"]
+__all__ = ["OpBuilder", "CPUAdamBuilder", "SparseAttnBuilder",
+           "UtilsBuilder", "ALL_OPS"]

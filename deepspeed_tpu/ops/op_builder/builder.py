@@ -136,6 +136,27 @@ class CPUAdamBuilder(OpBuilder):
         return lib
 
 
+class SparseAttnBuilder(OpBuilder):
+    """Block-sparse LUT construction (reference ``op_builder/sparse_attn
+    .py:6`` — its only C++ is the ``sdd_segment`` LUT helper; ours is
+    `csrc/sparse_attention/lut_builder.cpp`)."""
+
+    NAME = "sparse_attn"
+
+    def sources(self):
+        return [CSRC / "sparse_attention" / "lut_builder.cpp"]
+
+    def load(self, verbose=True):
+        lib = super().load(verbose=verbose)
+        i64 = ctypes.c_int64
+        p64 = ctypes.POINTER(ctypes.c_int64)
+        p32 = ctypes.POINTER(ctypes.c_int32)
+        lib.ds_build_lut.argtypes = [p64, i64, i64, i64, i64, p32, p32]
+        lib.ds_lut_max_nnz.argtypes = [p64, i64, i64, i64]
+        lib.ds_lut_max_nnz.restype = i64
+        return lib
+
+
 class UtilsBuilder(OpBuilder):
     """flatten/unflatten packing (reference ``op_builder/utils.py:4``,
     kernel `csrc/utils/flatten_unflatten.cpp`)."""

@@ -38,14 +38,7 @@ from deepspeed_tpu.ops.pallas.flash_attention import DEFAULT_MASK_VALUE
 # `matmul.py:53-114` / `softmax.py:42-77`, minus the Triton segmenting)
 # ---------------------------------------------------------------------------
 
-def build_lut(layout):
-    """Per-(head, q-block) list of nonzero k-block indices.
-
-    layout: [H, nq, nk] 0/1 array →
-      lut:  [H, nq, max_nnz] int32 (k-block index; padded entries are 0)
-      nnz:  [H, nq] int32 (valid entries per row)
-    """
-    layout = np.asarray(layout)
+def _build_lut_numpy(layout):
     H, nq, nk = layout.shape
     nnz = layout.sum(axis=-1).astype(np.int32)
     max_nnz = max(int(nnz.max()), 1)
@@ -55,6 +48,48 @@ def build_lut(layout):
             cols = np.nonzero(layout[h, qi])[0]
             lut[h, qi, :len(cols)] = cols
     return lut, nnz
+
+
+def _build_lut_native(layout):
+    """OpenMP C++ LUT builder (`csrc/sparse_attention/lut_builder.cpp` —
+    the analog of the reference's only sparse-attn C++, the sdd_segment
+    LUT helper). Returns None if the native op can't build here."""
+    try:
+        from deepspeed_tpu.ops.op_builder import SparseAttnBuilder
+
+        lib = SparseAttnBuilder().load(verbose=False)
+    except Exception:
+        return None
+    import ctypes
+
+    H, nq, nk = layout.shape
+    flat = np.ascontiguousarray(layout.reshape(-1), dtype=np.int64)
+    p64 = flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    max_nnz = max(int(lib.ds_lut_max_nnz(p64, H, nq, nk)), 1)
+    lut = np.zeros((H, nq, max_nnz), dtype=np.int32)
+    nnz = np.zeros((H, nq), dtype=np.int32)
+    lib.ds_build_lut(
+        p64, H, nq, nk, max_nnz,
+        lut.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        nnz.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return lut, nnz
+
+
+def build_lut(layout):
+    """Per-(head, q-block) list of nonzero k-block indices.
+
+    layout: [H, nq, nk] 0/1 array →
+      lut:  [H, nq, max_nnz] int32 (k-block index; padded entries are 0)
+      nnz:  [H, nq] int32 (valid entries per row)
+
+    Uses the native C++/OpenMP builder when it can compile, the NumPy
+    loop otherwise.
+    """
+    layout = np.asarray(layout)
+    native = _build_lut_native(layout)
+    if native is not None:
+        return native
+    return _build_lut_numpy(layout)
 
 
 @functools.lru_cache(maxsize=64)

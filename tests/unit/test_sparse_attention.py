@@ -310,3 +310,21 @@ def test_pallas_backward_matches_masked_dense(name, cfg, causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4,
                                    err_msg=f"d{nm} mismatch")
+
+
+def test_native_lut_matches_numpy():
+    """The C++ LUT builder (csrc/sparse_attention/lut_builder.cpp) must
+    agree with the NumPy reference on a ragged random layout."""
+    from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+        _build_lut_native, _build_lut_numpy)
+
+    rng = np.random.default_rng(0)
+    layout = (rng.random((4, 16, 16)) < 0.3).astype(np.int64)
+    layout[0, 3] = 0        # empty row
+    layout[1, 5] = 1        # dense row
+    native = _build_lut_native(layout)
+    assert native is not None, "native sparse_attn op failed to build"
+    lut_c, nnz_c = native
+    lut_np, nnz_np = _build_lut_numpy(layout)
+    np.testing.assert_array_equal(nnz_c, nnz_np)
+    np.testing.assert_array_equal(lut_c, lut_np)
