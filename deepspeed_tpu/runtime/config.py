@@ -339,6 +339,11 @@ class DeepSpeedConfig:
         # xprof equivalent) — {"trace_dir", "trace_start_step",
         # "trace_num_steps"}
         self.profiling_params = param_dict.get("profiling", None)
+        # persistent XLA compilation cache (first 350M-step compile is
+        # ~2 min on a v5e; a shared cache dir makes restarts/pod workers
+        # hit it instead)
+        self.compilation_cache_dir = get_scalar_param(
+            param_dict, "compilation_cache_dir", None)
         if TENSORBOARD in param_dict:
             tb = param_dict[TENSORBOARD]
             self.tensorboard_enabled = get_scalar_param(tb, TENSORBOARD_ENABLED,

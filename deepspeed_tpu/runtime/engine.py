@@ -310,6 +310,9 @@ class DeepSpeedEngine:
             self._config.gradient_accumulation_steps,
             num_workers=self.dp_world_size,
             steps_per_output=self._config.steps_per_print)
+        if self._config.compilation_cache_dir:
+            jax.config.update("jax_compilation_cache_dir",
+                              self._config.compilation_cache_dir)
         from deepspeed_tpu.utils.profiler import TraceProfiler
         self.trace_profiler = TraceProfiler(
             **(self._config.profiling_params or {}))

@@ -192,3 +192,19 @@ def test_mesh_config():
         "mesh": {"data": 2, "model": 4},
     }, world_size=2)
     assert cfg.mesh_shape == {"data": 2, "model": 4}
+
+
+def test_compilation_cache_dir_config(tmp_path):
+    import deepspeed_tpu
+    import jax
+    from tests.unit.simple_model import (base_config, simple_init_params,
+                                         simple_loss_fn)
+
+    cache = str(tmp_path / "xla_cache")
+    cfg = base_config(compilation_cache_dir=cache)
+    params = simple_init_params(jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, loss_fn=simple_loss_fn, params=params)
+    assert jax.config.jax_compilation_cache_dir == cache
+    # restore the default so other tests are unaffected
+    jax.config.update("jax_compilation_cache_dir", None)
