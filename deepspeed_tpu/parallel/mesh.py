@@ -29,13 +29,24 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     """Multi-host rendezvous: analog of ``dist.init_process_group`` at
     `runtime/engine.py:135`, via ``jax.distributed.initialize``.
 
-    Single-process (one host, or tests) is a no-op: JAX already sees all
-    local devices.
+    Defaults come from the ``DS_TPU_COORDINATOR`` /
+    ``DS_TPU_NUM_PROCESSES`` / ``DS_TPU_PROCESS_ID`` env the launcher sets
+    per host (`launcher/launch.py:build_env` — the MASTER_ADDR/RANK
+    equivalent). Single-process (one host, or tests) is a no-op: JAX
+    already sees all local devices.
     """
     global _initialized
     if _initialized:
         return
-    if coordinator_address is not None or num_processes not in (None, 1):
+    import os
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("DS_TPU_COORDINATOR")
+    if num_processes is None and "DS_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["DS_TPU_NUM_PROCESSES"])
+    if process_id is None and "DS_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["DS_TPU_PROCESS_ID"])
+    if num_processes not in (None, 1):
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)

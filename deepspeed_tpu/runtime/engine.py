@@ -218,6 +218,19 @@ class DeepSpeedEngine:
             config = args.deepspeed_config
         assert config is not None, "config (dict or json path) required"
 
+        # Multi-host rendezvous first (no-op single-process): scripts
+        # spawned by the launcher carry DS_TPU_* env and must join the
+        # jax.distributed cluster before any device/mesh query (the
+        # reference's dist.init_process_group at engine.py:135).
+        from deepspeed_tpu.parallel.mesh import initialize_distributed
+        try:
+            initialize_distributed()
+        except RuntimeError as e:
+            raise RuntimeError(
+                "multi-process rendezvous env (DS_TPU_*) is set but the "
+                "XLA backend was already initialized — call "
+                "deepspeed_tpu.parallel.initialize_distributed() at the "
+                "top of your script, before creating any jax array") from e
         self.mesh = mesh if mesh is not None else build_mesh(
             (config.get("mesh") if isinstance(config, dict) else None))
         self.dp_world_size = self.mesh.shape["data"]
