@@ -40,13 +40,22 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         return
     import os
 
+    explicit_coordinator = coordinator_address is not None
     if coordinator_address is None:
         coordinator_address = os.environ.get("DS_TPU_COORDINATOR")
     if num_processes is None and "DS_TPU_NUM_PROCESSES" in os.environ:
         num_processes = int(os.environ["DS_TPU_NUM_PROCESSES"])
     if process_id is None and "DS_TPU_PROCESS_ID" in os.environ:
         process_id = int(os.environ["DS_TPU_PROCESS_ID"])
-    if num_processes not in (None, 1):
+    # An explicit coordinator always initializes (num_processes/process_id
+    # auto-detect on TPU pods); env-driven initialization requires the
+    # process count so a partial env fails loudly rather than silently
+    # staying single-host.
+    if explicit_coordinator or num_processes not in (None, 1):
+        if not explicit_coordinator and coordinator_address is None:
+            raise RuntimeError(
+                "DS_TPU_NUM_PROCESSES is set but DS_TPU_COORDINATOR is "
+                "missing — partial launcher env")
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)

@@ -226,16 +226,23 @@ class DeepSpeedEngine:
         try:
             initialize_distributed()
         except RuntimeError as e:
-            raise RuntimeError(
-                "multi-process rendezvous env (DS_TPU_*) is set but the "
-                "XLA backend was already initialized — call "
-                "deepspeed_tpu.parallel.initialize_distributed() at the "
-                "top of your script, before creating any jax array") from e
+            if "before" in str(e) and "JAX" in str(e):
+                raise RuntimeError(
+                    "multi-process rendezvous env (DS_TPU_*) is set but "
+                    "the XLA backend was already initialized — call "
+                    "deepspeed_tpu.parallel.initialize_distributed() at "
+                    "the top of your script, before creating any jax "
+                    "array") from e
+            raise
         self.mesh = mesh if mesh is not None else build_mesh(
             (config.get("mesh") if isinstance(config, dict) else None))
         self.dp_world_size = self.mesh.shape["data"]
         self.mp_world_size = self.mesh.shape["model"]
         self._config = DeepSpeedConfig(config, world_size=self.dp_world_size)
+        if self._config.compilation_cache_dir:
+            # before ANY engine jit (opt-state init compiles below)
+            jax.config.update("jax_compilation_cache_dir",
+                              self._config.compilation_cache_dir)
 
         # --- precision policy -------------------------------------------
         if self._config.fp16_enabled:
@@ -323,9 +330,6 @@ class DeepSpeedEngine:
             self._config.gradient_accumulation_steps,
             num_workers=self.dp_world_size,
             steps_per_output=self._config.steps_per_print)
-        if self._config.compilation_cache_dir:
-            jax.config.update("jax_compilation_cache_dir",
-                              self._config.compilation_cache_dir)
         from deepspeed_tpu.utils.profiler import TraceProfiler
         self.trace_profiler = TraceProfiler(
             **(self._config.profiling_params or {}))

@@ -18,6 +18,7 @@ def main():
     deepspeed_tpu.add_config_arguments(parser)
     args = parser.parse_args()
 
+    deepspeed_tpu.parallel.initialize_distributed()
     import jax
     from deepspeed_tpu.models.bert import (
         BertForMaskedLM, bert_large, bert_tiny, init_bert_params,

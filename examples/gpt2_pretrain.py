@@ -19,6 +19,9 @@ def main():
     deepspeed_tpu.add_config_arguments(parser)
     args = parser.parse_args()
 
+    # multi-host launches (deepspeed-tpu --hostfile ...) must join the
+    # cluster BEFORE any jax call initializes the backend
+    deepspeed_tpu.parallel.initialize_distributed()
     import jax
     from deepspeed_tpu.models.gpt2 import (
         GPT2LMHead, gpt2_125m, gpt2_350m, gpt2_tiny, init_gpt2_params,

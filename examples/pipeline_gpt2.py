@@ -19,6 +19,7 @@ def main():
     deepspeed_tpu.add_config_arguments(parser)
     args = parser.parse_args()
 
+    deepspeed_tpu.parallel.initialize_distributed()
     import jax
     from deepspeed_tpu.models.gpt2 import gpt2_tiny
     from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module

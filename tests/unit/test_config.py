@@ -203,8 +203,10 @@ def test_compilation_cache_dir_config(tmp_path):
     cache = str(tmp_path / "xla_cache")
     cfg = base_config(compilation_cache_dir=cache)
     params = simple_init_params(jax.random.PRNGKey(0))
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        config=cfg, loss_fn=simple_loss_fn, params=params)
-    assert jax.config.jax_compilation_cache_dir == cache
-    # restore the default so other tests are unaffected
-    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=cfg, loss_fn=simple_loss_fn, params=params)
+        assert jax.config.jax_compilation_cache_dir == cache
+    finally:
+        # restore the default so other tests are unaffected
+        jax.config.update("jax_compilation_cache_dir", None)

@@ -57,13 +57,21 @@ WORKER = textwrap.dedent("""
 """)
 
 
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_two_process_rendezvous_and_training(tmp_path):
     repo = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     script = tmp_path / "worker.py"
     script.write_text(WORKER % {"repo": repo})
 
-    port = 29651
+    port = _free_port()
     procs = []
     for pid in range(2):
         env = dict(os.environ)
@@ -77,13 +85,18 @@ def test_two_process_rendezvous_and_training(tmp_path):
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
 
     results = {}
-    for p in procs:
-        out, err = p.communicate(timeout=240)
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-        for line in out.splitlines():
-            if line.startswith("RESULT "):
-                rec = json.loads(line[len("RESULT "):])
-                results[rec["pid"]] = rec["losses"]
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            for line in out.splitlines():
+                if line.startswith("RESULT "):
+                    rec = json.loads(line[len("RESULT "):])
+                    results[rec["pid"]] = rec["losses"]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
     assert set(results) == {0, 1}
     # the compiled step is SPMD over the global mesh: both processes see
