@@ -30,7 +30,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.ops.pallas.flash_attention import DEFAULT_MASK_VALUE
+from deepspeed_tpu.ops.pallas.flash_attention import (
+    _LANES, _from_bh, _to_bh, DEFAULT_MASK_VALUE)
 
 
 # ---------------------------------------------------------------------------
@@ -196,9 +197,6 @@ def _gather_attn(attn_add, lut_h, block, nq):
 # Pallas TPU kernels (no-mask fast path), forward + backward
 # ---------------------------------------------------------------------------
 
-_LANES = 128  # lane-broadcast pad for per-row scalars (lse/delta blocks)
-
-
 def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
                  interpret=False):
     """Returns (out [B,T,H,D], lse [B*H,T,_LANES]) — the logsumexp residual
@@ -210,11 +208,7 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
     nq = T // block
     max_nnz = lut.shape[-1]
 
-    # [B, T, H, D] → [B*H, nq*block, D], h fastest in the folded dim
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-
-    q, k, v = to_bh(q), to_bh(k), to_bh(v)
+    q, k, v = _to_bh(q), _to_bh(k), _to_bh(v)
     lut_flat = jnp.asarray(lut.reshape(H * nq * max_nnz), jnp.int32)
     nnz_flat = jnp.asarray(nnz.reshape(H * nq), jnp.int32)
 
@@ -299,7 +293,7 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
         ],
         interpret=interpret,
     )(lut_flat, nnz_flat, q, k, v)
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3), lse
+    return _from_bh(out, B, H), lse
 
 
 def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
@@ -318,11 +312,8 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
     max_nnz_t = lut_t.shape[-1]
     in_dtype = q.dtype
 
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-
-    qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
-    oh, gh = to_bh(out), to_bh(g)
+    qh, kh, vh = _to_bh(q), _to_bh(k), _to_bh(v)
+    oh, gh = _to_bh(out), _to_bh(g)
     delta = jnp.sum(gh.astype(jnp.float32) * oh.astype(jnp.float32),
                     axis=-1)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
@@ -478,10 +469,7 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
         interpret=interpret,
     )(lut_t_flat, nnz_t_flat, qh, kh, vh, gh, lse, delta)
 
-    def from_bh(x):
-        return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
-
-    return from_bh(dq), from_bh(dk), from_bh(dv)
+    return _from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H)
 
 
 @functools.lru_cache(maxsize=64)

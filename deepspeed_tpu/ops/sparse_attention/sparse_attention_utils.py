@@ -95,6 +95,12 @@ class SparseAttentionUtils:
         from deepspeed_tpu.models.bert import BertForMaskedLM, BertModel
 
         if isinstance(model, (BertModel, BertForMaskedLM)):
+            if max_position < model.config.max_position_embeddings:
+                raise ValueError(
+                    f"max_position {max_position} is smaller than the "
+                    f"model's current "
+                    f"{model.config.max_position_embeddings}; position "
+                    "tables are never shrunk")
             if sparsity_config is None:
                 from deepspeed_tpu.ops.sparse_attention.sparsity_config \
                     import FixedSparsityConfig

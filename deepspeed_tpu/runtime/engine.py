@@ -726,11 +726,14 @@ class DeepSpeedEngine:
         static_scale = self.static_loss_scale
         accumulate = make_grad_accumulator(loss_fn, self.compute_dtype,
                                            accum)
+        pld_fn = self._pld_theta_fn()
 
         def grad_step(params, dstate, batch, rng, lr_in):
             scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
                 else jnp.asarray(static_scale, jnp.float32)
-            loss_sum, grads = accumulate(params, batch, rng, scale)
+            loss_kw = {"pld_theta": pld_fn(dstate.global_step)} \
+                if pld_fn is not None else None
+            loss_sum, grads = accumulate(params, batch, rng, scale, loss_kw)
             # No ZeRO grad-sharding constraint here: the full gradient is
             # about to be fetched to host RAM anyway (the partitioned-
             # offload variant would fetch per-process shards; this engine
@@ -826,12 +829,15 @@ class DeepSpeedEngine:
         static_scale = self.static_loss_scale
         accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum)
         sparse_flags = self._sparse_grad_flags()
+        pld_fn = self._pld_theta_fn()
 
         def step_local(params, opt_state, dstate, batch, rng, lr_in):
             scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
                 else jnp.asarray(static_scale, jnp.float32)
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
-            loss_sum, grads = accumulate(params, batch, rng, scale)
+            loss_kw = {"pld_theta": pld_fn(dstate.global_step)} \
+                if pld_fn is not None else None
+            loss_sum, grads = accumulate(params, batch, rng, scale, loss_kw)
 
             # Static token budget: rows touched locally per boundary is
             # bounded by the number of id elements in the local batch.
@@ -930,12 +936,15 @@ class DeepSpeedEngine:
         dynamic = self.dynamic_loss_scale
         static_scale = self.static_loss_scale
         accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum)
+        pld_fn = self._pld_theta_fn()
 
         def step_local(params, opt_state, dstate, batch, rng, lr_in):
             scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
                 else jnp.asarray(static_scale, jnp.float32)
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
-            loss_sum, grads = accumulate(params, batch, rng, scale)
+            loss_kw = {"pld_theta": pld_fn(dstate.global_step)} \
+                if pld_fn is not None else None
+            loss_sum, grads = accumulate(params, batch, rng, scale, loss_kw)
 
             # Cross-shard overflow vote (reference stage2.py:1527-1551);
             # norms are pmean'd local-shard diagnostics (a true global norm
@@ -1057,16 +1066,17 @@ class DeepSpeedEngine:
                 self._compiled_train_step(self.params, self.opt_state,
                                           self.device_state, placed,
                                           step_rng, lr_in)
+        if step_t0 is not None:
+            # block on the step's own outputs BEFORE stopping any timer:
+            # effects_barrier (inside the timers) only waits for
+            # *effectful* dispatch, not the pure compiled train step
+            jax.block_until_ready(metrics["loss"])
         self.tput_timer.stop()
         if self.wall_clock_breakdown():
             self.timers("train_batch").stop()
             self.timers.log(["train_batch"],
                             memory_breakdown=self.memory_breakdown())
         if step_t0 is not None:
-            # timers above synchronized (effects_barrier), so this wall
-            # delta is the per-step device-time-inclusive duration
-            if not self.wall_clock_breakdown():
-                jax.effects_barrier()
             self.trace_profiler.after_step(self.global_steps,
                                            time.time() - step_t0)
         else:

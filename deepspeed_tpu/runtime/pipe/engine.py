@@ -62,6 +62,11 @@ class PipelineEngine(DeepSpeedEngine):
             config = args.deepspeed_config
         assert config is not None, "config (dict or json path) required"
 
+        # Join the multi-host cluster BEFORE the first backend-touching
+        # call (build_mesh below) — same contract as the base engine.
+        from deepspeed_tpu.parallel.mesh import initialize_distributed
+        initialize_distributed()
+
         mesh_cfg = config.get("mesh") if isinstance(config, dict) else None
         mesh = mesh if mesh is not None else build_mesh(mesh_cfg)
         num_stages = mesh.shape["pipe"]
@@ -76,6 +81,11 @@ class PipelineEngine(DeepSpeedEngine):
         # micro-batches per train batch = gradient accumulation steps
         # (reference pipe/engine.py:229: micro_batches == grad accum).
         probe = DeepSpeedConfig(config, world_size=mesh.shape["data"])
+        if probe.pld_enabled:
+            raise ValueError(
+                "progressive_layer_drop is not supported with "
+                "PipelineModule: the hand-scheduled 1F1B program takes no "
+                "pld_theta (stage bodies are homogeneous scans)")
         self.micro_batches = probe.gradient_accumulation_steps
         self.num_stages = num_stages
 

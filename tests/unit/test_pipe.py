@@ -286,3 +286,17 @@ def test_pipeline_engine_fp16_loss_scale():
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
     assert float(engine.loss_scale) > 1.0
+
+
+def test_pipeline_rejects_pld():
+    """PLD is explicitly unsupported with PipelineModule (the 1F1B program
+    takes no theta) — must fail at init, not mid-train."""
+    import deepspeed_tpu
+    with pytest.raises(ValueError, match="progressive_layer_drop"):
+        deepspeed_tpu.initialize(
+            config={"train_batch_size": 8,
+                    "gradient_accumulation_steps": 2,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "progressive_layer_drop": {"enabled": True},
+                    "mesh": {"pipe": 2, "data": 4}},
+            model=gpt2_pipeline_module(tiny_cfg(2), seq_len=SEQ))
