@@ -73,11 +73,25 @@ def debug_report(out=sys.stdout):
     return rows
 
 
-def main(out=sys.stdout):
+def main(out=sys.stdout, argv=None):
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu environment report (reference bin/"
+                    "ds_report)")
+    parser.add_argument(
+        "--perf", action="store_true",
+        help="also run the CPU Adam micro-benchmark at reference scale "
+             "(~1e8 elements; reference tests/perf/adam_test.py)")
+    args = parser.parse_args(argv)
     op_report(out=out)
     debug_report(out=out)
     from deepspeed_tpu.utils.profiler import device_report
     device_report(out=out)
+    if args.perf:
+        import json
+        from deepspeed_tpu.ops.adam.perf import benchmark_cpu_adam
+        print("cpu_adam micro-bench (1e8 elems, best of 5):", file=out)
+        print(json.dumps(benchmark_cpu_adam()), file=out)
 
 
 if __name__ == "__main__":

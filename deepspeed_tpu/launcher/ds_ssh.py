@@ -1,0 +1,45 @@
+"""Broadcast a shell command to every host in the hostfile.
+
+TPU-pod analog of the reference's `bin/ds_ssh` (a pdsh wrapper,
+reference bin/ds_ssh:1-24): uses pdsh when available, plain ssh per host
+otherwise, and runs locally when no hostfile exists.
+Usage: ds_tpu_ssh [-H hostfile] <command...>
+"""
+import argparse
+import shutil
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.runner import DLTS_HOSTFILE, fetch_hostfile
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="run a command on every host in the hostfile")
+    parser.add_argument("-H", "--hostfile", default=DLTS_HOSTFILE)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    cmd = " ".join(args.command)
+
+    pool = fetch_hostfile(args.hostfile)
+    if not pool:
+        print(f"Missing hostfile at {args.hostfile}, executing locally")
+        return subprocess.call(cmd, shell=True)
+
+    hosts = list(pool)
+    if shutil.which("pdsh"):
+        return subprocess.call(
+            ["pdsh", "-R", "ssh", "-w", ",".join(hosts), cmd])
+    rc = 0
+    for host in hosts:
+        print(f"--- {host} ---", flush=True)
+        r = subprocess.call(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host, cmd])
+        rc = rc or r
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,9 @@ def top_k_gating(logits, top_k, capacity):
         # capacity dropping, so a token whose first choice overflows still
         # routes through its second choice with the proportional weight
         # (not an inflated 1.0) — the dropped mass is lost, as in GShard.
+        # Deliberate divergence from the reference's top2gating, which
+        # renormalizes AFTER the capacity mask (second-choice gate becomes
+        # 1.0 on overflow); curves differ under overflow (COVERAGE.md).
         denom = gates.sum(-1, keepdims=True)
         gates = gates / jnp.maximum(denom, 1e-9)
     # top_k == 1 keeps the raw router probability (Switch): scaling the

@@ -20,8 +20,32 @@ from pathlib import Path
 from deepspeed_tpu.utils.logging import logger
 
 REPO_ROOT = Path(__file__).resolve().parents[3]
-CSRC = REPO_ROOT / "csrc"
-CACHE_DIR = REPO_ROOT / ".op_cache"
+PKG_ROOT = Path(__file__).resolve().parents[2]
+
+# Source layout: repo checkout keeps csrc/ at the top level; installed
+# wheels carry it inside the package (setup.py build_py copies it to
+# deepspeed_tpu/csrc).
+if (REPO_ROOT / "csrc").is_dir():
+    CSRC = REPO_ROOT / "csrc"
+else:
+    CSRC = PKG_ROOT / "csrc"
+
+
+def _default_cache_dir():
+    env = os.environ.get("DS_TPU_OP_CACHE")
+    if env:
+        return Path(env)
+    # Per-user cache (torch-extensions-style ~/.cache layout), NOT a
+    # source-tree path: builds are content-addressed, and a single
+    # location means a DS_BUILD_OPS prebuild at pip-install time (which
+    # runs in a throwaway copy of the tree) is found by the installed
+    # package at runtime.
+    return Path(os.environ.get("XDG_CACHE_HOME",
+                               Path.home() / ".cache")) / \
+        "deepspeed_tpu" / "op_cache"
+
+
+CACHE_DIR = _default_cache_dir()
 
 
 class OpBuilder:

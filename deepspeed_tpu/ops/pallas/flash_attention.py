@@ -113,15 +113,18 @@ def _from_bh(x, B, H):
     return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
 
-# TPU vector lanes: narrow per-row scalars (lse, delta) are stored broadcast
-# along a trailing lane dim so their blocks satisfy the (8, 128) tiling rule.
-_LANES = 128
-
-
+# Per-row scalars (lse, delta) live in HBM as [B*H, T, 1] — compact, not
+# lane-broadcast. A (1, block_q, 1) block DMAs block_q contiguous words and
+# lands in VMEM as a [block_q, 1] sublane vector, which broadcasts over the
+# [block_q, block_k] score tile for free (the same m[:, None] pattern the
+# forward's scratch uses). The official jax flash kernel instead broadcasts
+# these across all 128 lanes in HBM ([.., T, 128] fp32) — 128x the bytes,
+# re-streamed on every q-step of the dK/dV grid; at long sequence lengths
+# that stream dwarfs the q/k/v traffic itself.
 def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
                 interpret=False):
-    """Returns (out [B,T,H,D], lse [B*H,T,_LANES]) — lse is the softmax row
-    logsumexp residual (lane-broadcast) consumed by the backward kernels."""
+    """Returns (out [B,T,H,D], lse [B*H,T,1]) — lse is the softmax row
+    logsumexp residual consumed by the backward kernels."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -179,8 +182,7 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
         def _finish():
             o_ref[0] = (acc_ref[:] /
                         l_ref[:, 0][:, None]).astype(o_ref.dtype)
-            lse = m_ref[:, 0] + jnp.log(l_ref[:, 0])
-            lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, _LANES))
+            lse_ref[0] = (m_ref[:, 0] + jnp.log(l_ref[:, 0]))[:, None]
 
     grid = (B * H, n_q, n_k)
     out, lse = pl.pallas_call(
@@ -193,12 +195,11 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, _LANES),
-                         lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((B * H, T, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -234,9 +235,7 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
     qh, kh, vh = _to_bh(q), _to_bh(k), _to_bh(v)
     oh, gh = _to_bh(out), _to_bh(g)
     delta = jnp.sum(gh.astype(jnp.float32) * oh.astype(jnp.float32),
-                    axis=-1)                               # [BH, T]
-    delta = jnp.broadcast_to(delta[..., None],
-                             delta.shape + (_LANES,))      # lane-padded
+                    axis=-1, keepdims=True)                # [BH, T, 1]
 
     def scores(q_ref, k_ref, qi, ki):
         qb = q_ref[0].astype(jnp.float32)                  # [bq, D]
@@ -293,10 +292,8 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, _LANES),
-                         lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, _LANES),
-                         lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D),
                                lambda bh, qi, ki: (bh, qi, 0)),
@@ -351,10 +348,8 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, _LANES),
-                         lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, _LANES),
-                         lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
@@ -390,15 +385,11 @@ def _flash_pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
                       interpret):
     out, lse = _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
                            interpret)
-    # Store the residual compact [B*H, T]: holding the lane-broadcast
-    # [B*H, T, 128] form from forward to backward would be a 128x HBM
-    # blowup; the backward re-broadcasts it.
-    return out, (q, k, v, out, lse[..., 0])
+    return out, (q, k, v, out, lse)
 
 
 def _flash_pallas_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
-    lse = jnp.broadcast_to(lse[..., None], lse.shape + (_LANES,))
     return _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale,
                        block_q, block_k, interpret)
 

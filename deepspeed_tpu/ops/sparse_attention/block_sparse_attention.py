@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.pallas.flash_attention import (
-    _LANES, _from_bh, _to_bh, DEFAULT_MASK_VALUE)
+    _from_bh, _to_bh, DEFAULT_MASK_VALUE)
 
 
 # ---------------------------------------------------------------------------
@@ -199,8 +199,9 @@ def _gather_attn(attn_add, lut_h, block, nq):
 
 def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
                  interpret=False):
-    """Returns (out [B,T,H,D], lse [B*H,T,_LANES]) — the logsumexp residual
-    feeds the backward kernels."""
+    """Returns (out [B,T,H,D], lse [B*H,T,1]) — the logsumexp residual
+    feeds the backward kernels (compact, not lane-broadcast — see the
+    layout note in ops/pallas/flash_attention.py)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -256,8 +257,7 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
             o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
             # empty rows keep lse = -inf + log(1e-30): harmless, the bwd
             # kernels never visit them (no LUT entries)
-            lse = m_ref[:, 0] + jnp.log(l)
-            lse_ref[0] = jnp.broadcast_to(lse[:, None], (block, _LANES))
+            lse_ref[0] = (m_ref[:, 0] + jnp.log(l))[:, None]
 
     def k_index(bh, qi, j, lut_ref, nnz_ref):
         h = jax.lax.rem(bh, H)
@@ -275,7 +275,7 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
         out_specs=[
             pl.BlockSpec((1, block, D),
                          lambda bh, qi, j, lut_ref, nnz_ref: (bh, qi, 0)),
-            pl.BlockSpec((1, block, _LANES),
+            pl.BlockSpec((1, block, 1),
                          lambda bh, qi, j, lut_ref, nnz_ref: (bh, qi, 0)),
         ],
         scratch_shapes=[
@@ -289,7 +289,7 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((B * H, T, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
         ],
         interpret=interpret,
     )(lut_flat, nnz_flat, q, k, v)
@@ -315,8 +315,7 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
     qh, kh, vh = _to_bh(q), _to_bh(k), _to_bh(v)
     oh, gh = _to_bh(out), _to_bh(g)
     delta = jnp.sum(gh.astype(jnp.float32) * oh.astype(jnp.float32),
-                    axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
+                    axis=-1, keepdims=True)                # [BH, T, 1]
 
     lut_flat = jnp.asarray(lut.reshape(H * nq * max_nnz), jnp.int32)
     nnz_flat = jnp.asarray(nnz.reshape(H * nq), jnp.int32)
@@ -385,8 +384,8 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
                 pl.BlockSpec((1, block, D), k_index),
                 pl.BlockSpec((1, block, D), k_index),
                 pl.BlockSpec((1, block, D), q_row),
-                pl.BlockSpec((1, block, _LANES), q_row),
-                pl.BlockSpec((1, block, _LANES), q_row),
+                pl.BlockSpec((1, block, 1), q_row),
+                pl.BlockSpec((1, block, 1), q_row),
             ],
             out_specs=pl.BlockSpec((1, block, D), q_row),
             scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
@@ -450,8 +449,8 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
                 pl.BlockSpec((1, block, D), k_row),
                 pl.BlockSpec((1, block, D), k_row),
                 pl.BlockSpec((1, block, D), q_via_lut_t),
-                pl.BlockSpec((1, block, _LANES), q_via_lut_t),
-                pl.BlockSpec((1, block, _LANES), q_via_lut_t),
+                pl.BlockSpec((1, block, 1), q_via_lut_t),
+                pl.BlockSpec((1, block, 1), q_via_lut_t),
             ],
             out_specs=[
                 pl.BlockSpec((1, block, D), k_row),
@@ -493,13 +492,10 @@ def _make_sparse_fn(layout_bytes, layout_shape, block, causal, sm_scale,
     def f_fwd(q, k, v):
         out, lse = _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
                                 interpret=interpret)
-        # residual stored compact [B*H, T] — the lane-broadcast form
-        # would hold 128x the bytes from forward to backward
-        return out, (q, k, v, out, lse[..., 0])
+        return out, (q, k, v, out, lse)
 
     def f_bwd(res, g):
         q, k, v, out, lse = res
-        lse = jnp.broadcast_to(lse[..., None], lse.shape + (_LANES,))
         return _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t,
                                 nnz_t, block, causal, sm_scale,
                                 interpret=interpret)

@@ -56,6 +56,27 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
             raise RuntimeError(
                 "DS_TPU_NUM_PROCESSES is set but DS_TPU_COORDINATOR is "
                 "missing — partial launcher env")
+        # NB: can't ask jax.default_backend() here — that would initialize
+        # the backend, and jax.distributed.initialize must run first. Use
+        # pre-init signals only, and only a *positive* off-TPU signal: a
+        # platform env set without "tpu", or no libtpu importable (TPU VMs
+        # always ship it). An unset env on a TPU pod must keep working —
+        # process_id auto-detects there.
+        platforms = (os.environ.get("JAX_PLATFORMS")
+                     or os.environ.get("JAX_PLATFORM_NAME") or "")
+        if platforms:
+            off_tpu = "tpu" not in platforms.lower()
+        else:
+            import importlib.util
+            off_tpu = importlib.util.find_spec("libtpu") is None
+        if not explicit_coordinator and process_id is None and off_tpu:
+            # process_id=None only auto-detects on TPU pods; off-TPU it
+            # dies deep inside the backend with an obscure error — fail
+            # with the same loud partial-env message instead.
+            raise RuntimeError(
+                "DS_TPU_NUM_PROCESSES is set but DS_TPU_PROCESS_ID is "
+                "missing — partial launcher env (process_id only "
+                "auto-detects on TPU pods)")
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)

@@ -775,7 +775,11 @@ class DeepSpeedEngine:
         ...`` before the first ``train_batch``."""
         import re
 
-        pat = re.compile(r"embed|wte|wpe|vocab|token|lookup", re.I)
+        # "emb" only as a whole path component ("emb", "tok_emb.weight") so
+        # e.g. "member" doesn't false-positive.
+        pat = re.compile(
+            r"embed|wte|wpe|vocab|token|lookup|(?:^|[._/])emb(?:[._/]|$)",
+            re.I)
         pred = getattr(self, "sparse_grad_predicate", None) or (
             lambda names, leaf: leaf.ndim == 2 and
             any(pat.search(n) for n in names))
@@ -785,7 +789,24 @@ class DeepSpeedEngine:
                      for p in path]
             return bool(pred(names, leaf))
 
-        return jax.tree_util.tree_map_with_path(flag, self.params)
+        flags = jax.tree_util.tree_map_with_path(flag, self.params)
+        if not any(jax.tree_util.tree_leaves(flags)):
+            # The reference's detection is structural (nn.Embedding,
+            # engine.py:177-183) and so cannot miss; a name predicate can.
+            # With sparse_gradients on and zero matches, every leaf would
+            # silently take the dense path — say so loudly.
+            logger.warning(
+                "sparse_gradients is enabled but the embedding predicate "
+                "matched NO parameter leaves — every gradient will use the "
+                "dense allreduce path. Set engine.sparse_grad_predicate to "
+                "select your embedding tables (param path names: %s).",
+                [
+                    "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                             for p in path)
+                    for path, _ in
+                    jax.tree_util.tree_flatten_with_path(self.params)[0]
+                ][:16])
+        return flags
 
     def _make_sparse_grad_train_step(self):
         """Compiled step with CSR sparse embedding-gradient communication
@@ -799,12 +820,15 @@ class DeepSpeedEngine:
         ranks to the max nnz) and exchanged by index/value all_gather;
         every other leaf takes a dense pmean.
 
-        Exactness caveat: a *tied* embedding (also used as the output head,
-        e.g. GPT-2 wte) gets a dense gradient through the softmax — more
-        touched rows than the token budget. The step therefore reports the
-        L1 mass the top-``k`` truncation dropped (``sparse_grad_dropped``
-        metric) and ``train_batch`` warns when it is nonzero; use
-        ``engine.sparse_grad_predicate`` to exclude such leaves."""
+        Exactness: a *tied* embedding (also used as the output head, e.g.
+        GPT-2 wte) gets a dense gradient through the softmax — more touched
+        rows than the token budget. Such leaves take a per-leaf in-jit
+        dense fallback (a pmax-replicated vote over the mass the top-``k``
+        truncation would drop selects ``pmean`` instead of the CSR
+        exchange), so the step is *always* exact; ``sparse_grad_dropped`` /
+        ``sparse_grad_dense_fallbacks`` metrics surface the lost bandwidth
+        win and ``train_batch`` warns once; use
+        ``engine.sparse_grad_predicate`` to exclude such leaves up front."""
         from deepspeed_tpu.runtime.csr_tensor import (csr_allreduce,
                                                       dense_to_csr)
 
@@ -846,21 +870,40 @@ class DeepSpeedEngine:
                 if jnp.issubdtype(leaf.dtype, jnp.integer))
 
             dropped = jnp.asarray(0.0, jnp.float32)
+            fallbacks = jnp.asarray(0, jnp.int32)
 
             def reduce_leaf(is_sparse, g):
-                nonlocal dropped
+                nonlocal dropped, fallbacks
                 if is_sparse and 0 < tokens < g.shape[0]:
                     csr = dense_to_csr(g, min(tokens, g.shape[0]))
-                    # L1 mass the static top-k truncation lost (nonzero ⇒
-                    # this leaf's grad was denser than the token budget,
-                    # e.g. a tied embedding — surfaced as a metric).
-                    dropped += (jnp.abs(g).sum() -
-                                jnp.abs(csr.values).sum()).astype(jnp.float32)
-                    return csr_allreduce(csr, "data").to_dense()
+                    # L1 mass the static top-k truncation would lose.
+                    # Meaningfully nonzero ⇒ this leaf's grad is denser
+                    # than the token budget (e.g. a *tied* embedding,
+                    # whose LM-head softmax grad is dense over the vocab)
+                    # — truncating would silently drop real gradient every
+                    # step, so the leaf falls back to the exact dense
+                    # pmean. The vote compares *relative* mass (full-array
+                    # and top-k reductions round differently — an absolute
+                    # >0 test would flap on ULP noise) and is pmax'd so
+                    # every shard takes the same cond branch.
+                    g_l1 = jnp.abs(g).sum().astype(jnp.float32)
+                    leaf_dropped = jax.lax.pmax(
+                        (g_l1 -
+                         jnp.abs(csr.values).sum()).astype(jnp.float32),
+                        "data")
+                    use_dense = leaf_dropped > 1e-6 * jax.lax.pmax(
+                        g_l1, "data")
+                    # only count mass when the vote fires — below the
+                    # relative threshold it is reduction-order noise
+                    dropped += jnp.where(use_dense, leaf_dropped, 0.0)
+                    fallbacks += use_dense.astype(jnp.int32)
+                    return jax.lax.cond(
+                        use_dense,
+                        lambda: jax.lax.pmean(g, "data"),
+                        lambda: csr_allreduce(csr, "data").to_dense())
                 return jax.lax.pmean(g, "data")
 
             grads = jax.tree_util.tree_map(reduce_leaf, sparse_flags, grads)
-            dropped = jax.lax.pmax(dropped, "data")
 
             # Grads are now replicated-global, so no cross-shard vote or
             # norm reduction is needed past this point.
@@ -887,6 +930,7 @@ class DeepSpeedEngine:
                 loss_sum, accum, grad_norm, applied_norm, lr, scale,
                 overflow, loss_reduce=lambda l: jax.lax.pmean(l, "data"))
             metrics["sparse_grad_dropped"] = dropped
+            metrics["sparse_grad_dense_fallbacks"] = fallbacks
             return params_out, opt_out, dstate_out, metrics
 
         P = PartitionSpec
@@ -901,7 +945,8 @@ class DeepSpeedEngine:
         metrics_specs = {k: rep for k in ("loss", "grad_norm",
                                           "applied_grad_norm", "lr",
                                           "loss_scale", "overflow",
-                                          "sparse_grad_dropped")}
+                                          "sparse_grad_dropped",
+                                          "sparse_grad_dense_fallbacks")}
         mapped = jax.shard_map(
             step_local, mesh=self.mesh,
             in_specs=(param_specs, opt_specs, dstate_specs, P(None, "data"),
@@ -1092,10 +1137,14 @@ class DeepSpeedEngine:
             if float(metrics["sparse_grad_dropped"]) > 1e-7:
                 self._warned_sparse_dropped = True
                 logger.warning(
-                    "sparse_gradients dropped %.3e of gradient L1 mass: an "
-                    "embedding leaf's gradient is denser than the token "
-                    "budget (tied output head?). Exclude it via "
-                    "engine.sparse_grad_predicate.",
+                    "sparse_gradients: %d embedding leaf/leaves had "
+                    "gradients denser than the token budget (%.3e L1 mass "
+                    "beyond top-k — tied output head?) and fell back to "
+                    "the exact dense allreduce. Training is exact, but the "
+                    "CSR bandwidth win is lost for those leaves; exclude "
+                    "them via engine.sparse_grad_predicate to silence "
+                    "this.",
+                    int(metrics.get("sparse_grad_dense_fallbacks", 0)),
                     float(metrics["sparse_grad_dropped"]))
 
         self.micro_steps += self._config.gradient_accumulation_steps

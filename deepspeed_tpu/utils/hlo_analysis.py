@@ -1,0 +1,69 @@
+"""Static HLO communication accounting.
+
+The reference *claims* its 1-bit Adam moves ~5x less data
+(`README.md:19,40`, `runtime/fp16/onebit_adam.py:104-228`) but never
+measures it; NCCL traffic is invisible to the framework. Under XLA the
+wire volume is a *compile-time* artifact: every collective is an HLO op
+with a static shape, so the bytes a compiled step moves per device can be
+read off the HLO text. ``collective_bytes`` does exactly that — the basis
+of the pinned byte-ratio test in ``tests/unit/test_onebit_adam.py``.
+"""
+
+import re
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+# e.g. "f32[8,128]{1,0}" or "u8[16]" or "f32[]"
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+# `%name = <shape-or-tuple> <op>(` — ops may be async "-start" forms;
+# "-done" forms return the same buffer and are skipped to avoid double
+# counting.
+_COLLECTIVES = ("all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+                "collective-permute", "collective-broadcast")
+_OP_RE = re.compile(
+    r"=\s+(?P<shape>\([^)]*\)|\S+)\s+"
+    r"(?P<op>" + "|".join(_COLLECTIVES) + r")(?P<suffix>-start|-done)?\(")
+
+
+def _shape_bytes(shape_text):
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        if dtype not in _DTYPE_BYTES:
+            continue  # token/opaque types carry no payload
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_bytes(hlo_text):
+    """Sum output bytes of every collective op in an HLO dump.
+
+    Returns ``{op_name: bytes, ..., "total": bytes}``. Async pairs are
+    counted once (the ``-start``); tuple outputs sum their array elements.
+    For ``all-reduce``/``all-to-all`` the output size equals the input
+    size, so "output bytes" is the per-device payload in both directions
+    of a symmetric exchange — a consistent basis for *ratios* between two
+    programs, which is what the tests pin.
+    """
+    counts = {}
+    for m in _OP_RE.finditer(hlo_text):
+        if m.group("suffix") == "-done":
+            continue
+        op = m.group("op")
+        b = _shape_bytes(m.group("shape"))
+        # async-start outputs are (operand_alias, result, scratch...);
+        # halve to avoid counting the aliased input buffer.
+        if m.group("suffix") == "-start" and m.group("shape").startswith("("):
+            b //= 2
+        counts[op] = counts.get(op, 0) + b
+    counts["total"] = sum(counts.values())
+    return counts

@@ -1,18 +1,28 @@
-"""Version info (reference: `deepspeed/git_version_info.py`)."""
+"""Version info (reference: `deepspeed/git_version_info.py:1-20` — try the
+build-time-stamped module first, fall back to live git in a checkout)."""
 
-version = "0.1.0"
+version = "0.3.0"
 git_hash = None
 git_branch = None
 
 try:
-    import subprocess
-    _out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
-                          capture_output=True, text=True, timeout=2)
-    if _out.returncode == 0:
-        git_hash = _out.stdout.strip()
-    _out = subprocess.run(["git", "rev-parse", "--abbrev-ref", "HEAD"],
-                          capture_output=True, text=True, timeout=2)
-    if _out.returncode == 0:
-        git_branch = _out.stdout.strip()
-except Exception:
-    pass
+    # Written by setup.py's build_py at install time.
+    from deepspeed_tpu.git_version_info_installed import (  # noqa: F401
+        version, git_hash, git_branch)
+except ImportError:
+    try:
+        import os
+        import subprocess
+        _cwd = os.path.dirname(os.path.abspath(__file__))
+        _out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                              capture_output=True, text=True, timeout=2,
+                              cwd=_cwd)
+        if _out.returncode == 0:
+            git_hash = _out.stdout.strip()
+        _out = subprocess.run(["git", "rev-parse", "--abbrev-ref", "HEAD"],
+                              capture_output=True, text=True, timeout=2,
+                              cwd=_cwd)
+        if _out.returncode == 0:
+            git_branch = _out.stdout.strip()
+    except Exception:
+        pass

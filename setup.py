@@ -1,0 +1,80 @@
+"""Build hooks for deepspeed_tpu (metadata lives in pyproject.toml).
+
+Reference `setup.py:1-188` parity, redesigned for a JIT-native-op world:
+
+- **Version stamping** (reference setup.py:100-160 writing
+  `deepspeed/git_version_info.py`): build_py writes
+  `deepspeed_tpu/git_version_info_installed.py` with the version and the
+  git hash/branch captured at build time, so installed copies report
+  provenance without a live git checkout.
+- **csrc as package data**: the native ops are g++-compiled C-ABI shared
+  libraries built on first use (`ops/op_builder/builder.py`); the wheel
+  carries their *sources* under `deepspeed_tpu/csrc/`.
+- **DS_BUILD_OPS=1** (reference setup.py:40-76 AOT op builds): prebuilds
+  every registered op into the op cache at install time instead of first
+  use.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py as _build_py
+
+HERE = Path(__file__).resolve().parent
+# Single source of truth: deepspeed_tpu/version.py (read, not imported —
+# importing would run its git-subprocess fallback at build time).
+VERSION = re.search(r'^version = "([^"]+)"',
+                    (HERE / "deepspeed_tpu" / "version.py").read_text(),
+                    re.M).group(1)
+
+
+def _git(*args):
+    try:
+        out = subprocess.run(["git", *args], cwd=HERE, capture_output=True,
+                             text=True, timeout=5)
+        return out.stdout.strip() if out.returncode == 0 else None
+    except OSError:
+        return None
+
+
+class build_py(_build_py):
+    def run(self):
+        super().run()
+        target_pkg = Path(self.build_lib) / "deepspeed_tpu"
+        if target_pkg.exists():
+            # 1) stamp version + git provenance (reference setup.py:100-160)
+            stamp = target_pkg / "git_version_info_installed.py"
+            stamp.write_text(
+                "# Generated at build time by setup.py (do not edit).\n"
+                f"version = {VERSION!r}\n"
+                f"git_hash = {_git('rev-parse', '--short', 'HEAD')!r}\n"
+                f"git_branch = {_git('rev-parse', '--abbrev-ref', 'HEAD')!r}\n"
+            )
+            # 2) ship the native-op sources inside the package
+            src_csrc = HERE / "csrc"
+            dst_csrc = target_pkg / "csrc"
+            if src_csrc.is_dir():
+                if dst_csrc.exists():
+                    shutil.rmtree(dst_csrc)
+                shutil.copytree(src_csrc, dst_csrc,
+                                ignore=shutil.ignore_patterns(
+                                    "*.so", "*.o", "__pycache__"))
+        # 3) optional AOT prebuild of every op (reference DS_BUILD_OPS)
+        if os.environ.get("DS_BUILD_OPS", "0") == "1":
+            import sys
+            sys.path.insert(0, str(HERE))
+            from deepspeed_tpu.ops.op_builder import ALL_OPS
+            for builder_cls in ALL_OPS.values():
+                b = builder_cls()
+                if b.is_compatible():
+                    print(f"DS_BUILD_OPS: prebuilding {b.NAME}")
+                    b.load(verbose=True)
+                else:
+                    print(f"DS_BUILD_OPS: skipping incompatible {b.NAME}")
+
+
+setup(version=VERSION, cmdclass={"build_py": build_py})

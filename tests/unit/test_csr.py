@@ -158,31 +158,70 @@ def test_sparse_grad_flags_detects_embedding():
     assert flags["head"]["kernel"] is False
 
 
-def test_sparse_gradients_tied_embedding_reports_dropped_mass():
-    """A tied embedding (used as output head) has a dense gradient; the
-    static top-k truncation must be *surfaced*, not silent."""
+def _tied_loss(params, batch, rng=None):
+    table = params["embedding"]["table"]
+    x = table[batch["ids"]].mean(axis=1)         # lookup (sparse grad)
+    logits = x @ table.T                         # tied head (dense grad)
+    lp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(lp, batch["label"][:, None],
+                                         axis=1))
+
+
+def _train_tied(sparse, steps=4):
     import deepspeed_tpu
-
-    def tied_loss(params, batch, rng=None):
-        table = params["embedding"]["table"]
-        x = table[batch["ids"]].mean(axis=1)         # lookup (sparse grad)
-        logits = x @ table.T                         # tied head (dense grad)
-        lp = jax.nn.log_softmax(logits)
-        return -jnp.mean(jnp.take_along_axis(lp, batch["label"][:, None],
-                                             axis=1))
-
     cfg = {"train_batch_size": 16, "optimizer":
            {"type": "Adam", "params": {"lr": 1e-2}},
-           "sparse_gradients": True, "steps_per_print": 1000}
+           "sparse_gradients": sparse, "steps_per_print": 1000}
     params = {"embedding": {"table":
               jax.random.normal(jax.random.PRNGKey(0), (256, 16)) * 0.1}}
     engine, _, _, _ = deepspeed_tpu.initialize(
-        config=cfg, loss_fn=tied_loss, params=params)
+        config=cfg, loss_fn=_tied_loss, params=params)
     rng = np.random.default_rng(0)
     batch = {"ids": rng.integers(0, 256, (16, 4)).astype(np.int32),
              "label": rng.integers(0, 256, (16,)).astype(np.int32)}
-    engine.train_batch(batch)
-    # 16*4=64 token budget < 256 dense rows → truncation happened and the
-    # metric + warn-once flag must say so.
+    losses = [float(engine.train_batch(batch)) for _ in range(steps)]
+    return losses, engine
+
+
+def test_sparse_gradients_tied_embedding_falls_back_dense_and_is_exact():
+    """A tied embedding (used as output head) has a dense gradient over
+    the whole vocab — denser than the static top-k token budget. The
+    engine must (a) detect the would-be truncation, (b) fall back to the
+    exact dense pmean for that leaf in-jit, and (c) surface both as
+    metrics + a warning. Numerics must match the dense engine exactly."""
+    losses, engine = _train_tied(sparse=True)
+    # 16*4=64 token budget < 256 dense rows → truncation would happen.
     assert float(engine._last_metrics["sparse_grad_dropped"]) > 0
+    assert int(engine._last_metrics["sparse_grad_dense_fallbacks"]) >= 1
     assert getattr(engine, "_warned_sparse_dropped", False)
+    # The fallback makes the step exact: tied curve == dense-path curve.
+    dense_losses, _ = _train_tied(sparse=False)
+    np.testing.assert_allclose(losses, dense_losses, rtol=2e-5)
+
+
+def test_sparse_gradients_zero_match_warns(caplog):
+    """`sparse_gradients: true` with a predicate matching no leaves must
+    warn loudly (reference detection is structural and cannot miss,
+    engine.py:177-183; a name predicate can)."""
+    import logging
+    import deepspeed_tpu
+
+    def mlp_loss(params, batch, rng=None):
+        return jnp.mean((batch["x"] @ params["dense"]["w"]) ** 2)
+
+    cfg = {"train_batch_size": 8, "optimizer":
+           {"type": "Adam", "params": {"lr": 1e-2}},
+           "sparse_gradients": True, "steps_per_print": 1000}
+    params = {"dense": {"w":
+              jax.random.normal(jax.random.PRNGKey(0), (16, 16)) * 0.1}}
+    ds_logger = logging.getLogger("deepspeed_tpu")
+    ds_logger.propagate = True        # package logger defaults to False
+    try:
+        with caplog.at_level(logging.WARNING, logger="deepspeed_tpu"):
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                config=cfg, loss_fn=mlp_loss, params=params)
+            engine.train_batch({"x": np.ones((8, 16), np.float32)})
+    finally:
+        ds_logger.propagate = False
+    assert any("matched NO parameter leaves" in r.getMessage()
+               for r in caplog.records)

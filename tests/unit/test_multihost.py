@@ -103,3 +103,26 @@ def test_two_process_rendezvous_and_training(tmp_path):
     # the identical global loss every step
     assert results[0] == results[1], results
     assert results[0][-1] < results[0][0]
+
+
+def test_partial_env_missing_coordinator_raises(monkeypatch):
+    from deepspeed_tpu.parallel import mesh
+    monkeypatch.setattr(mesh, "_initialized", False)
+    monkeypatch.delenv("DS_TPU_COORDINATOR", raising=False)
+    monkeypatch.setenv("DS_TPU_NUM_PROCESSES", "2")
+    monkeypatch.setenv("DS_TPU_PROCESS_ID", "0")
+    with pytest.raises(RuntimeError, match="DS_TPU_COORDINATOR is\n?\\s*missing"):
+        mesh.initialize_distributed()
+
+
+def test_partial_env_missing_process_id_raises(monkeypatch):
+    """process_id=None only auto-detects on TPU pods; off-TPU the backend
+    fails obscurely — the partial env must fail loudly instead."""
+    from deepspeed_tpu.parallel import mesh
+    monkeypatch.setattr(mesh, "_initialized", False)
+    monkeypatch.setenv("DS_TPU_COORDINATOR", "127.0.0.1:1")
+    monkeypatch.setenv("DS_TPU_NUM_PROCESSES", "2")
+    monkeypatch.delenv("DS_TPU_PROCESS_ID", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    with pytest.raises(RuntimeError, match="DS_TPU_PROCESS_ID"):
+        mesh.initialize_distributed()
