@@ -155,6 +155,35 @@ class PipelineParts:
         return layer.apply(p, x, rng)
 
 
+
+def _leaf_names(path):
+    return [str(getattr(q, "key", getattr(q, "idx", q))) for q in path]
+
+
+def _is_expert_leaf(path, a):
+    """Expert-banked body leaves (named ``expert_*`` with a bank dim, e.g.
+    `moe/expert_pipe.py:ExpertParallelFFNLayer`) shard their bank dim over
+    the ``expert`` mesh axis instead of replicating. The same predicate
+    gates the spec AND the gradient tail reduction — they must agree, or a
+    replicated leaf would skip its expert pmean (rank-divergent grads
+    under a replicated out-spec)."""
+    return (any(n.startswith("expert_") for n in _leaf_names(path))
+            and a.ndim >= 3)
+
+
+def body_param_specs(body_params):
+    """Per-leaf PartitionSpecs for the stacked body [S, L/S, ...]: stage
+    dim over ``pipe``; expert banks additionally put their bank dim (the
+    first post-stack dim) over ``expert``."""
+
+    def spec(path, a):
+        if _is_expert_leaf(path, a):
+            return P("pipe", None, "expert", *([None] * (a.ndim - 3)))
+        return P("pipe", *([None] * (a.ndim - 1)))
+
+    return jax.tree_util.tree_map_with_path(spec, body_params)
+
+
 def build_pipeline_parts(module, num_stages: int, rng,
                          example_micro) -> PipelineParts:
     """Build layers, initialize params, and stack the body.
@@ -232,8 +261,7 @@ def build_pipeline_parts(module, num_stages: int, rng,
         "prologue": spec_of("prologue"),
         "epilogue": spec_of("epilogue"),
         "tied": spec_of("tied"),
-        "body": jax.tree_util.tree_map(
-            lambda a: P("pipe", *([None] * (a.ndim - 1))), params["body"]),
+        "body": body_param_specs(params["body"]),
     }
 
     loss_fn = module.loss_fn
@@ -428,8 +456,7 @@ def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
     use_rng = rng is not None
     key = rng if use_rng else jnp.zeros((2,), jnp.uint32)
 
-    body_specs = jax.tree_util.tree_map(
-        lambda a: P("pipe", *([None] * (a.ndim - 1))), params["body"])
+    body_specs = body_param_specs(params["body"])
     rest_specs = jax.tree_util.tree_map(lambda _: P(), rest)
     batch_specs = jax.tree_util.tree_map(
         lambda _: P(None, "data"), batch_m)
@@ -661,8 +688,16 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
             gr_acc)
         if axis_tail:
             loss = lax.pmean(loss, axis_tail)
-            gb_acc = jax.tree_util.tree_map(
-                lambda a: lax.pmean(a, axis_tail), gb_acc)
+            # Replicated leaves: identical per-rank grads (expert-partial
+            # cotangents are already psum'd in-layer by psum_grad), so
+            # pmean is exact. Expert-SHARDED leaves hold genuinely
+            # different shards — never mix them across ``expert``.
+            def tail_mean(path, a):
+                axes = tuple(ax for ax in axis_tail
+                             if not (ax == "expert" and
+                                     _is_expert_leaf(path, a)))
+                return lax.pmean(a, axes) if axes else a
+            gb_acc = jax.tree_util.tree_map_with_path(tail_mean, gb_acc)
             gr_acc = jax.tree_util.tree_map(
                 lambda a: lax.pmean(a, axis_tail), gr_acc)
         # restore the leading stage dim the shard_map out_spec strips
