@@ -47,6 +47,21 @@ def bert_flops_per_token(cfg, seq_len):
     return 6 * (block_params + head) + attention
 
 
+
+def time_engine_steps(engine, batch, steps, warmup=2):
+    """Warm up, then time `steps` train_batch calls. float() forces full
+    materialization — on the axon relay, block_until_ready alone can
+    return before execution completes."""
+    for _ in range(warmup):
+        float(engine.train_batch(batch))
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = engine.train_batch(batch)
+    float(loss)
+    return time.perf_counter() - t0
+
+
 def run_once_bert(jax, bs, seq_len, steps):
     """BERT-Large MLM pretraining step — the reference's headline bench
     (64 TFLOPS / 272 samples/s on V100 at seq128,
@@ -76,13 +91,7 @@ def run_once_bert(jax, bs, seq_len, steps):
     batch = {"input_ids": rng.integers(
         0, cfg.vocab_size, (bs, seq_len)).astype(np.int32),
         "labels": labels}
-    for _ in range(2):
-        float(engine.train_batch(batch))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch(batch)
-    float(loss)
-    dt = time.perf_counter() - t0
+    dt = time_engine_steps(engine, batch, steps)
     tokens_per_sec = bs * seq_len * steps / dt
     tflops = tokens_per_sec * bert_flops_per_token(cfg, seq_len) / 1e12
     return bs * steps / dt, tokens_per_sec, tflops
@@ -96,13 +105,26 @@ CACHE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "BENCH_TPU_CACHE.json")
 
 
+def _cache_key():
+    return os.environ.get("BENCH_MODEL") or "default"
+
+
 def save_tpu_result(payload):
-    """Record a successful live TPU measurement so a later run facing a
-    wedged tunnel can report it (clearly labeled) instead of nothing."""
+    """Record a successful live TPU measurement (keyed by BENCH_MODEL) so a
+    later run facing a wedged tunnel can report the matching cached row
+    (clearly labeled) instead of nothing."""
     try:
+        try:
+            with open(CACHE_FILE) as f:
+                cache = json.load(f)
+            if "metric" in cache:      # migrate pre-r3 single-slot format
+                cache = {"default": cache}
+        except Exception:
+            cache = {}
+        cache[_cache_key()] = dict(payload, cached_at=time.strftime(
+            "%Y-%m-%d %H:%M:%S"))
         with open(CACHE_FILE, "w") as f:
-            json.dump(dict(payload, cached_at=time.strftime(
-                "%Y-%m-%d %H:%M:%S")), f)
+            json.dump(cache, f)
     except OSError:
         pass
 
@@ -110,7 +132,10 @@ def save_tpu_result(payload):
 def load_tpu_result():
     try:
         with open(CACHE_FILE) as f:
-            return json.load(f)
+            cache = json.load(f)
+        if "metric" in cache:          # pre-r3 single-slot format
+            cache = {"default": cache}
+        return cache.get(_cache_key())
     except Exception:
         return None
 
@@ -178,13 +203,52 @@ def init_backend_with_retry(retries=5, delay=10.0):
         raise last
 
 
+def run_once_gpt2_offload(jax, cfg_fn, batch_size, seq_len, steps,
+                          loss_chunk=512):
+    """North-star config (BASELINE.json): GPT-2 1.5B on ONE chip via
+    ZeRO-Offload (host fp32 masters + C++ Adam) + remat + chunked CE.
+    The reference's analog capability: 13B on one 32 GB V100
+    (docs/_tutorials/zero-offload.md:9) — v5e has 16 GB HBM."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2LMHead, init_gpt2_params, make_gpt2_loss_fn)
+
+    cfg = cfg_fn(n_positions=seq_len, remat=True, use_flash_attention=True,
+                 loss_chunk=loss_chunk)
+    model = GPT2LMHead(cfg)
+    params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
+    config = {
+        "train_batch_size": batch_size,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "cpu_offload": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=config, loss_fn=make_gpt2_loss_fn(model), params=params)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(batch_size, seq_len)).astype(np.int32)}
+    dt = time_engine_steps(engine, batch, steps, warmup=1)
+    tokens_per_sec = batch_size * seq_len * steps / dt
+    tflops = tokens_per_sec * model_flops_per_token(cfg, seq_len) / 1e12
+    peak_hbm = None
+    try:
+        stats = jax.devices()[0].memory_stats()
+        peak_hbm = stats.get("peak_bytes_in_use")
+    except Exception:
+        pass
+    return tokens_per_sec, tflops, peak_hbm
+
+
 def run_once(jax, cfg_fn, batch_size, seq_len, steps, remat, on_tpu):
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import (
         GPT2LMHead, init_gpt2_params, make_gpt2_loss_fn)
 
     cfg = cfg_fn(n_positions=seq_len, remat=remat,
-                 use_flash_attention=on_tpu)
+                 use_flash_attention=on_tpu,
+                 loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "0")))
     model = GPT2LMHead(cfg)
     params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
     loss_fn = make_gpt2_loss_fn(model)
@@ -202,8 +266,7 @@ def run_once(jax, cfg_fn, batch_size, seq_len, steps, remat, on_tpu):
     batch = {"input_ids": rng.integers(
         0, cfg.vocab_size, size=(batch_size, seq_len)).astype(np.int32)}
 
-    # warmup / compile (float() forces full materialization — on the axon
-    # relay, block_until_ready alone can return before execution completes)
+    # warmup / compile
     for _ in range(2):
         float(engine.train_batch(batch))
 
@@ -222,11 +285,7 @@ def run_once(jax, cfg_fn, batch_size, seq_len, steps, remat, on_tpu):
         except Exception:
             pass
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch(batch)
-    float(loss)
-    dt = time.perf_counter() - t0
+    dt = time_engine_steps(engine, batch, steps, warmup=0)
 
     tokens_per_sec = batch_size * seq_len * steps / dt
     if xla_flops:
@@ -247,7 +306,39 @@ def main():
 
     platform = devices[0].platform
     on_tpu = platform == "tpu"
-    if os.environ.get("BENCH_MODEL") == "bert_large" and not on_tpu:
+    bench_model = os.environ.get("BENCH_MODEL", "")
+    if bench_model in ("gpt2_1.5b", "gpt2_760m"):
+        # North star: largest single-chip model via ZeRO-Offload.
+        if not on_tpu:
+            emit({"metric": f"GPT-2 {bench_model[5:]} offload "
+                            "tokens/sec/chip", "value": 0,
+                  "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+                  "error": f"requires a TPU; backend is {platform!r}"})
+            return
+        from deepspeed_tpu.models.gpt2 import gpt2_1_5b, gpt2_760m
+        cfg_fn = gpt2_1_5b if bench_model == "gpt2_1.5b" else gpt2_760m
+        name = bench_model[5:]
+        try:
+            bs = int(os.environ.get("BENCH_BS", "4"))
+            tps, tflops, peak = run_once_gpt2_offload(
+                jax, cfg_fn, batch_size=bs, seq_len=1024,
+                steps=int(os.environ.get("BENCH_STEPS", "3")))
+            out = {"metric": f"GPT-2 {name} ZeRO-Offload train "
+                             f"tokens/sec/chip (bf16, seq1024, bs{bs}, "
+                             "remat, chunked-CE)",
+                   "value": round(tps, 1), "unit": "tokens/sec/chip",
+                   "vs_baseline": round(tflops / BASELINE_TFLOPS, 3)}
+            if peak:
+                out["peak_hbm_gb"] = round(peak / 2 ** 30, 2)
+            save_tpu_result(out)
+            emit(out)
+        except Exception as e:
+            emit({"metric": f"GPT-2 {name} offload tokens/sec/chip",
+                  "value": 0, "unit": "tokens/sec/chip",
+                  "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}",
+                  "traceback": traceback.format_exc(limit=5)})
+        return
+    if bench_model == "bert_large" and not on_tpu:
         emit({"metric": "BERT-Large MLM samples/sec/chip", "value": 0,
               "unit": "samples/sec/chip", "vs_baseline": 0.0,
               "error": f"BENCH_MODEL=bert_large requires a TPU; backend "
@@ -282,6 +373,8 @@ def main():
         cfg_name, batch_size, seq_len, steps = "125M(cpu-smoke)", 2, 128, 2
 
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0"))
+    loss_chunk_tag = f", chunked-CE{chunk}" if chunk else ""
     attempts = [(batch_size, remat), (batch_size, True), (batch_size // 2, True)]
     attempts = list(dict.fromkeys(attempts))  # dedupe when BENCH_REMAT=1
     err = tb = None
@@ -292,7 +385,8 @@ def main():
             out = {
                 "metric": f"GPT-2 {cfg_name} train tokens/sec/chip "
                           f"(bf16, seq{seq_len}, bs{bs}"
-                          f"{', remat' if rm else ''})",
+                          f"{', remat' if rm else ''}"
+                          f"{loss_chunk_tag})",
                 "value": round(tokens_per_sec, 1),
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(tflops / BASELINE_TFLOPS, 3),

@@ -29,7 +29,19 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16        # compute dtype (MXU-native)
     param_dtype: Any = jnp.float32   # master param dtype
     remat: bool = False              # activation checkpointing per block
+    remat_policy: str = "full"       # "full" | "dots" | "nothing":
+    #                                  full = save only block inputs;
+    #                                  dots = save matmul outputs
+    #                                  (jax.checkpoint_policies.
+    #                                  checkpoint_dots) — recompute just
+    #                                  the elementwise/softmax tails, the
+    #                                  usual best trade on TPU where bwd
+    #                                  is HBM-bound; nothing = save all
+    #                                  (policy-form of remat=False)
     use_flash_attention: bool = False  # Pallas flash-attention kernel
+    loss_chunk: int = 0              # >0: chunked cross-entropy over the
+    #                                  vocab head (never materializes the
+    #                                  [B, T, vocab] logits in HBM)
 
 
 # Sizes follow the reference perf-harness configs
@@ -159,7 +171,8 @@ class GPT2LMHead(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, deterministic=True, pld_theta=None):
+    def __call__(self, input_ids, deterministic=True, pld_theta=None,
+                 return_hidden=False):
         cfg = self.config
         B, T = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
@@ -172,11 +185,23 @@ class GPT2LMHead(nn.Module):
 
         block_cls = Block
         if cfg.remat:
-            block_cls = nn.remat(Block, prevent_cse=False)
+            policies = {
+                "full": None,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "nothing": jax.checkpoint_policies.everything_saveable,
+            }
+            if cfg.remat_policy not in policies:
+                raise ValueError(
+                    f"remat_policy {cfg.remat_policy!r} not in "
+                    f"{sorted(policies)}")
+            policy = policies[cfg.remat_policy]
+            block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
         for i in range(cfg.n_layer):
             x = block_cls(cfg, layer_idx=i, n_layers=cfg.n_layer,
                           name=f"h_{i}")(x, deterministic, pld_theta)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if return_hidden:
+            return x        # chunked-loss path applies the head itself
         logits = x @ wte.T.astype(cfg.dtype)
         return logits
 
@@ -200,6 +225,44 @@ def cross_entropy_loss(logits, labels, ignore_index=-100):
     return total / jnp.maximum(count, 1)
 
 
+def chunked_cross_entropy_sum_and_count(x, wte, labels, chunk,
+                                        ignore_index=-100):
+    """CE against a tied vocab head without materializing [B, T, V] logits.
+
+    At GPT-2 scale the fp32 logits are the single largest activation
+    (bs8 x 1024 x 50257 x 4 B ≈ 1.6 GB — the reason 760M OOMs with fp32
+    masters, BENCHNOTES r2). ``lax.scan`` over sequence chunks computes
+    each [B, chunk, V] logit tile, reduces it to (loss sum, count), and
+    drops it; ``jax.checkpoint`` on the body recomputes the tile in the
+    backward, so peak HBM is O(B * chunk * V) in both directions. The
+    head matmuls stay full-width [B*chunk, M] x [M, V] — MXU-shaped.
+
+    x: [B, T, M] final hidden states; wte: [V, M]; labels: [B, T].
+    """
+    B, T, M = x.shape
+    chunk = min(chunk, T)
+    n = -(-T // chunk)
+    pad = n * chunk - T
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)),
+                         constant_values=ignore_index)
+    xc = jnp.moveaxis(x.reshape(B, n, chunk, M), 1, 0)       # [n,B,c,M]
+    lc = jnp.moveaxis(labels.reshape(B, n, chunk), 1, 0)     # [n,B,c]
+    head = wte.T.astype(x.dtype)                             # [M, V]
+
+    @jax.checkpoint
+    def body(carry, inp):
+        s, cnt = carry
+        xcb, lcb = inp
+        ls, c = cross_entropy_sum_and_count(xcb @ head, lcb, ignore_index)
+        return (s + ls, cnt + c), None
+
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.int32(0)), (xc, lc))
+    return total, count
+
+
 def make_gpt2_loss_fn(model: GPT2LMHead):
     """loss_fn(params, batch, rng) for the engine.
 
@@ -219,6 +282,16 @@ def make_gpt2_loss_fn(model: GPT2LMHead):
         if rng is not None:
             d_rng, p_rng = jax.random.split(rng)
             rngs = {"dropout": d_rng, "pld": p_rng}
+        chunk = model.config.loss_chunk
+        if chunk:
+            hidden = model.apply(
+                {"params": params}, input_ids,
+                deterministic=rng is None, rngs=rngs,
+                pld_theta=pld_theta if rng is not None else None,
+                return_hidden=True)
+            total, count = chunked_cross_entropy_sum_and_count(
+                hidden, params["wte"], labels, chunk)
+            return total / jnp.maximum(count, 1)
         logits = model.apply({"params": params}, input_ids,
                              deterministic=rng is None, rngs=rngs,
                              pld_theta=pld_theta if rng is not None else None)
