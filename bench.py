@@ -109,6 +109,23 @@ def _cache_key():
     return os.environ.get("BENCH_MODEL") or "default"
 
 
+def _migrate_cache(cache):
+    """Pre-r3 cache was one flat row; key it by what it measured (the old
+    save path was shared by every BENCH_MODEL)."""
+    if "metric" not in cache:
+        return cache
+    metric = cache.get("metric", "")
+    if "BERT" in metric:
+        key = "bert_large"
+    elif "Offload" in metric and "1.5" in metric:
+        key = "gpt2_1.5b"
+    elif "Offload" in metric and "760" in metric:
+        key = "gpt2_760m"
+    else:
+        key = "default"
+    return {key: cache}
+
+
 def save_tpu_result(payload):
     """Record a successful live TPU measurement (keyed by BENCH_MODEL) so a
     later run facing a wedged tunnel can report the matching cached row
@@ -117,8 +134,7 @@ def save_tpu_result(payload):
         try:
             with open(CACHE_FILE) as f:
                 cache = json.load(f)
-            if "metric" in cache:      # migrate pre-r3 single-slot format
-                cache = {"default": cache}
+            cache = _migrate_cache(cache)
         except Exception:
             cache = {}
         cache[_cache_key()] = dict(payload, cached_at=time.strftime(
@@ -133,9 +149,7 @@ def load_tpu_result():
     try:
         with open(CACHE_FILE) as f:
             cache = json.load(f)
-        if "metric" in cache:          # pre-r3 single-slot format
-            cache = {"default": cache}
-        return cache.get(_cache_key())
+        return _migrate_cache(cache).get(_cache_key())
     except Exception:
         return None
 

@@ -24,13 +24,24 @@ import time
 import numpy as np
 
 
-def _timeit(fn, *args, iters=10):
+def _materialize(out):
+    """Force a device->host copy of one output: on the axon TPU relay,
+    block_until_ready alone can return before execution completes (see
+    bench.py:time_engine_steps); transferring any output of the XLA
+    program guarantees the whole program ran."""
     import jax
-    jax.block_until_ready(fn(*args))     # warmup/compile, whole pytree
+    first = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(first)
+    return out
+
+
+def _timeit(fn, *args, iters=10):
+    _materialize(fn(*args))              # warmup/compile
     t0 = time.perf_counter()
+    out = None
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _materialize(out)
     return (time.perf_counter() - t0) / iters * 1e3   # ms
 
 
@@ -104,8 +115,7 @@ def study_maxseq(jax, emit):
     def fits(make_fn, T):
         try:
             q, k, v = make_inputs(jax, B, T, H, D, jax.numpy.bfloat16)
-            out = fwd_bwd(make_fn(T))(q, k, v)
-            jax.block_until_ready(out)
+            _materialize(fwd_bwd(make_fn(T))(q, k, v))
             return True
         except MemoryError:
             return False                 # host-side (layout/LUT) OOM

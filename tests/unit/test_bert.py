@@ -5,6 +5,7 @@ and tensor-parallel spec coverage."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models.bert import (
@@ -22,6 +23,7 @@ def _mlm_batch(rng, B=8, T=32, vocab=256, mask_frac=0.15):
             "attention_mask": np.ones((B, T), np.int32)}
 
 
+@pytest.mark.slow
 def test_bert_forward_shapes():
     cfg = bert_tiny()
     model = BertForMaskedLM(cfg)
@@ -51,6 +53,7 @@ def test_bert_attention_mask_matters():
                                atol=1e-6)
 
 
+@pytest.mark.slow
 def test_bert_mlm_trains_through_engine():
     cfg = {
         "train_batch_size": 8,

@@ -27,6 +27,7 @@ def test_chunked_matches_dense_sum_and_count():
         assert int(ch[1]) == int(dense[1])
 
 
+@pytest.mark.slow
 def test_chunked_loss_fn_grads_match_dense():
     cfg_d = GPT2Config(vocab_size=64, n_positions=32, n_embd=16, n_layer=2,
                        n_head=2, dtype=jnp.float32)

@@ -96,6 +96,7 @@ def test_op_registry_and_compat():
     assert CPUAdamBuilder().load().ds_simd_width() in (1, 8, 16)
 
 
+@pytest.mark.slow
 def test_engine_zero_offload_end_to_end():
     """cpu_offload engine trains and tracks the on-device engine's losses
     (same model/data/optimizer; host C++ Adam vs device fused Adam)."""

@@ -1,6 +1,7 @@
 """GPT-2 model tests: forward shapes, loss, TP partition specs, engine e2e."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -78,6 +79,7 @@ def test_gpt2_trains_end_to_end():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_gpt2_tensor_parallel_mesh():
     """TP over the model axis: same loss as replicated run."""
     _, model, params = build_tiny()

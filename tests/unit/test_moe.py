@@ -5,6 +5,7 @@ end-to-end training through the engine."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.moe.layer import (MoE, MoEConfig, compute_capacity,
@@ -124,6 +125,7 @@ def test_moe_expert_parallel_matches_single_device():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_gpt2_moe_trains_through_engine():
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2_moe import (

@@ -76,6 +76,7 @@ def test_compressed_allreduce_identical_inputs():
     np.testing.assert_allclose(we_new[0], base - expect, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_compressed_allreduce_error_feedback_converges():
     """Iterating on a fixed target with error feedback: the running mean of
     transmitted values converges to the true mean (the EF-SGD property the
@@ -208,6 +209,7 @@ def test_onebit_compression_stage_converges():
         f"no convergence in compression stage: {warm_end} -> {tail}")
 
 
+@pytest.mark.slow
 def test_engine_onebit_end_to_end():
     """Engine-level: optimizer OneBitAdam through freeze into compression,
     loss decreasing throughout; checkpoint roundtrip of the error state."""

@@ -45,6 +45,7 @@ def test_split_specs_finds_body():
     assert len(pro) == 1 and len(body) == 4 and len(epi) == 2
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("pipe,data,micro", [(2, 1, 4), (4, 2, 4), (2, 4, 2)])
 def test_pipeline_loss_matches_sequential(pipe, data, micro):
     """The compiled rotation computes exactly the sequential loss."""
@@ -66,6 +67,7 @@ def test_pipeline_loss_matches_sequential(pipe, data, micro):
                                rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_grads_match_sequential():
     """Backward pipeline (AD through ppermute rotation) == sequential grads,
     including the tied embedding used by both first and last stage."""
@@ -94,6 +96,7 @@ def test_pipeline_grads_match_sequential():
             err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
 
 
+@pytest.mark.slow
 def test_pipeline_engine_trains():
     """End-to-end: loss decreases over steps on a pipe×data mesh."""
     micro = 4
@@ -116,6 +119,7 @@ def test_pipeline_engine_trains():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_pipeline_engine_with_zero_and_bf16():
     """Pipeline composes with ZeRO sharding of per-stage params + bf16."""
     config = {
@@ -137,6 +141,7 @@ def test_pipeline_engine_with_zero_and_bf16():
     assert np.isfinite(loss) and loss < l0
 
 
+@pytest.mark.slow
 def test_pipeline_engine_checkpoint_roundtrip(tmp_path):
     config = {
         "train_batch_size": 8,
@@ -224,6 +229,7 @@ def test_1f1b_value_and_grad_matches_sequential():
             err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
 
 
+@pytest.mark.slow
 def test_1f1b_memory_independent_of_microbatches():
     """THE 1F1B property (VERDICT r1 weak #3): per-stage live activation
     memory is bounded by the ring buffer (2S-1 slots), NOT by the number

@@ -59,6 +59,7 @@ def test_ring_attention_under_jit(seq_mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_gradients(seq_mesh):
     q, k, v = qkv(T=64, B=2, H=2, D=8)
 
@@ -84,6 +85,7 @@ def test_ulysses_matches_dense(seq_mesh, causal):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_gradients(seq_mesh):
     q, k, v = qkv(T=64, B=2, H=4, D=8)
 

@@ -67,6 +67,7 @@ def test_column_row_pair_matches_dense():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_tp_block_loss_and_grads_match_replicated_oracle():
     """Loss AND grads of the TP block on a dp×tp mesh == the replicated
     single-device oracle (the reference's mpu contract, engine.py:513-524,
@@ -104,6 +105,7 @@ def test_tp_block_loss_and_grads_match_replicated_oracle():
     assert not qkv.sharding.is_fully_replicated
 
 
+@pytest.mark.slow
 def test_gpt2_tp_training_matches_dp_through_engine():
     """Model-level TP: GPT-2 trained with Megatron-style specs on a
     model=2 mesh gives the same losses as pure data parallelism."""

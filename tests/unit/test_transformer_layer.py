@@ -154,6 +154,7 @@ def test_config_from_dict_and_json(tmp_path):
     assert cfg2.heads == 8 and cfg2.stochastic_mode
 
 
+@pytest.mark.slow
 def test_jit_and_seq_scaling():
     """Layer compiles under jit and handles the reference's shape matrix
     (a slice of test_cuda_forward's (batch, seq, hidden, heads) grid)."""
