@@ -74,7 +74,9 @@ def run_once_bert(jax, bs, seq_len, steps):
     import jax.numpy as jnp
 
     cfg = bert_large(max_position_embeddings=max(512, seq_len),
-                     dtype=jnp.bfloat16, use_flash_attention=True)
+                     dtype=jnp.bfloat16, use_flash_attention=True,
+                     loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK",
+                                                   "0")))
     model = BertForMaskedLM(cfg)
     params = init_bert_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
     config = {
@@ -364,8 +366,10 @@ def main():
         try:
             sps, tps, tflops = run_once_bert(jax, bs=128, seq_len=128,
                                              steps=20)
+            bchunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0"))
+            btag = f", chunked-CE{bchunk}" if bchunk else ""
             out = {"metric": "BERT-Large MLM samples/sec/chip (bf16, "
-                             "seq128, bs128)",
+                             f"seq128, bs128{btag})",
                    "value": round(sps, 1), "unit": "samples/sec/chip",
                    "vs_baseline": round(tflops / BASELINE_TFLOPS, 3)}
             save_tpu_result(out)

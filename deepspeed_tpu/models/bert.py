@@ -40,6 +40,8 @@ class BertConfig:
     # SparsityConfig instance → every layer's attention goes block-sparse
     # (the SparseAttentionUtils adoption path; heads must match).
     sparse_attention: Optional[Any] = None
+    loss_chunk: int = 0           # >0: chunked MLM cross-entropy (the
+    #                               [B, T, 30522] logits never materialize)
 
 
 def bert_base(**kw):
@@ -132,7 +134,7 @@ class BertForMaskedLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 deterministic=True):
+                 deterministic=True, return_hidden=False):
         cfg = self.config
         x = BertModel(cfg, name="bert")(
             input_ids, attention_mask, token_type_ids, deterministic)
@@ -140,17 +142,34 @@ class BertForMaskedLM(nn.Module):
         x = jax.nn.gelu(x, approximate=False)
         x = nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype,
                          name="transform_ln")(x)
+        if return_hidden:
+            return x    # chunked-loss path applies the decoder itself
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, name="decoder")(x)
         return logits
 
 
 def make_bert_mlm_loss_fn(model: BertForMaskedLM):
     """loss_fn(params, batch, rng): batch has input_ids [B,T], labels [B,T]
-    with -100 at unmasked positions, optional attention_mask [B,T]."""
-    from deepspeed_tpu.models.gpt2 import cross_entropy_loss
+    with -100 at unmasked positions, optional attention_mask [B,T].
+
+    With ``config.loss_chunk > 0`` the [B, T, vocab] logits never
+    materialize (chunked CE over the decoder head — see
+    models/gpt2.py:chunked_cross_entropy_with_head)."""
+    from deepspeed_tpu.models.gpt2 import (
+        chunked_cross_entropy_with_head, cross_entropy_loss)
 
     def loss_fn(params, batch, rng=None):
         rngs = {"dropout": rng} if rng is not None else {}
+        chunk = model.config.loss_chunk
+        if chunk:
+            hidden = model.apply(
+                {"params": params}, batch["input_ids"],
+                batch.get("attention_mask"), batch.get("token_type_ids"),
+                deterministic=rng is None, rngs=rngs, return_hidden=True)
+            total, count = chunked_cross_entropy_with_head(
+                hidden, params["decoder"]["kernel"],
+                params["decoder"]["bias"], batch["labels"], chunk)
+            return total / jnp.maximum(count, 1)
         logits = model.apply(
             {"params": params}, batch["input_ids"],
             batch.get("attention_mask"), batch.get("token_type_ids"),

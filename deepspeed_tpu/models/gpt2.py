@@ -225,9 +225,9 @@ def cross_entropy_loss(logits, labels, ignore_index=-100):
     return total / jnp.maximum(count, 1)
 
 
-def chunked_cross_entropy_sum_and_count(x, wte, labels, chunk,
-                                        ignore_index=-100):
-    """CE against a tied vocab head without materializing [B, T, V] logits.
+def chunked_cross_entropy_with_head(x, head, bias, labels, chunk,
+                                    ignore_index=-100):
+    """CE against a vocab head without materializing [B, T, V] logits.
 
     At GPT-2 scale the fp32 logits are the single largest activation
     (bs8 x 1024 x 50257 x 4 B ≈ 1.6 GB — the reason 760M OOMs with fp32
@@ -237,7 +237,8 @@ def chunked_cross_entropy_sum_and_count(x, wte, labels, chunk,
     backward, so peak HBM is O(B * chunk * V) in both directions. The
     head matmuls stay full-width [B*chunk, M] x [M, V] — MXU-shaped.
 
-    x: [B, T, M] final hidden states; wte: [V, M]; labels: [B, T].
+    x: [B, T, M] final hidden states; head: [M, V]; bias: [V] or None;
+    labels: [B, T].
     """
     B, T, M = x.shape
     chunk = min(chunk, T)
@@ -249,18 +250,31 @@ def chunked_cross_entropy_sum_and_count(x, wte, labels, chunk,
                          constant_values=ignore_index)
     xc = jnp.moveaxis(x.reshape(B, n, chunk, M), 1, 0)       # [n,B,c,M]
     lc = jnp.moveaxis(labels.reshape(B, n, chunk), 1, 0)     # [n,B,c]
-    head = wte.T.astype(x.dtype)                             # [M, V]
+    head = head.astype(x.dtype)
+    if bias is not None:
+        bias = bias.astype(x.dtype)
 
     @jax.checkpoint
     def body(carry, inp):
         s, cnt = carry
         xcb, lcb = inp
-        ls, c = cross_entropy_sum_and_count(xcb @ head, lcb, ignore_index)
+        logits = xcb @ head
+        if bias is not None:
+            logits = logits + bias
+        ls, c = cross_entropy_sum_and_count(logits, lcb, ignore_index)
         return (s + ls, cnt + c), None
 
     (total, count), _ = jax.lax.scan(
         body, (jnp.float32(0.0), jnp.int32(0)), (xc, lc))
     return total, count
+
+
+def chunked_cross_entropy_sum_and_count(x, wte, labels, chunk,
+                                        ignore_index=-100):
+    """Tied-head form: CE against ``wte.T`` (see
+    :func:`chunked_cross_entropy_with_head`)."""
+    return chunked_cross_entropy_with_head(x, wte.T, None, labels, chunk,
+                                           ignore_index)
 
 
 def make_gpt2_loss_fn(model: GPT2LMHead):

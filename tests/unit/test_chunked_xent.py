@@ -73,3 +73,35 @@ def test_chunked_loss_cuts_compiled_logit_memory():
     # Dense holds [B, T, V] fp32 logits (+ log_softmax residents) ≈ 8 MB
     # at these shapes; chunked peaks at [B, 32, V].
     assert chunk_b < dense_b * 0.6, (dense_b, chunk_b)
+
+
+@pytest.mark.slow
+def test_bert_chunked_mlm_loss_matches_dense():
+    """BERT MLM: loss_chunk>0 computes the identical loss+grads without the
+    [B, T, 30522] logits (decoder kernel AND bias flow through)."""
+    from deepspeed_tpu.models.bert import (
+        BertConfig, BertForMaskedLM, init_bert_params,
+        make_bert_mlm_loss_fn)
+
+    mk = lambda chunk: BertForMaskedLM(BertConfig(
+        vocab_size=96, hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=32,
+        max_position_embeddings=32, loss_chunk=chunk))
+    model_d, model_c = mk(0), mk(8)
+    params = init_bert_params(model_d, jax.random.PRNGKey(0), seq_len=24)
+    rng = np.random.default_rng(2)
+    labels = np.full((2, 24), -100, np.int64)
+    labels[:, ::5] = rng.integers(0, 96, labels[:, ::5].shape)
+    batch = {"input_ids": rng.integers(0, 96, (2, 24)).astype(np.int32),
+             "labels": labels}
+
+    ld, gd = jax.value_and_grad(
+        lambda p: make_bert_mlm_loss_fn(model_d)(p, batch, None))(params)
+    lc, gc = jax.value_and_grad(
+        lambda p: make_bert_mlm_loss_fn(model_c)(p, batch, None))(params)
+    np.testing.assert_allclose(float(lc), float(ld), rtol=1e-6)
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(gd)[0],
+            jax.tree_util.tree_flatten_with_path(gc)[0]):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-5, atol=1e-7, err_msg=str(pa))
