@@ -1,0 +1,115 @@
+"""One-shot live-TPU measurement capture (round 3).
+
+The axon tunnel wedges for hours at a time; when it comes back it may not
+stay. This script captures EVERY on-chip number the round needs, each in
+its own subprocess (a wedge/OOM in one measurement cannot kill the rest),
+appending JSON rows to BENCH_TPU_RESULTS.jsonl. bench.py invocations also
+refresh BENCH_TPU_CACHE.json per BENCH_MODEL key.
+
+Usage: python benchmarks/run_all_tpu.py [--only gpt2,bert,offload,longctx,sweep]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "BENCH_TPU_RESULTS.jsonl")
+
+
+def log(msg):
+    print(f"[run_all_tpu {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def record(tag, payload):
+    with open(OUT, "a") as f:
+        f.write(json.dumps({"tag": tag, "t": time.strftime("%F %T"),
+                            **payload}) + "\n")
+
+
+def run(tag, cmd, env=None, timeout=1800):
+    log(f"{tag}: {' '.join(cmd)}")
+    e = dict(os.environ)
+    e.pop("JAX_PLATFORMS", None)     # let the TPU backend load
+    if env:
+        e.update(env)
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, env=e, cwd=REPO)
+        lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+        for ln in lines:
+            try:
+                record(tag, json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+        if r.returncode != 0:
+            record(tag, {"error": r.stderr[-800:] or f"rc={r.returncode}"})
+        log(f"{tag}: done rc={r.returncode} ({len(lines)} rows)")
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        record(tag, {"error": f"timeout after {timeout}s"})
+        log(f"{tag}: TIMEOUT")
+        return False
+
+
+def tpu_alive(timeout_s=120):
+    try:
+        e = dict(os.environ)
+        e.pop("JAX_PLATFORMS", None)
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s, text=True, env=e)
+        return r.returncode == 0 and r.stdout.strip().endswith("tpu")
+    except Exception:
+        return False
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--only", default="gpt2,gpt2_chunked,bert,offload,"
+                                          "longctx,sweep")
+    args = parser.parse_args()
+    only = set(args.only.split(","))
+
+    if not tpu_alive():
+        log("TPU not reachable; nothing captured")
+        return 1
+    log("TPU live — capturing")
+    py = sys.executable
+
+    if "gpt2" in only:
+        # flagship 350M + remat-policy variants
+        run("gpt2_350m", [py, "bench.py"])
+        run("gpt2_350m_dots", [py, "bench.py"],
+            env={"BENCH_REMAT": "1"})
+    if "gpt2_chunked" in only:
+        run("gpt2_350m_chunked", [py, "bench.py"],
+            env={"BENCH_LOSS_CHUNK": "512"})
+        run("gpt2_350m_chunked_bs16", [py, "bench.py"],
+            env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "16"})
+    if "bert" in only:
+        run("bert_large", [py, "bench.py"],
+            env={"BENCH_MODEL": "bert_large"})
+    if "offload" in only:
+        run("gpt2_760m_offload", [py, "bench.py"],
+            env={"BENCH_MODEL": "gpt2_760m"}, timeout=2400)
+        run("gpt2_1.5b_offload", [py, "bench.py"],
+            env={"BENCH_MODEL": "gpt2_1.5b"}, timeout=3600)
+    if "longctx" in only:
+        run("longctx_speed", [py, "benchmarks/long_context.py",
+                              "--study", "speed"], timeout=2400)
+        run("longctx_maxseq", [py, "benchmarks/long_context.py",
+                               "--study", "maxseq"], timeout=2400)
+    if "sweep" in only:
+        run("block_sweep", [py, "benchmarks/long_context.py",
+                            "--study", "block"], timeout=2400)
+    log(f"capture complete → {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
