@@ -34,6 +34,10 @@ def run(tag, cmd, env=None, timeout=1800):
     log(f"{tag}: {' '.join(cmd)}")
     e = dict(os.environ)
     e.pop("JAX_PLATFORMS", None)     # let the TPU backend load
+    # Persistent XLA compile cache: the tunnel may not stay up long, and
+    # first compiles run 20-40 s each — cache them across measurements.
+    e.setdefault("JAX_COMPILATION_CACHE_DIR",
+                 os.path.join(REPO, ".jax_cache"))
     if env:
         e.update(env)
     try:
