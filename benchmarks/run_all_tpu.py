@@ -82,10 +82,10 @@ def main():
     args = parser.parse_args()
     only = set(args.only.split(","))
 
-    if not tpu_alive() and not args.force:
+    if not args.force and not tpu_alive():
         log("TPU not reachable; nothing captured")
         return 1
-    log("TPU live — capturing")
+    log("capturing" + ("" if not args.force else " (--force: TPU state unverified)"))
     py = sys.executable
 
     if "gpt2" in only:

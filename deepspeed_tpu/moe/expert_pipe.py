@@ -82,6 +82,12 @@ class ExpertParallelFFNLayer:
                                       specs; this layer sees E_local
     Must run inside the pipeline's ``shard_map`` on a mesh with an
     ``expert`` axis (size may be 1).
+
+    Activations may be a plain [B, S, M] array or a ``(hidden, aux)``
+    tuple: in tuple form the Switch load-balancing loss accumulates into
+    ``aux`` (weighted by ``MoEConfig.aux_loss_weight``) and rides the
+    pipeline to the loss (prologue emits ``(h, 0.0)``; the loss adds the
+    scalar — see ``test_expert_pipe.py`` for the module shape).
     """
 
     def __init__(self, d_model, hidden_dim, moe: MoEConfig = None,
@@ -106,6 +112,14 @@ class ExpertParallelFFNLayer:
         }
 
     def apply(self, params, x, rng=None):
+        # Tuple activations carry the Switch load-balancing aux loss
+        # through the pipeline: layers take/return (hidden, aux_scalar)
+        # and the module's epilogue/loss adds it (the pipeline's
+        # activation pytrees ppermute transparently). Plain-array x skips
+        # the aux entirely.
+        aux_in = None
+        if isinstance(x, tuple):
+            x, aux_in = x
         ax = self.axis_name
         cfg = self.moe
         e_loc = params["expert_w1"].shape[0]     # E / ep after sharding
@@ -158,6 +172,16 @@ class ExpertParallelFFNLayer:
         y = jnp.einsum("bsec,becm->bsm", comb_l, eo)
         if bound:
             y = psum_combine(y, ax)              # combine across experts
-        del aux  # pipeline losses are per-microbatch scalars; the aux
-        #          load-balancing term is a GSPMD-engine feature (layer.py)
-        return x + y.astype(x.dtype)
+        out = x + y.astype(x.dtype)
+        if aux_in is None:
+            return out
+        if bound:
+            # The aux is computed from the FULL (replicated) routing
+            # tensors, so each expert rank's backward already carries the
+            # complete aux gradient — but it flows into the psum_grad'd
+            # h/gate, which sums cotangents across ranks. Pre-scale the
+            # differentiable path by 1/ep (value restored via
+            # stop_gradient) so psum_grad's sum lands at exactly 1x.
+            n = lax.psum(1, ax)
+            aux = aux / n + lax.stop_gradient(aux * (1.0 - 1.0 / n))
+        return out, aux_in + cfg.aux_loss_weight * aux

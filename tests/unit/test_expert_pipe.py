@@ -26,30 +26,49 @@ SEQ, ROWS, MICRO = 8, 16, 4   # 4 rows/microbatch: divisible by data<=4
 
 
 class _Embed:
+    use_aux = False
+
     def init(self, rng, micro):
         return {"emb": jax.random.normal(rng, (32, D_MODEL)) * 0.1}
 
     def apply(self, params, micro, rng=None):
-        return params["emb"][micro["ids"]]
+        h = params["emb"][micro["ids"]]
+        if self.use_aux:
+            return h, jnp.float32(0.0)
+        return h
+
+
+class _AuxEmbed(_Embed):
+    use_aux = True
 
 
 class _Head:
     def init(self, rng, x):
+        if isinstance(x, tuple):
+            x = x[0]
         return {"w": jax.random.normal(rng, (D_MODEL, 32)) * 0.1}
 
     def apply(self, params, x, rng=None):
+        if isinstance(x, tuple):
+            x, aux = x
+            return x @ params["w"], aux
         return x @ params["w"]
 
 
-def _loss(logits, micro):
-    lp = jax.nn.log_softmax(logits.astype(jnp.float32))
-    return -jnp.mean(jnp.take_along_axis(
+def _loss(out, micro):
+    aux = 0.0
+    if isinstance(out, tuple):
+        out, aux = out
+    lp = jax.nn.log_softmax(out.astype(jnp.float32))
+    xent = -jnp.mean(jnp.take_along_axis(
         lp, micro["labels"][..., None], axis=-1))
+    return xent + aux
 
 
-def _module():
+def _module(use_aux=False):
     moe = MoEConfig(num_experts=N_EXPERTS, top_k=2, capacity_factor=2.0)
-    specs = [LayerSpec(_Embed)] + \
+    embed = _AuxEmbed if use_aux else _Embed
+    specs = [LayerSpec(embed)] + \
         [LayerSpec(ExpertParallelFFNLayer, D_MODEL, HIDDEN, moe)
          for _ in range(2)] + [LayerSpec(_Head)]
     example = {"ids": np.zeros((2, SEQ), np.int32),
@@ -58,9 +77,9 @@ def _module():
                           example_input=example)
 
 
-def _run(mesh_shape, n_devices=8):
+def _run(mesh_shape, n_devices=8, use_aux=False):
     mesh = build_mesh(mesh_shape, devices=jax.devices()[:n_devices])
-    module = _module()
+    module = _module(use_aux)
     rng = np.random.default_rng(0)
     micro = {"ids": rng.integers(0, 32, (2, SEQ)).astype(np.int32),
              "labels": rng.integers(0, 32, (2, SEQ)).astype(np.int32)}
@@ -104,3 +123,26 @@ def test_expert_pipeline_trains_through_engine():
     losses = [float(engine.train_batch(batch)) for _ in range(8)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.slow
+def test_expert_pipeline_aux_loss_carried_and_grad_exact():
+    """The Switch aux load-balancing loss rides the pipeline as a tuple
+    activation; its gradient must be identical between expert-sharded and
+    replicated execution (catches the 1/ep cotangent scaling through
+    psum_grad — the aux path is full-per-rank, not partial)."""
+    # Same data sharding on both sides: the aux (load fractions) is
+    # nonlinear in the per-shard batch, so data=4 vs data=2 would differ
+    # by averaging order even with EP exact.
+    loss_rep, grads_rep = _run({"pipe": 2, "expert": 1, "data": 2},
+                               n_devices=4, use_aux=True)
+    loss_ep, grads_ep = _run({"pipe": 2, "expert": 2, "data": 2},
+                             use_aux=True)
+    # aux > 0 ⇒ the carried loss differs from the no-aux run
+    loss_plain, _ = _run({"pipe": 2, "expert": 2, "data": 2})
+    assert loss_ep != loss_plain
+    np.testing.assert_allclose(loss_ep, loss_rep, rtol=1e-5)
+    flat_rep, _ = jax.tree_util.tree_flatten(grads_rep)
+    flat_ep, _ = jax.tree_util.tree_flatten(grads_ep)
+    for a, b in zip(flat_rep, flat_ep):
+        np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-6)
