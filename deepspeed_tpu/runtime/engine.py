@@ -435,8 +435,20 @@ class DeepSpeedEngine:
                     "OneBitAdam is not compatible with ZeRO "
                     "(reference scope: fp16 optimizer path only)")
                 world = self.dp_world_size
-                self.opt_init_fn = lambda p: client_optimizer.init(
-                    p, world=world)
+                if getattr(self.loss_fn, "direct_value_and_grad_local",
+                           None) is not None:
+                    # pipeline composition needs [stages, world, padded]
+                    # error buffers (per-stage collective groups); route
+                    # through the pipeline-aware init, not the wrapper's
+                    # DP-shaped one.
+                    from deepspeed_tpu.runtime.fp16.onebit_adam import (
+                        init_pipeline_onebit_state)
+                    stages = self.mesh.shape["pipe"]
+                    self.opt_init_fn = lambda p: init_pipeline_onebit_state(
+                        p, world, stages)
+                else:
+                    self.opt_init_fn = lambda p: client_optimizer.init(
+                        p, world=world)
             else:
                 self.opt_init_fn = client_optimizer.init
             self._opt_update = lambda p, g, s, lr, beta1: \
@@ -465,10 +477,19 @@ class DeepSpeedEngine:
                 "OneBitAdam is not compatible with ZeRO "
                 "(reference scope: fp16 optimizer path only)")
             from deepspeed_tpu.runtime.fp16.onebit_adam import (
-                init_onebit_state, onebit_adam_update)
+                init_onebit_state, init_pipeline_onebit_state,
+                onebit_adam_update)
             freeze_step = opt_params.pop("freeze_step", 100000)
             world = self.dp_world_size
-            self.opt_init_fn = lambda p: init_onebit_state(p, world)
+            if getattr(self.loss_fn, "direct_value_and_grad_local",
+                       None) is not None:
+                # pipeline x 1-bit composition: error buffers per
+                # (stage, data-rank) over the stage-local flat size
+                stages = self.mesh.shape["pipe"]
+                self.opt_init_fn = lambda p: init_pipeline_onebit_state(
+                    p, world, stages)
+            else:
+                self.opt_init_fn = lambda p: init_onebit_state(p, world)
             self._opt_update = lambda p, g, s, lr_, beta1: onebit_adam_update(
                 p, g, s, lr=lr_, beta1=beta1, beta2=betas[1], eps=eps,
                 weight_decay=weight_decay, freeze_step=freeze_step,
@@ -534,6 +555,12 @@ class DeepSpeedEngine:
         sample = jax.eval_shape(self.opt_init_fn, self.params)
         from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdamState
         if isinstance(sample, OnebitAdamState):
+            if sample.worker_error.ndim == 3:
+                # pipeline x 1-bit: [stages, data_world, padded_local]
+                err = NamedSharding(self.mesh,
+                                    PartitionSpec("pipe", "data", None))
+                return OnebitAdamState(m=opt, v=opt, step=rep,
+                                       worker_error=err, server_error=err)
             return OnebitAdamState(
                 m=opt, v=opt, step=rep,
                 worker_error=NamedSharding(
@@ -626,6 +653,9 @@ class DeepSpeedEngine:
 
     def _make_train_step(self):
         if self.optimizer_name == ONEBIT_ADAM_OPTIMIZER:
+            if getattr(self.loss_fn, "direct_value_and_grad_local",
+                       None) is not None:
+                return self._make_pipeline_onebit_train_step()
             return self._make_onebit_train_step()
         if self.sparse_gradients_enabled():
             return self._make_sparse_grad_train_step()
@@ -1046,6 +1076,116 @@ class DeepSpeedEngine:
             out_specs=(param_specs, opt_specs, dstate_specs, metrics_specs),
             check_vma=False)
         return jax.jit(mapped, donate_argnums=(0, 1, 2))
+
+    def _make_pipeline_onebit_train_step(self):
+        """Compiled step for the pipeline x 1-bit Adam composition
+        (BASELINE config 5; beyond the reference, whose OnebitAdam rides
+        the fp16-optimizer path only): the 1F1B program runs with
+        ``data_local=True`` — its dense psum over ``data`` is skipped and
+        gradients come back with a stacked data axis — then the 1-bit
+        error-feedback collective + update runs in a second ``shard_map``
+        over (pipe, data), each stage group averaging its own shard's
+        momentum over its data replicas."""
+        from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdamState
+
+        for ax, size in self.mesh.shape.items():
+            assert ax in ("data", "pipe") or size == 1, (
+                f"pipeline OneBitAdam supports pipe x data meshes; axis "
+                f"{ax!r} has size {size}")
+        direct_local = self.loss_fn.direct_value_and_grad_local
+        fp16 = self._config.fp16_enabled
+        clip = float(self._config.gradient_clipping or 0.0)
+        lr_fn = self._lr_fn
+        mom_fn = self._mom_fn
+        opt_update = self._opt_update
+        scale_args = self._scale_args()
+        dynamic = self.dynamic_loss_scale
+        static_scale = self.static_loss_scale
+        mesh = self.mesh
+        tree_map = jax.tree_util.tree_map
+
+        P = PartitionSpec
+        param_specs = tree_map(lambda ns: ns.spec, self._shardings["param"])
+        grad_specs = tree_map(lambda sp: P("data", *tuple(sp)), param_specs)
+        err_spec = P("pipe", "data", None)
+
+        def upd(p_l, g_l, m_l, v_l, we_l, se_l, step, lr_, b1, ovf):
+            def strip_body(t):
+                return dict(t, body=tree_map(lambda a: a[0], t["body"]))
+
+            lp, lm, lv = strip_body(p_l), strip_body(m_l), strip_body(v_l)
+            lg = {k: tree_map(lambda a: a[0], g_l[k])
+                  for k in ("prologue", "epilogue", "tied")}
+            lg["body"] = tree_map(lambda a: a[0, 0], g_l["body"])
+            st = OnebitAdamState(m=lm, v=lv, step=step,
+                                 worker_error=we_l[0],      # [1, padded]
+                                 server_error=se_l[0, 0])   # [chunk]
+            new_p, new_st = opt_update(lp, lg, st, lr_, b1)
+
+            def sel(old, new):
+                return tree_map(lambda o, n: jnp.where(ovf, o, n), old, new)
+            new_p = sel(lp, new_p)
+            new_m = sel(lm, new_st.m)
+            new_v = sel(lv, new_st.v)
+            new_we = jnp.where(ovf, we_l[0], new_st.worker_error)
+            new_se = jnp.where(ovf, se_l[0, 0], new_st.server_error)
+            new_step = jnp.where(ovf, step, new_st.step)
+
+            def restore_body(t):
+                return dict(t, body=tree_map(lambda a: a[None], t["body"]))
+            return (restore_body(new_p), restore_body(new_m),
+                    restore_body(new_v), new_we[None], new_se[None, None],
+                    new_step)
+
+        mapped_upd = jax.shard_map(
+            upd, mesh=mesh,
+            in_specs=(param_specs, grad_specs, param_specs, param_specs,
+                      err_spec, err_spec, P(), P(), P(), P()),
+            out_specs=(param_specs, param_specs, param_specs, err_spec,
+                       err_spec, P()),
+            check_vma=False)
+
+        def train_step(params, opt_state, dstate, batch, rng, lr_in):
+            scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
+                else jnp.asarray(static_scale, jnp.float32)
+            micro = tree_map(lambda x: x[0], batch)   # accum dim == 1
+            loss, grads = direct_local(params, micro, rng, scale)
+
+            # Unscale + overflow + clip on the STACKED (data-local) grads
+            # — reductions only, never a dense cross-data averaging.
+            grads = tree_map(lambda g: g.astype(jnp.float32) / scale, grads)
+            overflow = check_overflow(grads) if fp16 else jnp.asarray(False)
+            # Per-data-slice norms: sum of squares over every dim but the
+            # stacked axis; identical on all ranks, so clipping by the max
+            # slice norm is rank-consistent (the DP onebit's pmax analog).
+            sq = sum(jnp.sum(jnp.square(g),
+                             axis=tuple(range(1, g.ndim)))
+                     for g in jax.tree_util.tree_leaves(grads))
+            norms = jnp.sqrt(sq)                        # [data]
+            grad_norm = jnp.mean(norms)
+            applied_norm = grad_norm
+            if clip > 0:
+                factor = jnp.minimum(
+                    1.0, clip / (jnp.max(norms) + 1e-6))
+                grads = tree_map(lambda g: g * factor, grads)
+                applied_norm = grad_norm * factor
+
+            lr = lr_fn(dstate.global_step) if lr_fn is not None else lr_in
+            beta1 = mom_fn(dstate.global_step)
+            new_params, new_m, new_v, new_we, new_se, new_step = mapped_upd(
+                params, grads, opt_state.m, opt_state.v,
+                opt_state.worker_error, opt_state.server_error,
+                opt_state.step, lr, beta1, overflow)
+            opt_out = OnebitAdamState(m=new_m, v=new_v, step=new_step,
+                                      worker_error=new_we,
+                                      server_error=new_se)
+            dstate_out = loss_scale_epilogue(dstate, overflow, fp16,
+                                             dynamic, scale_args)
+            metrics = step_metrics(loss, 1, grad_norm, applied_norm, lr,
+                                   scale, overflow)
+            return new_params, opt_out, dstate_out, metrics
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     def _shard_batch(self, batch):
         """Host-side: this process's batch rows → [accum, per_step_global, ...]

@@ -28,7 +28,8 @@ from jax.flatten_util import ravel_pytree
 from deepspeed_tpu.runtime.comm.compressed import (
     compressed_allreduce, error_feedback_sizes)
 
-__all__ = ["OnebitAdamState", "init_onebit_state", "onebit_adam_update"]
+__all__ = ["OnebitAdamState", "init_onebit_state",
+           "init_pipeline_onebit_state", "onebit_adam_update"]
 
 
 class OnebitAdamState(NamedTuple):
@@ -53,6 +54,35 @@ def init_onebit_state(params, world: int) -> OnebitAdamState:
         step=jnp.asarray(0, jnp.int32),
         worker_error=jnp.zeros((world, padded), jnp.float32),
         server_error=jnp.zeros((padded,), jnp.float32),
+    )
+
+
+def init_pipeline_onebit_state(params, world: int,
+                               num_stages: int) -> OnebitAdamState:
+    """State for the pipeline x 1-bit composition
+    (`engine.py:_make_pipeline_onebit_train_step`): m/v mirror the
+    (stacked, pipe-sharded) params; error-feedback buffers are per
+    (stage, data-rank) over the stage-LOCAL flat parameter count — every
+    (pipe, data) device runs its own compressed collective over ``data``
+    within its stage group, so residuals live where the shards live.
+
+    ``params`` is the pipeline tree {prologue, body, epilogue, tied} with
+    the body stacked [S, L/S, ...]. Homogeneous stages ⇒ one local size.
+    """
+    body_n = sum(int(p.size)
+                 for p in jax.tree_util.tree_leaves(params["body"]))
+    rest_n = sum(int(p.size) for k in ("prologue", "epilogue", "tied")
+                 for p in jax.tree_util.tree_leaves(params[k]))
+    assert body_n % num_stages == 0, (body_n, num_stages)
+    n_local = body_n // num_stages + rest_n
+    padded, chunk = error_feedback_sizes(n_local, world)
+    zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+    return OnebitAdamState(
+        m=jax.tree_util.tree_map(zeros, params),
+        v=jax.tree_util.tree_map(zeros, params),
+        step=jnp.asarray(0, jnp.int32),
+        worker_error=jnp.zeros((num_stages, world, padded), jnp.float32),
+        server_error=jnp.zeros((num_stages, world, chunk), jnp.float32),
     )
 
 

@@ -125,6 +125,12 @@ class PipelineEngine(DeepSpeedEngine):
         loss_fn.direct_value_and_grad = make_pipeline_value_and_grad_fn(
             self.pipeline_parts, mesh, self.micro_batches,
             compute_dtype=compute_dtype)
+        # 1-bit Adam composition: same 1F1B program, but gradients come
+        # back data-LOCAL (stacked data axis) for the compressed
+        # collective to average (engine._make_pipeline_onebit_train_step).
+        loss_fn.direct_value_and_grad_local = make_pipeline_value_and_grad_fn(
+            self.pipeline_parts, mesh, self.micro_batches,
+            compute_dtype=compute_dtype, data_local=True)
 
         super().__init__(args=args,
                          model=model,

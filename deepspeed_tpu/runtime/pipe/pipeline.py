@@ -475,7 +475,8 @@ def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
 # executed 1F1B: interleaved forward/backward in ONE compiled scan
 # ---------------------------------------------------------------------------
 def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
-                                    num_micro: int, compute_dtype=None):
+                                    num_micro: int, compute_dtype=None,
+                                    data_local=False):
     """Build ``vag(params, batch, rng, scale) -> (loss, grads)`` running a
     hand-scheduled 1F1B pipeline (the reference's ``TrainSchedule``
     interleave, `runtime/pipe/schedule.py:189-241`, executed rather than
@@ -497,6 +498,13 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
     final grads are scaled by ``scale / total_weight`` (weighted losses) or
     ``scale / (M * |data|)`` — weights (token counts) don't depend on
     params, so this equals grad of ``scale * mean_loss``.
+
+    ``data_local=True`` (the 1-bit Adam composition): the dense psum over
+    ``data`` is SKIPPED — grads come back with a stacked leading ``data``
+    axis, scaled so their *mean* over that axis is the true gradient, for
+    a compressed collective to average instead (the analog of the
+    reference disabling engine allreduce for OnebitAdam,
+    onebit_adam.py:372).
     """
     S = parts.num_stages
     M = num_micro
@@ -681,11 +689,20 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
             gscale = 1.0 / (M * n_data)
         # body grads stay pipe-sharded; rest grads sum across the stages
         # that touched them (the tied-weight allreduce, module.py:405-474)
-        gb_acc = jax.tree_util.tree_map(
-            lambda a: lax.psum(a, "data") * gscale, gb_acc)
-        gr_acc = jax.tree_util.tree_map(
-            lambda a: lax.psum(lax.psum(a, "pipe"), "data") * gscale,
-            gr_acc)
+        if data_local:
+            # Scale so the MEAN over data ranks equals the true gradient:
+            # mean_r(n_data * g_r * gscale) = sum_r g_r * gscale.
+            n_data = lax.axis_size("data")
+            gb_acc = jax.tree_util.tree_map(
+                lambda a: a * (gscale * n_data), gb_acc)
+            gr_acc = jax.tree_util.tree_map(
+                lambda a: lax.psum(a, "pipe") * (gscale * n_data), gr_acc)
+        else:
+            gb_acc = jax.tree_util.tree_map(
+                lambda a: lax.psum(a, "data") * gscale, gb_acc)
+            gr_acc = jax.tree_util.tree_map(
+                lambda a: lax.psum(lax.psum(a, "pipe"), "data") * gscale,
+                gr_acc)
         if axis_tail:
             loss = lax.pmean(loss, axis_tail)
             # Replicated leaves: identical per-rank grads (expert-partial
@@ -701,15 +718,26 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
             gr_acc = jax.tree_util.tree_map(
                 lambda a: lax.pmean(a, axis_tail), gr_acc)
         # restore the leading stage dim the shard_map out_spec strips
+        # (+ a stacked data dim in data_local mode)
         gb_acc = jax.tree_util.tree_map(lambda a: a[None], gb_acc)
+        if data_local:
+            gb_acc = jax.tree_util.tree_map(lambda a: a[None], gb_acc)
+            gr_acc = jax.tree_util.tree_map(lambda a: a[None], gr_acc)
         return loss, gb_acc, gr_acc
+
+    def _out_specs(body_specs, rest_specs):
+        if not data_local:
+            return (P(), body_specs, rest_specs)
+        stack = lambda spec: P("data", *tuple(spec))
+        return (P(),
+                jax.tree_util.tree_map(stack, body_specs),
+                jax.tree_util.tree_map(stack, rest_specs))
 
     def pipeline_value_and_grad(params, batch, rng, scale):
         loss, gb, gr = _call_pipeline(
             mesh, M, device_fn, params, batch, rng,
             extra=(jnp.asarray(scale, jnp.float32),),
-            out_specs=lambda body_specs, rest_specs: (P(), body_specs,
-                                                      rest_specs))
+            out_specs=_out_specs)
         grads = {"prologue": gr["prologue"], "body": gb,
                  "epilogue": gr["epilogue"], "tied": gr["tied"]}
         return loss, grads

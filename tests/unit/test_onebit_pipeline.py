@@ -1,0 +1,92 @@
+"""pipeline x 1-bit Adam composition (BASELINE config 5): the executed
+1F1B emits data-LOCAL gradients; the error-feedback collective averages
+momentum per stage group over the data axis."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import gpt2_tiny
+from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+from deepspeed_tpu.parallel.mesh import build_mesh
+
+ROWS, SEQ, MICRO = 16, 16, 4
+
+
+def _train(opt_cfg, steps=6, mesh_shape=None):
+    import deepspeed_tpu
+
+    mesh = build_mesh(mesh_shape or {"pipe": 2, "data": 4},
+                      devices=jax.devices()[:8])
+    module = gpt2_pipeline_module(gpt2_tiny(), seq_len=SEQ)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": ROWS,
+                "gradient_accumulation_steps": MICRO,
+                "optimizer": opt_cfg,
+                "steps_per_print": 1000},
+        model=module, mesh=mesh)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, 255, (ROWS, SEQ)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(steps)]
+    return losses, engine
+
+
+@pytest.mark.slow
+def test_pipeline_onebit_warmup_matches_plain_adam():
+    """During warmup (step <= freeze_step) 1-bit Adam IS Adam without
+    bias correction — through the pipeline the curves must be identical
+    (pins the data-local grad scaling: mean over the stacked axis must
+    equal the dense pmean the plain path computes)."""
+    onebit, e1 = _train({"type": "OneBitAdam",
+                         "params": {"lr": 1e-3, "freeze_step": 1000}})
+    adam, _ = _train({"type": "Adam",
+                      "params": {"lr": 1e-3, "bias_correction": False}})
+    # identical math, different fp32 reduction order (stacked-mean vs
+    # in-pipeline psum): tiny drift accumulates over steps
+    np.testing.assert_allclose(onebit, adam, rtol=2e-4)
+    from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdamState
+    assert isinstance(e1.opt_state, OnebitAdamState)
+    # pipeline-shaped error buffers: [stages, data_world, padded_local]
+    assert e1.opt_state.worker_error.ndim == 3
+    assert e1.opt_state.worker_error.shape[0] == 2    # stages
+    assert e1.opt_state.worker_error.shape[1] == 4    # data world
+
+
+@pytest.mark.slow
+def test_pipeline_onebit_compression_stage_trains():
+    """Past freeze_step the compressed collective carries the momentum;
+    training must keep converging (error feedback absorbs the 1-bit
+    quantization)."""
+    losses, engine = _train({"type": "OneBitAdam",
+                             "params": {"lr": 1e-3, "freeze_step": 2}},
+                            steps=10)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    # the compression stage actually ran
+    assert int(engine.opt_state.step) == 10
+    assert float(jnp.abs(engine.opt_state.worker_error).sum()) > 0
+
+
+@pytest.mark.slow
+def test_pipeline_onebit_client_optimizer_instance():
+    """A client OnebitAdam wrapper instance passed to a PipelineEngine must
+    also get the pipeline-shaped [stages, world, padded] error buffers."""
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdam
+
+    mesh = build_mesh({"pipe": 2, "data": 4}, devices=jax.devices()[:8])
+    module = gpt2_pipeline_module(gpt2_tiny(), seq_len=SEQ)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": ROWS,
+                "gradient_accumulation_steps": MICRO,
+                "steps_per_print": 1000},
+        model=module, mesh=mesh,
+        optimizer=OnebitAdam(lr=1e-3, freeze_step=0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 255, (ROWS, SEQ)).astype(np.int32)}
+    loss = float(engine.train_batch(batch))
+    assert np.isfinite(loss)
+    assert engine.opt_state.worker_error.shape[:2] == (2, 4)
