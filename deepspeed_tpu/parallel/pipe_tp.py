@@ -1,0 +1,138 @@
+"""Tensor parallelism inside the compiled pipeline (dp x pp x tp — 3D).
+
+The reference's 3D story is Megatron TP layers wrapped in DeepSpeed
+pipeline stages (`docs/_tutorials/megatron.md`; PipelineModule over
+Megatron's ColumnParallel/RowParallel). Our GSPMD TP layer library
+(`parallel/tensor_parallel.py`) relies on sharding constraints, which are
+inert inside the pipeline's manual ``shard_map`` — so the pipeline body
+needs TP written with explicit collectives, like the expert-parallel FFN
+(`moe/expert_pipe.py`):
+
+- ``mp_*``-named param leaves carry their shard dim FIRST and are split
+  over the ``model`` mesh axis by the pipeline's body specs
+  (`runtime/pipe/pipeline.py:body_param_specs`);
+- column-parallel matmuls produce head/hidden shards with no comm;
+  row-parallel matmuls produce partial sums combined by one
+  ``psum_combine`` (psum forward, identity backward — the Megatron
+  ``g`` function);
+- ``psum_grad`` on the replicated input repairs the partial cotangents
+  from the column-parallel consumers (Megatron's ``f`` function).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import flax.linen as nn
+
+from deepspeed_tpu.moe.expert_pipe import psum_combine, psum_grad
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _axis_bound(ax):
+    """Manual-mode probe — outside shard_map (build-time shape inference,
+    sequential oracles) the layer runs replicated with no collectives."""
+    try:
+        lax.axis_index(ax)
+        return True
+    except NameError:
+        return False
+
+
+class TPBlockLayer:
+    """GPT-2-style transformer block, tensor-parallel over ``model``.
+
+    Param leaves (shard dim first, split over ``model`` by body specs):
+      ``mp_qkv``   [n_head_local * 3 * D, M]   column-parallel QKV,
+                                               packed HEAD-major (H, 3, D)
+                                               so the model-axis split
+                                               keeps whole heads (q,k,v
+                                               together per head)
+      ``mp_qkv_b`` [n_head_local * 3 * D]
+      ``mp_proj``  [n_head_local * D, M]       row-parallel attn out
+      ``mp_fc``    [ffn_local, M]              column-parallel MLP in
+      ``mp_fc_b``  [ffn_local]
+      ``mp_fc_out`` [ffn_local, M]             row-parallel MLP out
+    Replicated: ``ln1/ln2`` scale+bias, ``proj_b``, ``fc_out_b`` [M]
+    (row-parallel biases add once, after the psum).
+
+    ``n_head`` must divide by the model-axis size. Attention runs on the
+    LOCAL heads (flash on TPU) — the Megatron head-partition.
+    """
+
+    def __init__(self, d_model, n_head, ffn_mult=4, axis_name="model",
+                 use_flash=False):
+        assert d_model % n_head == 0
+        self.d_model = d_model
+        self.n_head = n_head
+        self.ffn = ffn_mult * d_model
+        self.axis_name = axis_name
+        self.use_flash = use_flash
+
+    def init(self, rng, x):
+        M, H = self.d_model, self.n_head
+        D = M // H
+        ks = jax.random.split(rng, 4)
+        init = nn.initializers.normal(0.02)
+        return {
+            "ln1_scale": jnp.ones((M,), jnp.float32),
+            "ln1_bias": jnp.zeros((M,), jnp.float32),
+            "ln2_scale": jnp.ones((M,), jnp.float32),
+            "ln2_bias": jnp.zeros((M,), jnp.float32),
+            "mp_qkv": init(ks[0], (3 * H * D, M), jnp.float32),
+            "mp_qkv_b": jnp.zeros((3 * H * D,), jnp.float32),
+            "mp_proj": init(ks[1], (H * D, M), jnp.float32),
+            "proj_b": jnp.zeros((M,), jnp.float32),
+            "mp_fc": init(ks[2], (self.ffn, M), jnp.float32),
+            "mp_fc_b": jnp.zeros((self.ffn,), jnp.float32),
+            "mp_fc_out": init(ks[3], (self.ffn, M), jnp.float32),
+            "fc_out_b": jnp.zeros((M,), jnp.float32),
+        }
+
+    @staticmethod
+    def _ln(x, scale, bias):
+        mean = x.mean(-1, keepdims=True)
+        var = ((x - mean) ** 2).mean(-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+
+    def apply(self, params, x, rng=None):
+        ax = self.axis_name
+        bound = _axis_bound(ax)
+        B, T, M = x.shape
+        dtype = x.dtype
+        three_hd = params["mp_qkv"].shape[0]        # H_local * 3 * D
+        D = M // self.n_head
+        h_local = three_hd // (3 * D)
+
+        # ---- attention (column-parallel QKV, local heads, row proj) ----
+        h = self._ln(x, params["ln1_scale"], params["ln1_bias"]).astype(dtype)
+        if bound:
+            h = psum_grad(h, ax)                    # Megatron "f"
+        qkv = h @ params["mp_qkv"].T.astype(dtype) + \
+            params["mp_qkv_b"].astype(dtype)        # [B,T,hl*3*D]
+        qkv = qkv.reshape(B, T, h_local, 3, D)      # head-major packing
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        if self.use_flash:
+            y = flash_attention(q, k, v, causal=True)
+        else:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+            s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s * scale, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(dtype)
+            y = jnp.einsum("bhts,bshd->bthd", p, v)
+        y = y.reshape(B, T, h_local * D)
+        part = y @ params["mp_proj"].astype(dtype)  # [B,T,M] partial
+        if bound:
+            part = psum_combine(part, ax)           # Megatron "g"
+        x = x + part + params["proj_b"].astype(dtype)
+
+        # ---- MLP (column fc, row fc_out) -------------------------------
+        h2 = self._ln(x, params["ln2_scale"], params["ln2_bias"]).astype(dtype)
+        if bound:
+            h2 = psum_grad(h2, ax)
+        ff = jax.nn.gelu(h2 @ params["mp_fc"].T.astype(dtype) +
+                         params["mp_fc_b"].astype(dtype))
+        part2 = ff @ params["mp_fc_out"].astype(dtype)
+        if bound:
+            part2 = psum_combine(part2, ax)
+        return x + part2 + params["fc_out_b"].astype(dtype)

@@ -1109,27 +1109,54 @@ class DeepSpeedEngine:
         grad_specs = tree_map(lambda sp: P("data", *tuple(sp)), param_specs)
         err_spec = P("pipe", "data", None)
 
-        def upd(p_l, g_l, m_l, v_l, we_l, se_l, step, lr_, b1, ovf):
-            def strip_body(t):
-                return dict(t, body=tree_map(lambda a: a[0], t["body"]))
+        from deepspeed_tpu.runtime.fp16.onebit_adam import (
+            pipeline_onebit_splits)
+        (pb, cb), (pr, cr) = pipeline_onebit_splits(
+            self.params, self.dp_world_size, mesh.shape["pipe"])
 
-            lp, lm, lv = strip_body(p_l), strip_body(m_l), strip_body(v_l)
-            lg = {k: tree_map(lambda a: a[0], g_l[k])
-                  for k in ("prologue", "epilogue", "tied")}
-            lg["body"] = tree_map(lambda a: a[0, 0], g_l["body"])
-            st = OnebitAdamState(m=lm, v=lv, step=step,
-                                 worker_error=we_l[0],      # [1, padded]
-                                 server_error=se_l[0, 0])   # [chunk]
-            new_p, new_st = opt_update(lp, lg, st, lr_, b1)
+        def upd(p_l, g_l, m_l, v_l, we_l, se_l, step, lr_, b1, ovf):
+            # Body (this stage's shard) and rest (pipe-replicated
+            # prologue/epilogue/tied) run SEPARATE compressed collectives:
+            # a joint flat buffer would give each stage group a different
+            # quantization scale for the shared rest entries and silently
+            # diverge the tied embeddings across stages.
+            body_p = {"body": tree_map(lambda a: a[0], p_l["body"])}
+            body_g = {"body": tree_map(lambda a: a[0, 0], g_l["body"])}
+            body_m = {"body": tree_map(lambda a: a[0], m_l["body"])}
+            body_v = {"body": tree_map(lambda a: a[0], v_l["body"])}
+            rest_keys = ("prologue", "epilogue", "tied")
+            rest_p = {k: p_l[k] for k in rest_keys}
+            rest_g = {k: tree_map(lambda a: a[0], g_l[k])
+                      for k in rest_keys}
+            rest_m = {k: m_l[k] for k in rest_keys}
+            rest_v = {k: v_l[k] for k in rest_keys}
+
+            we = we_l[0]                       # [1, pb + pr]
+            se = se_l[0, 0]                    # [cb + cr]
+            st_body = OnebitAdamState(m=body_m, v=body_v, step=step,
+                                      worker_error=we[:, :pb],
+                                      server_error=se[:cb])
+            st_rest = OnebitAdamState(m=rest_m, v=rest_v, step=step,
+                                      worker_error=we[:, pb:],
+                                      server_error=se[cb:])
+            new_bp, new_bst = opt_update(body_p, body_g, st_body, lr_, b1)
+            new_rp, new_rst = opt_update(rest_p, rest_g, st_rest, lr_, b1)
 
             def sel(old, new):
                 return tree_map(lambda o, n: jnp.where(ovf, o, n), old, new)
-            new_p = sel(lp, new_p)
-            new_m = sel(lm, new_st.m)
-            new_v = sel(lv, new_st.v)
-            new_we = jnp.where(ovf, we_l[0], new_st.worker_error)
-            new_se = jnp.where(ovf, se_l[0, 0], new_st.server_error)
-            new_step = jnp.where(ovf, step, new_st.step)
+            new_p = dict(sel(rest_p, new_rp),
+                         body=sel(body_p, new_bp)["body"])
+            new_m = dict(sel(rest_m, new_rst.m),
+                         body=sel(body_m, new_bst.m)["body"])
+            new_v = dict(sel(rest_v, new_rst.v),
+                         body=sel(body_v, new_bst.v)["body"])
+            new_we = jnp.where(
+                ovf, we, jnp.concatenate(
+                    [new_bst.worker_error, new_rst.worker_error], axis=-1))
+            new_se = jnp.where(
+                ovf, se, jnp.concatenate(
+                    [new_bst.server_error, new_rst.server_error], axis=-1))
+            new_step = jnp.where(ovf, step, new_bst.step)
 
             def restore_body(t):
                 return dict(t, body=tree_map(lambda a: a[None], t["body"]))

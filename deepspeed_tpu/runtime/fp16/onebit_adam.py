@@ -74,16 +74,33 @@ def init_pipeline_onebit_state(params, world: int,
     rest_n = sum(int(p.size) for k in ("prologue", "epilogue", "tied")
                  for p in jax.tree_util.tree_leaves(params[k]))
     assert body_n % num_stages == 0, (body_n, num_stages)
-    n_local = body_n // num_stages + rest_n
-    padded, chunk = error_feedback_sizes(n_local, world)
+    # Body (stage-local) and rest (pipe-replicated) compress as SEPARATE
+    # buffers: one joint buffer would give every stage group a different
+    # quantization scale for the shared rest entries (the scale is the
+    # whole-buffer L2, compressed.py:_compress) and silently diverge the
+    # tied embeddings across stages. The error buffers concatenate
+    # [body | rest] along the last dim.
+    pb, cb = error_feedback_sizes(body_n // num_stages, world)
+    pr, cr = error_feedback_sizes(max(rest_n, 8), world)
     zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
     return OnebitAdamState(
         m=jax.tree_util.tree_map(zeros, params),
         v=jax.tree_util.tree_map(zeros, params),
         step=jnp.asarray(0, jnp.int32),
-        worker_error=jnp.zeros((num_stages, world, padded), jnp.float32),
-        server_error=jnp.zeros((num_stages, world, chunk), jnp.float32),
+        worker_error=jnp.zeros((num_stages, world, pb + pr), jnp.float32),
+        server_error=jnp.zeros((num_stages, world, cb + cr), jnp.float32),
     )
+
+
+def pipeline_onebit_splits(params, world, num_stages):
+    """((padded_body, chunk_body), (padded_rest, chunk_rest)) — the
+    concatenation layout of the pipeline state's error buffers."""
+    body_n = sum(int(p.size)
+                 for p in jax.tree_util.tree_leaves(params["body"]))
+    rest_n = sum(int(p.size) for k in ("prologue", "epilogue", "tied")
+                 for p in jax.tree_util.tree_leaves(params[k]))
+    return (error_feedback_sizes(body_n // num_stages, world),
+            error_feedback_sizes(max(rest_n, 8), world))
 
 
 def onebit_adam_update(params,

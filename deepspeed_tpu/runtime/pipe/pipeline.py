@@ -160,15 +160,32 @@ def _leaf_names(path):
     return [str(getattr(q, "key", getattr(q, "idx", q))) for q in path]
 
 
-def _is_expert_leaf(path, a):
+def _is_expert_leaf(path, a, local=False):
     """Expert-banked body leaves (named ``expert_*`` with a bank dim, e.g.
     `moe/expert_pipe.py:ExpertParallelFFNLayer`) shard their bank dim over
     the ``expert`` mesh axis instead of replicating. The same predicate
     gates the spec AND the gradient tail reduction — they must agree, or a
     replicated leaf would skip its expert pmean (rank-divergent grads
-    under a replicated out-spec)."""
+    under a replicated out-spec).
+
+    ``local=True`` when ``a`` is a device-local stage tree (the stacked
+    ``[S]`` stage dim stripped, so the bank dim sits one axis lower) —
+    getting this wrong silently cross-mixes shard gradients for low-rank
+    leaves like biases."""
+    min_ndim = 2 if local else 3
     return (any(n.startswith("expert_") for n in _leaf_names(path))
-            and a.ndim >= 3)
+            and a.ndim >= min_ndim)
+
+
+def _is_mp_leaf(path, a, local=False):
+    """Tensor-parallel body leaves (named ``mp_*``, shard dim first, e.g.
+    `parallel/pipe_tp.py:TPBlockLayer`) split that dim over the ``model``
+    mesh axis — the Megatron column/row partition inside the pipeline.
+    Same spec/tail-reduction coupling (and the same ``local`` caveat) as
+    :func:`_is_expert_leaf`."""
+    min_ndim = 2 if local else 3
+    return (any(n.startswith("mp_") for n in _leaf_names(path))
+            and a.ndim >= min_ndim)
 
 
 def body_param_specs(body_params):
@@ -179,6 +196,8 @@ def body_param_specs(body_params):
     def spec(path, a):
         if _is_expert_leaf(path, a):
             return P("pipe", None, "expert", *([None] * (a.ndim - 3)))
+        if _is_mp_leaf(path, a):
+            return P("pipe", None, "model", *([None] * (a.ndim - 3)))
         return P("pipe", *([None] * (a.ndim - 1)))
 
     return jax.tree_util.tree_map_with_path(spec, body_params)
@@ -710,9 +729,12 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
             # pmean is exact. Expert-SHARDED leaves hold genuinely
             # different shards — never mix them across ``expert``.
             def tail_mean(path, a):
+                # NB: gb_acc leaves here are stage-LOCAL (no [S] dim).
                 axes = tuple(ax for ax in axis_tail
-                             if not (ax == "expert" and
-                                     _is_expert_leaf(path, a)))
+                             if not ((ax == "expert" and
+                                      _is_expert_leaf(path, a, local=True))
+                                     or (ax == "model" and
+                                         _is_mp_leaf(path, a, local=True))))
                 return lax.pmean(a, axes) if axes else a
             gb_acc = jax.tree_util.tree_map_with_path(tail_mean, gb_acc)
             gr_acc = jax.tree_util.tree_map(

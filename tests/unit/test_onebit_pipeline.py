@@ -90,3 +90,25 @@ def test_pipeline_onebit_client_optimizer_instance():
     loss = float(engine.train_batch(batch))
     assert np.isfinite(loss)
     assert engine.opt_state.worker_error.shape[:2] == (2, 4)
+
+
+@pytest.mark.slow
+def test_pipeline_onebit_rest_params_stay_pipe_consistent():
+    """The compressed collective runs per stage group; the quantization
+    scale must NOT couple the stage-local body shard into the shared
+    prologue/epilogue/tied updates (body and rest compress as separate
+    buffers — a joint buffer diverges the tied embedding across stages).
+    Checked on the raw per-device buffers: a replicated out-spec with
+    check_vma=False would silently mask divergence at the logical level."""
+    _, engine = _train({"type": "OneBitAdam",
+                        "params": {"lr": 1e-3, "freeze_step": 0}},
+                       steps=6)
+    import jax.tree_util as jtu
+    for path, leaf in jtu.tree_flatten_with_path(
+            {k: engine.params[k] for k in
+             ("prologue", "epilogue", "tied")})[0]:
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for sh in shards[1:]:
+            np.testing.assert_array_equal(
+                sh, shards[0],
+                err_msg=f"pipe-divergent replicated leaf {path}")
