@@ -1,0 +1,52 @@
+"""Shared toy pipeline-module fixtures (used by tests/unit/test_pipe_tp.py
+and tests/model/test_gpt2_func.py — one definition so layer-contract
+changes to TPBlockLayer can't silently drift between the two copies)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel.pipe_tp import TPBlockLayer
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+
+def tiny_tp_pipeline_module(vocab, d_model, n_head, seq, ids_key,
+                            n_blocks=2, num_stages=2, labels_key=None):
+    """embed(table) -> n_blocks x TPBlockLayer -> head, softmax-xent loss.
+
+    ``labels_key=None``: next-token objective (labels = ids rolled by -1);
+    otherwise explicit labels from ``micro[labels_key]``.
+    """
+
+    class Embed:
+        def init(self, rng, micro):
+            return {"emb": jax.random.normal(rng, (vocab, d_model)) * 0.1}
+
+        def apply(self, p, micro, rng=None):
+            return p["emb"][micro[ids_key]]
+
+    class Head:
+        def init(self, rng, x):
+            return {"w": jax.random.normal(rng, (d_model, vocab)) * 0.1}
+
+        def apply(self, p, x, rng=None):
+            return x @ p["w"]
+
+    def loss(logits, micro):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        if labels_key is None:
+            tgt = jnp.roll(micro[ids_key], -1, axis=1)
+        else:
+            tgt = micro[labels_key]
+        return -jnp.mean(jnp.take_along_axis(lp, tgt[..., None], -1))
+
+    example = {ids_key: np.zeros((2, seq), np.int32)}
+    if labels_key is not None:
+        example[labels_key] = np.zeros((2, seq), np.int32)
+    return PipelineModule(
+        layers=[LayerSpec(Embed)] +
+               [LayerSpec(TPBlockLayer, d_model, n_head)
+                for _ in range(n_blocks)] +
+               [LayerSpec(Head)],
+        num_stages=num_stages, loss_fn=loss, example_input=example)

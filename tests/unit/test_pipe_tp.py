@@ -22,36 +22,11 @@ D_MODEL, N_HEAD = 8, 4
 SEQ, ROWS, MICRO = 8, 16, 4
 
 
-class _Embed:
-    def init(self, rng, micro):
-        return {"emb": jax.random.normal(rng, (32, D_MODEL)) * 0.1}
-
-    def apply(self, params, micro, rng=None):
-        return params["emb"][micro["ids"]]
-
-
-class _Head:
-    def init(self, rng, x):
-        return {"w": jax.random.normal(rng, (D_MODEL, 32)) * 0.1}
-
-    def apply(self, params, x, rng=None):
-        return x @ params["w"]
-
-
-def _loss(logits, micro):
-    lp = jax.nn.log_softmax(logits.astype(jnp.float32))
-    return -jnp.mean(jnp.take_along_axis(
-        lp, micro["labels"][..., None], axis=-1))
-
-
 def _module():
-    specs = [LayerSpec(_Embed)] + \
-        [LayerSpec(TPBlockLayer, D_MODEL, N_HEAD) for _ in range(2)] + \
-        [LayerSpec(_Head)]
-    example = {"ids": np.zeros((2, SEQ), np.int32),
-               "labels": np.zeros((2, SEQ), np.int32)}
-    return PipelineModule(layers=specs, num_stages=2, loss_fn=_loss,
-                          example_input=example)
+    from tests.pipeline_fixtures import tiny_tp_pipeline_module
+    return tiny_tp_pipeline_module(vocab=32, d_model=D_MODEL,
+                                   n_head=N_HEAD, seq=SEQ, ids_key="ids",
+                                   labels_key="labels")
 
 
 def _run(mesh_shape, n_devices=8):
