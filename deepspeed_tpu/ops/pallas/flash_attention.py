@@ -27,21 +27,44 @@ import jax
 import jax.numpy as jnp
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+# Additive form of a hard key mask (added to scores, so it must stay well
+# inside fp32 range): exp(s - 1e9) == 0.0 exactly in fp32.
+MASK_BIAS = -1e9
 
 
 # ---------------------------------------------------------------------------
 # dense reference
 # ---------------------------------------------------------------------------
 
-def dense_attention(q, k, v, causal=True, sm_scale=None):
-    """Plain attention; q,k,v: [B, T, H, D] → [B, T, H, D]."""
+def _to_key_bias(key_padding_mask, key_bias):
+    """Resolve the public mask args to one additive [B, S] fp32 bias (or
+    None): a bool ``key_padding_mask`` becomes 0 / MASK_BIAS; an explicit
+    ``key_bias`` (soft additive penalties included) passes through."""
+    assert key_padding_mask is None or key_bias is None, (
+        "pass key_padding_mask OR key_bias, not both")
+    if key_padding_mask is not None:
+        return jnp.where(jnp.asarray(key_padding_mask, bool),
+                         0.0, MASK_BIAS).astype(jnp.float32)
+    if key_bias is not None:
+        return key_bias.astype(jnp.float32)
+    return None
+
+
+def dense_attention(q, k, v, causal=True, sm_scale=None,
+                    key_padding_mask=None, key_bias=None):
+    """Plain attention; q,k,v: [B, T, H, D] → [B, T, H, D].
+    ``key_padding_mask`` [B, S] bool (True = attend) or ``key_bias``
+    [B, S] additive fp32."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    bias = _to_key_bias(key_padding_mask, key_bias)
     scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * sm_scale
     if causal:
         T, S = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((T, S), bool))
         scores = jnp.where(mask[None, None], scores, DEFAULT_MASK_VALUE)
+    if bias is not None:
+        scores = scores + bias[:, None, None, :]
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
@@ -50,28 +73,35 @@ def dense_attention(q, k, v, causal=True, sm_scale=None):
 # blockwise XLA (online softmax over KV blocks via lax.scan)
 # ---------------------------------------------------------------------------
 
-def _blockwise_attention(q, k, v, causal, sm_scale, block_k=256):
-    """Online-softmax attention; memory O(T * block_k) per head."""
+def _blockwise_attention(q, k, v, causal, sm_scale, block_k=256,
+                         key_bias=None):
+    """Online-softmax attention; memory O(T * block_k) per head.
+    ``key_bias`` [B, S] additive fp32 (resolved by the caller)."""
     B, T, H, D = q.shape
     S = k.shape[1]
+    if key_bias is None:
+        key_bias = jnp.zeros((B, S), jnp.float32)
+    kpm = key_bias
     block_k = min(block_k, S)
     n_blocks = (S + block_k - 1) // block_k
     pad = n_blocks * block_k - S
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        kpm = jnp.pad(kpm, ((0, 0), (0, pad)))
 
     qf = q.astype(jnp.float32) * sm_scale
     kb = k.reshape(B, n_blocks, block_k, H, D).astype(jnp.float32)
     vb = v.reshape(B, n_blocks, block_k, H, D).astype(jnp.float32)
     kb = jnp.moveaxis(kb, 1, 0)  # [n_blocks, B, block_k, H, D]
     vb = jnp.moveaxis(vb, 1, 0)
+    mb = jnp.moveaxis(kpm.reshape(B, n_blocks, block_k), 1, 0)
 
     q_pos = jnp.arange(T)
 
     def body(carry, inputs):
         acc, m, l = carry
-        k_blk, v_blk, blk_idx = inputs
+        k_blk, v_blk, m_blk, blk_idx = inputs
         s = jnp.einsum("bthd,bshd->bhts", qf, k_blk)  # [B,H,T,block_k]
         kv_pos = blk_idx * block_k + jnp.arange(block_k)
         valid = kv_pos < S
@@ -80,6 +110,8 @@ def _blockwise_attention(q, k, v, causal, sm_scale, block_k=256):
             s = jnp.where(valid[None, None], s, DEFAULT_MASK_VALUE)
         else:
             s = jnp.where(valid[None, None, None], s, DEFAULT_MASK_VALUE)
+        # additive key bias: [B, block_k] → [B, 1, 1, block_k]
+        s = s + m_blk[:, None, None, :]
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[..., None])
         correction = jnp.exp(m - m_new)
@@ -92,8 +124,8 @@ def _blockwise_attention(q, k, v, causal, sm_scale, block_k=256):
     m0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, T), jnp.float32)
     (acc, m, l), _ = jax.lax.scan(
-        body, (acc0, m0, l0), (kb, vb, jnp.arange(n_blocks)))
-    out = acc / l[..., None]
+        body, (acc0, m0, l0), (kb, vb, mb, jnp.arange(n_blocks)))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
     return jnp.moveaxis(out, 1, 2).astype(q.dtype)  # [B,T,H,D]
 
 
@@ -122,9 +154,11 @@ def _from_bh(x, B, H):
 # re-streamed on every q-step of the dK/dV grid; at long sequence lengths
 # that stream dwarfs the q/k/v traffic itself.
 def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
-                interpret=False):
+                interpret=False, key_bias=None):
     """Returns (out [B,T,H,D], lse [B*H,T,1]) — lse is the softmax row
-    logsumexp residual consumed by the backward kernels."""
+    logsumexp residual consumed by the backward kernels.
+    ``key_bias`` [B, S] additive fp32 rides as a [B, S, 1] array indexed
+    per batch (bh // H)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -136,10 +170,19 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
         f"seq lens ({T},{S}) must divide blocks ({block_q},{block_k})")
     n_q = T // block_q
     n_k = S // block_k
+    masked = key_bias is not None
 
     q, k, v = _to_bh(q), _to_bh(k), _to_bh(v)
+    kpm = None
+    if masked:
+        kpm = key_bias.astype(jnp.float32)[..., None]        # [B, S, 1]
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
+    def kernel(q_ref, k_ref, v_ref, *refs):
+        if masked:
+            kpm_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        else:
+            o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+            kpm_ref = None
         qi = pl.program_id(1)
         ki = pl.program_id(2)
 
@@ -167,6 +210,9 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
                 k_pos = ki * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
                 s = jnp.where(k_pos <= q_pos, s, DEFAULT_MASK_VALUE)
+            if masked:
+                # [bk, 1] sublane vector → additive row bias over lanes
+                s = s + kpm_ref[0][:, 0][None, :]
             m_prev = m_ref[:, 0]
             m_new = jnp.maximum(m_prev, s.max(axis=-1))
             p = jnp.exp(s - m_new[:, None])
@@ -180,19 +226,27 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
 
         @pl.when(ki == n_k - 1)
         def _finish():
-            o_ref[0] = (acc_ref[:] /
-                        l_ref[:, 0][:, None]).astype(o_ref.dtype)
-            lse_ref[0] = (m_ref[:, 0] + jnp.log(l_ref[:, 0]))[:, None]
+            # fully-masked rows: l == 0 → guard the divide (outputs for
+            # padded q positions are meaningless and masked downstream)
+            l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
+            o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+            lse_ref[0] = (m_ref[:, 0] + jnp.log(l_safe))[:, None]
 
     grid = (B * H, n_q, n_k)
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+    ]
+    args = [q, k, v]
+    if masked:
+        in_specs.append(pl.BlockSpec(
+            (1, block_k, 1), lambda bh, qi, ki: (bh // H, ki, 0)))
+        args.append(kpm)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
@@ -207,12 +261,12 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return _from_bh(out, B, H), lse
 
 
 def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
-                interpret=False):
+                interpret=False, key_bias=None):
     """FlashAttention-2 backward. Two kernels:
 
     - dQ: grid (BH, n_q, n_k), accumulates dq over KV tiles in VMEM.
@@ -232,12 +286,15 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
     n_k = S // block_k
 
     in_dtype = q.dtype
+    H = q.shape[2]
+    masked = key_bias is not None
+    kpm = key_bias.astype(jnp.float32)[..., None] if masked else None
     qh, kh, vh = _to_bh(q), _to_bh(k), _to_bh(v)
     oh, gh = _to_bh(out), _to_bh(g)
     delta = jnp.sum(gh.astype(jnp.float32) * oh.astype(jnp.float32),
                     axis=-1, keepdims=True)                # [BH, T, 1]
 
-    def scores(q_ref, k_ref, qi, ki):
+    def scores(q_ref, k_ref, qi, ki, kpm_ref=None):
         qb = q_ref[0].astype(jnp.float32)                  # [bq, D]
         kb = k_ref[0].astype(jnp.float32)                  # [bk, D]
         s = jax.lax.dot_general(
@@ -249,10 +306,17 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, DEFAULT_MASK_VALUE)
+        if kpm_ref is not None:
+            s = s + kpm_ref[0][:, 0][None, :]              # additive bias
         return s
 
     def dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                  dq_ref, dq_acc):
+                  *refs):
+        if masked:
+            kpm_ref, dq_ref, dq_acc = refs
+        else:
+            dq_ref, dq_acc = refs
+            kpm_ref = None
         qi = pl.program_id(1)
         ki = pl.program_id(2)
 
@@ -266,7 +330,7 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
 
         @pl.when(run if causal else True)
         def _compute():
-            s = scores(q_ref, k_ref, qi, ki)
+            s = scores(q_ref, k_ref, qi, ki, kpm_ref)
             lse = lse_ref[0][:, :1]                        # [bq, 1]
             p = jnp.exp(s - lse)                           # [bq, bk]
             gb = g_ref[0].astype(jnp.float32)              # [bq, D]
@@ -284,26 +348,37 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
         def _finish():
             dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
+    ]
+    dq_args = [qh, kh, vh, gh, lse, delta]
+    if masked:
+        dq_in_specs.append(pl.BlockSpec(
+            (1, block_k, 1), lambda bh, qi, ki: (bh // H, ki, 0)))
+        dq_args.append(kpm)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B * H, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, D),
                                lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(qh.shape, in_dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(qh, kh, vh, gh, lse, delta)
+    )(*dq_args)
 
     def dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                   dk_ref, dv_ref, dk_acc, dv_acc):
+                   *refs):
+        if masked:
+            kpm_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+        else:
+            dk_ref, dv_ref, dk_acc, dv_acc = refs
+            kpm_ref = None
         ki = pl.program_id(1)
         qi = pl.program_id(2)
 
@@ -319,7 +394,7 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
 
         @pl.when(run if causal else True)
         def _compute():
-            s = scores(q_ref, k_ref, qi, ki)
+            s = scores(q_ref, k_ref, qi, ki, kpm_ref)
             p = jnp.exp(s - lse_ref[0][:, :1])             # [bq, bk]
             gb = g_ref[0].astype(jnp.float32)              # [bq, D]
             dv_acc[:] += jax.lax.dot_general(
@@ -340,17 +415,23 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
             dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
             dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+        pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0)),
+    ]
+    dkv_args = [qh, kh, vh, gh, lse, delta]
+    if masked:
+        dkv_in_specs.append(pl.BlockSpec(
+            (1, block_k, 1), lambda bh, ki, qi: (bh // H, ki, 0)))
+        dkv_args.append(kpm)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(B * H, n_k, n_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
@@ -364,7 +445,7 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(qh, kh, vh, gh, lse, delta)
+    )(*dkv_args)
 
     return (_from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H))
 
@@ -373,53 +454,64 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_pallas(q, k, v, causal, sm_scale, block_q, block_k,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_pallas(q, k, v, key_bias, causal, sm_scale, block_q, block_k,
                   interpret=False):
     out, _ = _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
-                         interpret)
+                         interpret, key_bias=key_bias)
     return out
 
 
-def _flash_pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+def _flash_pallas_fwd(q, k, v, key_bias, causal, sm_scale, block_q, block_k,
                       interpret):
     out, lse = _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
-                           interpret)
-    return out, (q, k, v, out, lse)
+                           interpret, key_bias=key_bias)
+    return out, (q, k, v, key_bias, out, lse)
 
 
 def _flash_pallas_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale,
-                       block_q, block_k, interpret)
+    q, k, v, key_bias, out, lse = res
+    dq, dk, dv = _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale,
+                             block_q, block_k, interpret,
+                             key_bias=key_bias)
+    dkb = None if key_bias is None else jnp.zeros_like(key_bias)
+    return dq, dk, dv, dkb
 
 
 _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
 
 
 def flash_attention(q, k, v, causal=True, sm_scale=None,
-                    block_q=512, block_k=512, implementation="auto"):
+                    block_q=512, block_k=512, implementation="auto",
+                    key_padding_mask=None, key_bias=None):
     """Memory-efficient attention; q,k,v: [B, T, H, D] → [B, T, H, D].
 
     ``implementation``: "auto" (pallas on TPU, xla elsewhere), "pallas"
     (interpreter mode off-TPU — slow, for parity tests), "xla", or "dense".
+    ``key_padding_mask`` [B, S] bool (True = attend) or ``key_bias``
+    [B, S] additive fp32 (soft penalties honored exactly): applied to
+    scores in every implementation; outputs at fully-masked *query*
+    positions are unspecified (mask them downstream, as the loss does).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    bias = _to_key_bias(key_padding_mask, key_bias)
     on_tpu = jax.devices()[0].platform == "tpu"
     if implementation == "auto":
         implementation = "pallas" if on_tpu else "xla"
     if implementation == "dense":
-        return dense_attention(q, k, v, causal, sm_scale)
+        return dense_attention(q, k, v, causal, sm_scale, key_bias=bias)
     if implementation == "xla":
-        return _blockwise_attention(q, k, v, causal, sm_scale)
+        return _blockwise_attention(q, k, v, causal, sm_scale,
+                                    key_bias=bias)
     if implementation == "pallas":
         T = q.shape[1]
         bq = min(block_q, T)
         bk = min(block_k, k.shape[1])
         # Fall back when shapes don't tile cleanly.
         if T % bq != 0 or k.shape[1] % bk != 0:
-            return _blockwise_attention(q, k, v, causal, sm_scale)
-        return _flash_pallas(q, k, v, causal, sm_scale, bq, bk,
-                             not on_tpu)
+            return _blockwise_attention(q, k, v, causal, sm_scale,
+                                        key_bias=bias)
+        return _flash_pallas(q, k, v, bias, causal, sm_scale,
+                             bq, bk, not on_tpu)
     raise ValueError(f"unknown implementation {implementation!r}")

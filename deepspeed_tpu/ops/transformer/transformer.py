@@ -97,6 +97,19 @@ class DeepSpeedTransformerConfig:
             return cls.from_dict(json.loads(reader.read()))
 
 
+def _is_key_padding_shape(shape, B, T):
+    """True when an additive mask is broadcastable to [B, 1, 1, T] — i.e.
+    constant over query positions, so it collapses to a key-padding row."""
+    if len(shape) > 4:
+        return False
+    padded = (1,) * (4 - len(shape)) + tuple(shape)
+    ok_b = padded[0] in (1, B)
+    ok_heads = padded[1] == 1
+    ok_q = padded[2] == 1
+    ok_k = padded[3] in (1, T)
+    return ok_b and ok_heads and ok_q and ok_k
+
+
 class DeepSpeedTransformerLayer(nn.Module):
     """One transformer encoder block (reference ``DeepSpeedTransformerLayer``,
     `ops/transformer/transformer.py` + the C++ composition cited above).
@@ -181,10 +194,25 @@ class DeepSpeedTransformerLayer(nn.Module):
                            k.transpose(0, 2, 1, 3),
                            v.transpose(0, 2, 1, 3),
                            key_padding_mask=kpm).transpose(0, 2, 1, 3)
-            elif self.use_flash_attention and attention_mask is None:
+            elif self.use_flash_attention and (
+                    attention_mask is None or
+                    _is_key_padding_shape(attention_mask.shape, B, T)) and (
+                    deterministic or cfg.attn_dropout_ratio == 0.0):
+                # BERT-style [B,1,1,T] additive masks collapse to a key
+                # bias the flash kernels add natively (round 3) — soft
+                # penalties honored exactly. Per-query masks
+                # (e.g. [B,1,T,T]) and attention-prob dropout fall through
+                # to the dense path below (the fused kernel has no prob
+                # dropout — same contract as GPT-2's flash gate).
                 from deepspeed_tpu.ops.pallas.flash_attention import (
                     flash_attention)
-                ctx = flash_attention(q, k, v, causal=False)
+                from deepspeed_tpu.ops.sparse_attention.\
+                    sparse_self_attention import collapse_additive_mask
+                kbias = None
+                if attention_mask is not None:
+                    kbias = collapse_additive_mask(attention_mask, B, T)
+                ctx = flash_attention(q, k, v, causal=False,
+                                      key_bias=kbias)
             else:
                 scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
                 att = jnp.einsum("bthd,bshd->bhts", q, k).astype(

@@ -104,3 +104,34 @@ def test_bert_tp_runs_on_mesh():
     out = jax.jit(lambda p, i: model.apply({"params": p}, i))(sharded, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_bert_flash_path_honors_padding_mask():
+    """With a [B,1,1,T] additive padding mask, the flash attention core
+    must now engage (round 3) and match the dense path at valid
+    positions."""
+    from deepspeed_tpu.models.bert import (BertConfig, BertForMaskedLM,
+                                           init_bert_params)
+    import jax.numpy as jnp
+
+    mk = lambda flash: BertForMaskedLM(BertConfig(
+        vocab_size=64, hidden_size=16, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=32,
+        max_position_embeddings=32, use_flash_attention=flash))
+    params = init_bert_params(mk(False), jax.random.PRNGKey(0), seq_len=16)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 64, (2, 16)).astype(np.int32)
+    valid = np.ones((2, 16), np.float32)
+    valid[0, 10:] = 0.0
+    valid[1, 13:] = 0.0
+
+    def logits(flash):
+        # BertModel takes the [B, T] 1/0 mask and builds the [B,1,1,T]
+        # additive form itself
+        return mk(flash).apply({"params": params}, ids,
+                               jnp.asarray(valid), deterministic=True)
+
+    dense, flash = np.asarray(logits(False)), np.asarray(logits(True))
+    np.testing.assert_allclose(flash[valid.astype(bool)],
+                               dense[valid.astype(bool)],
+                               rtol=2e-4, atol=2e-5)

@@ -130,3 +130,55 @@ def test_gpt2_with_flash_attention():
     cfg_d = gpt2_tiny(use_flash_attention=False)
     loss_d = make_gpt2_loss_fn(GPT2LMHead(cfg_d))(params, batch, None)
     np.testing.assert_allclose(float(loss), float(loss_d), rtol=1e-4)
+
+
+# --- key-padding mask (round 3: the BERT padded-batch path) ---------------
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_key_padding_mask_matches_dense(impl, causal):
+    rng = np.random.default_rng(5)
+    B, T, H, D = 2, 256, 2, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+               for _ in range(3))
+    kpm = np.ones((B, T), bool)
+    kpm[0, 200:] = False          # padded tail, batch row 0
+    kpm[1, 64:128] = False        # hole mid-sequence, row 1
+    kpm = jnp.asarray(kpm)
+
+    def f(impl_name):
+        def loss(q, k, v):
+            out = flash_attention(q, k, v, causal=causal,
+                                  implementation=impl_name,
+                                  block_q=128, block_k=128,
+                                  key_padding_mask=kpm)
+            # only valid QUERY positions contribute (padded-query outputs
+            # are unspecified by contract; causal row 0 of batch 1 only
+            # sees masked keys after the hole starts — also excluded)
+            q_ok = kpm[:, :, None, None]
+            return (out * q_ok).astype(jnp.float32).sum()
+        return jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    vd, gd = f("dense")
+    vi, gi = f(impl)
+    np.testing.assert_allclose(float(vi), float(vd), rtol=2e-4)
+    for a, b in zip(gd, gi):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-3, atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_soft_key_bias_matches_dense(impl):
+    """Soft additive penalties (not just hard masks) are honored exactly
+    (the transformer layer passes collapsed additive masks through)."""
+    rng = np.random.default_rng(7)
+    B, T, H, D = 2, 256, 2, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+               for _ in range(3))
+    bias = jnp.asarray(rng.uniform(-2.0, 0.0, (B, T)), jnp.float32)
+
+    out_d = flash_attention(q, k, v, causal=False, implementation="dense",
+                            key_bias=bias)
+    out_i = flash_attention(q, k, v, causal=False, implementation=impl,
+                            block_q=128, block_k=128, key_bias=bias)
+    np.testing.assert_allclose(np.asarray(out_i), np.asarray(out_d),
+                               rtol=2e-4, atol=2e-5)
