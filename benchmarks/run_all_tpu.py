@@ -101,6 +101,13 @@ def main():
     if "bert" in only:
         run("bert_large", [py, "bench.py"],
             env={"BENCH_MODEL": "bert_large"})
+        run("bert_large_seq512", [py, "bench.py"],
+            env={"BENCH_MODEL": "bert_large", "BENCH_SEQ": "512"})
+        # seq512: at seq128 the fixed local window covers the whole
+        # layout (fully dense) and would measure nothing sparse
+        run("bert_large_sparse", [py, "bench.py"],
+            env={"BENCH_MODEL": "bert_large", "BENCH_SPARSE": "1",
+                 "BENCH_SEQ": "512"})
     if "offload" in only:
         run("gpt2_760m_offload", [py, "bench.py"],
             env={"BENCH_MODEL": "gpt2_760m"}, timeout=2400)
