@@ -18,6 +18,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "BENCH_TPU_RESULTS.jsonl")
+ALL_GROUPS = "gpt2,gpt2_chunked,bert,offload,longctx,sweep"
 
 
 def log(msg):
@@ -59,70 +60,91 @@ def run(tag, cmd, env=None, timeout=1800):
         return False
 
 
-def tpu_alive(timeout_s=120):
+def tpu_probe(timeout_s=120):
+    """(alive, detail) — TPU liveness from a fresh subprocess.
+
+    The tunnel wedges rather than erroring (jax.devices() blocks forever),
+    so the probe must be a killable child process, not an in-process call.
+    """
+    e = dict(os.environ)
+    e.pop("JAX_PLATFORMS", None)
     try:
-        e = dict(os.environ)
-        e.pop("JAX_PLATFORMS", None)
         r = subprocess.run(
             [sys.executable, "-c",
              "import jax; print(jax.devices()[0].platform)"],
             capture_output=True, timeout=timeout_s, text=True, env=e)
-        return r.returncode == 0 and r.stdout.strip().endswith("tpu")
-    except Exception:
-        return False
+        if r.returncode == 0 and r.stdout.strip().endswith("tpu"):
+            return True, "tpu"
+        if r.returncode == 0:
+            return False, r.stdout.strip()[:200] or "no-platform"
+        return False, (r.stderr.strip().splitlines() or ["no-tpu"])[-1][:200]
+    except subprocess.TimeoutExpired:
+        return False, f"wedged (no response in {timeout_s}s)"
+    except Exception as exc:  # noqa: BLE001 - any probe failure means "down"
+        return False, f"{type(exc).__name__}: {exc}"
 
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--only", default="gpt2,gpt2_chunked,bert,offload,"
-                                          "longctx,sweep")
+    parser.add_argument("--only", default=ALL_GROUPS)
     parser.add_argument("--force", action="store_true",
                         help="run even without a live TPU (plumbing test; "
                              "rows will carry errors/CPU-smoke values)")
     args = parser.parse_args()
     only = set(args.only.split(","))
 
-    if not args.force and not tpu_alive():
-        log("TPU not reachable; nothing captured")
-        return 1
+    if not args.force:
+        alive, detail = tpu_probe()
+        if not alive:
+            log(f"TPU not reachable ({detail}); nothing captured")
+            return 1
     log("capturing" + ("" if not args.force else " (--force: TPU state unverified)"))
     py = sys.executable
 
+    failed = set()
+
+    def grun(group, tag, cmd, **kw):
+        if not run(tag, cmd, **kw):
+            failed.add(group)
+
     if "gpt2" in only:
         # flagship 350M + remat-policy variants
-        run("gpt2_350m", [py, "bench.py"])
-        run("gpt2_350m_dots", [py, "bench.py"],
-            env={"BENCH_REMAT": "1"})
+        grun("gpt2", "gpt2_350m", [py, "bench.py"])
+        grun("gpt2", "gpt2_350m_dots", [py, "bench.py"],
+             env={"BENCH_REMAT": "1"})
     if "gpt2_chunked" in only:
-        run("gpt2_350m_chunked", [py, "bench.py"],
-            env={"BENCH_LOSS_CHUNK": "512"})
-        run("gpt2_350m_chunked_bs16", [py, "bench.py"],
-            env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "16"})
+        grun("gpt2_chunked", "gpt2_350m_chunked", [py, "bench.py"],
+             env={"BENCH_LOSS_CHUNK": "512"})
+        grun("gpt2_chunked", "gpt2_350m_chunked_bs16", [py, "bench.py"],
+             env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "16"})
     if "bert" in only:
-        run("bert_large", [py, "bench.py"],
-            env={"BENCH_MODEL": "bert_large"})
-        run("bert_large_seq512", [py, "bench.py"],
-            env={"BENCH_MODEL": "bert_large", "BENCH_SEQ": "512"})
+        grun("bert", "bert_large", [py, "bench.py"],
+             env={"BENCH_MODEL": "bert_large"})
+        grun("bert", "bert_large_seq512", [py, "bench.py"],
+             env={"BENCH_MODEL": "bert_large", "BENCH_SEQ": "512"})
         # seq512: at seq128 the fixed local window covers the whole
         # layout (fully dense) and would measure nothing sparse
-        run("bert_large_sparse", [py, "bench.py"],
-            env={"BENCH_MODEL": "bert_large", "BENCH_SPARSE": "1",
-                 "BENCH_SEQ": "512"})
+        grun("bert", "bert_large_sparse", [py, "bench.py"],
+             env={"BENCH_MODEL": "bert_large", "BENCH_SPARSE": "1",
+                  "BENCH_SEQ": "512"})
     if "offload" in only:
-        run("gpt2_760m_offload", [py, "bench.py"],
-            env={"BENCH_MODEL": "gpt2_760m"}, timeout=2400)
-        run("gpt2_1.5b_offload", [py, "bench.py"],
-            env={"BENCH_MODEL": "gpt2_1.5b"}, timeout=3600)
+        grun("offload", "gpt2_760m_offload", [py, "bench.py"],
+             env={"BENCH_MODEL": "gpt2_760m"}, timeout=2400)
+        grun("offload", "gpt2_1.5b_offload", [py, "bench.py"],
+             env={"BENCH_MODEL": "gpt2_1.5b"}, timeout=3600)
     if "longctx" in only:
-        run("longctx_speed", [py, "benchmarks/long_context.py",
-                              "--study", "speed"], timeout=2400)
-        run("longctx_maxseq", [py, "benchmarks/long_context.py",
-                               "--study", "maxseq"], timeout=2400)
+        grun("longctx", "longctx_speed", [py, "benchmarks/long_context.py",
+                                          "--study", "speed"], timeout=2400)
+        grun("longctx", "longctx_maxseq", [py, "benchmarks/long_context.py",
+                                           "--study", "maxseq"], timeout=2400)
     if "sweep" in only:
-        run("block_sweep", [py, "benchmarks/long_context.py",
-                            "--study", "block"], timeout=2400)
-    log(f"capture complete → {OUT}")
-    return 0
+        grun("sweep", "block_sweep", [py, "benchmarks/long_context.py",
+                                      "--study", "block"], timeout=2400)
+    record("capture_summary", {"requested": sorted(only),
+                               "failed_groups": sorted(failed)})
+    log(f"capture complete → {OUT}"
+        + (f" (FAILED groups: {','.join(sorted(failed))})" if failed else ""))
+    return 2 if failed else 0
 
 
 if __name__ == "__main__":

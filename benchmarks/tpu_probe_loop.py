@@ -1,0 +1,93 @@
+"""TPU tunnel probe loop (round 4).
+
+The axon tunnel can wedge for hours — ``jax.devices()`` blocks forever
+with no error — so every probe runs in a subprocess with a hard timeout
+(``run_all_tpu.tpu_probe``). Each probe result is appended to
+``TPU_PROBE_LOG.txt`` at the repo root: that file is the committed
+artifact proving whether live measurements were infrastructurally
+possible this round (VERDICT r3, next-round #1).
+
+On the first LIVE probe this script launches ``benchmarks/run_all_tpu.py``
+to capture every on-chip number the round needs (flagship GPT-2 350M,
+BERT-Large seq128/512, sparse BERT, 760M/1.5B offload, long-context
+studies, block sweep) into ``BENCH_TPU_RESULTS.jsonl``. run_all_tpu
+reports which measurement groups failed (wedge/OOM/timeout mid-capture);
+those groups are retried on later UP probes until everything has a clean
+row.
+
+Usage: python benchmarks/tpu_probe_loop.py [--interval 270] [--once]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from run_all_tpu import ALL_GROUPS, OUT, tpu_probe  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_PROBE_LOG.txt")
+
+
+def log_line(msg):
+    line = f"{time.strftime('%F %T')} {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def _last_capture_summary():
+    """failed_groups from the newest capture_summary row, or None."""
+    try:
+        with open(OUT) as f:
+            rows = [json.loads(ln) for ln in f if ln.strip()]
+    except FileNotFoundError:
+        return None
+    for row in reversed(rows):
+        if row.get("tag") == "capture_summary":
+            return ",".join(row.get("failed_groups", []))
+    return None
+
+
+def capture(groups):
+    log_line(f"LIVE -> run_all_tpu.py --only {groups}")
+    before = _last_capture_summary()
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "run_all_tpu.py"),
+         "--only", groups],
+        cwd=REPO)
+    failed = _last_capture_summary()
+    if failed is None or (r.returncode != 0 and failed == before):
+        # run_all_tpu died before writing its summary row (tunnel wedged
+        # between our probe and its re-check, or a crash): nothing was
+        # captured, so everything requested is still pending.
+        log_line(f"run_all_tpu.py rc={r.returncode}, no new capture "
+                 f"summary; keeping pending={groups}")
+        return groups
+    log_line(f"run_all_tpu.py rc={r.returncode}"
+             + (f" failed={failed}" if failed else " all clean"))
+    return failed
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=270)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args()
+
+    pending = ALL_GROUPS
+    while True:
+        alive, detail = tpu_probe()
+        log_line("UP" if alive else f"down ({detail})")
+        if alive and pending:
+            pending = capture(pending)
+        if args.once:
+            return 0 if alive else 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,27 +111,47 @@ def test_bert_chunked_mlm_loss_matches_dense():
 def test_chunked_xent_with_zero3_matches_dense_curve():
     """loss_chunk composes with ZeRO-3 param sharding (the chunked path
     reads params['wte'] directly — GSPMD must handle the sharded table
-    inside the scan body identically to the dense head)."""
+    inside the scan body identically to the dense head).
+
+    Tolerance note (round-4 diagnosis of the round-3 red run): in bf16 the
+    chunked and dense curves differ by ~1.5e-4 after a few Adam steps. That
+    is NOT a ZeRO-3 interaction — the chunked-vs-dense divergence is
+    bitwise-identical at stages 0, 1 and 3 — it is bf16 rounding of the
+    ``wte`` cotangent: the scan accumulates per-chunk head gradients with
+    bf16 adds while the dense head computes one fp32-accumulated matmul.
+    Measured against an fp64 oracle, dense dwte is itself 2.5e-3 off and
+    chunked 4e-3 — both at the bf16 noise floor (eps 2^-8 ≈ 4e-3), and in
+    fp32 the two curves agree to 1e-7 (and grads to 5e-5, see
+    test_chunked_loss_fn_grads_match_dense). So this test asserts the two
+    things that are actually exact: ZeRO-3 must be loss-transparent
+    (sharded == unsharded curve, tight), and chunked-vs-dense must sit at
+    the bf16 noise floor (2e-3, ~10x the observed 1.5e-4)."""
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHead,
                                            init_gpt2_params,
                                            make_gpt2_loss_fn)
 
-    def train(chunk):
+    def train(chunk, zero_stage):
         cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=16,
                          n_layer=2, n_head=2, dtype=jnp.bfloat16,
                          loss_chunk=chunk)
         model = GPT2LMHead(cfg)
         params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=32)
+        config = {"train_batch_size": 8,
+                  "bf16": {"enabled": True},
+                  "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                  "steps_per_print": 1000}
+        if zero_stage:
+            config["zero_optimization"] = {"stage": zero_stage}
         engine, _, _, _ = deepspeed_tpu.initialize(
-            config={"train_batch_size": 8,
-                    "bf16": {"enabled": True},
-                    "zero_optimization": {"stage": 3},
-                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
-                    "steps_per_print": 1000},
-            loss_fn=make_gpt2_loss_fn(model), params=params)
+            config=config, loss_fn=make_gpt2_loss_fn(model), params=params)
         batch = {"input_ids": np.random.default_rng(0).integers(
             0, 128, (8, 32)).astype(np.int32)}
         return [float(engine.train_batch(batch)) for _ in range(5)]
 
-    np.testing.assert_allclose(train(8), train(0), rtol=1e-5)
+    chunked_z3, chunked_z0 = train(8, 3), train(8, 0)
+    dense_z3 = train(0, 3)
+    # ZeRO-3 sharding must not change the chunked curve at all.
+    np.testing.assert_allclose(chunked_z3, chunked_z0, rtol=1e-6)
+    # Chunked vs dense: bf16 noise floor only (see docstring).
+    np.testing.assert_allclose(chunked_z3, dense_z3, rtol=2e-3)
