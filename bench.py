@@ -88,9 +88,15 @@ def run_once_bert(jax, bs, seq_len, steps, sparse=False):
                                        attention="bidirectional")
         layout = np.asarray(sparsity.make_layout(seq_len))
         attn_density = float(layout.sum()) / layout.size
+    # Default dropout 0.1 = the reference's published BERT-Large recipe
+    # (bert-pretraining.md) — the flash path takes attention-prob dropout
+    # in-kernel (round 4), so this no longer silently de-fuses attention.
+    drop = float(os.environ.get("BENCH_DROPOUT", "0.1"))
     cfg = bert_large(max_position_embeddings=max(512, seq_len),
                      dtype=jnp.bfloat16, use_flash_attention=True,
                      sparse_attention=sparsity,
+                     hidden_dropout_prob=drop,
+                     attention_probs_dropout_prob=drop,
                      loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK",
                                                    "0")))
     model = BertForMaskedLM(cfg)
@@ -98,7 +104,8 @@ def run_once_bert(jax, bs, seq_len, steps, sparse=False):
     config = {
         "train_batch_size": bs,
         "bf16": {"enabled": True},
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4,
+            "pallas": os.environ.get("BENCH_PALLAS_ADAM", "0") == "1"}},
         "steps_per_print": 10 ** 9,
     }
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -264,6 +271,9 @@ def run_once_gpt2_offload(jax, cfg_fn, batch_size, seq_len, steps,
         "train_batch_size": batch_size,
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 2, "cpu_offload": True},
+        # no BENCH_PALLAS_ADAM knob here: the offload path updates via the
+        # host C++ Adam, never the device _opt_update — the knob would be
+        # a silent no-op mislabeling the A/B.
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "steps_per_print": 10 ** 9,
     }
@@ -299,7 +309,8 @@ def run_once(jax, cfg_fn, batch_size, seq_len, steps, remat, on_tpu):
     config = {
         "train_batch_size": batch_size,
         "bf16": {"enabled": True},
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4,
+            "pallas": os.environ.get("BENCH_PALLAS_ADAM", "0") == "1"}},
         "steps_per_print": 10 ** 9,
     }
     engine, _, _, _ = deepspeed_tpu.initialize(

@@ -88,13 +88,19 @@ class CausalSelfAttention(nn.Module):
         v = v.reshape(B, T, H, C // H)
 
         if cfg.use_flash_attention:
-            # The fused kernel has no attention-prob dropout; refuse configs
-            # where the two attention paths would train differently.
-            assert cfg.dropout == 0.0 or deterministic, (
-                "use_flash_attention does not support attention dropout; "
-                "set dropout=0.0 or use the dense attention path")
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
-            y = flash_attention(q, k, v, causal=True)
+            # Attention-prob dropout runs inside the kernels (counter-based
+            # mask regenerated in the backward), so the flash path stays on
+            # in training configs — the round-3 gate that forced dense
+            # attention whenever dropout was active is gone.
+            rate, seed = 0.0, None
+            if not deterministic and cfg.dropout > 0.0:
+                rate = cfg.dropout
+                seed = jax.lax.bitcast_convert_type(
+                    jax.random.bits(self.make_rng("dropout"), (),
+                                    jnp.uint32), jnp.int32)
+            y = flash_attention(q, k, v, causal=True,
+                                dropout_rate=rate, dropout_seed=seed)
         else:
             scale = 1.0 / jnp.sqrt(jnp.asarray(C // H, cfg.dtype))
             att = jnp.einsum("bthd,bshd->bhts", q, k) * scale

@@ -27,49 +27,10 @@ from jax import lax
 import flax.linen as nn
 
 from deepspeed_tpu.moe.layer import MoEConfig, compute_capacity, top_k_gating
-
-
-def psum_grad(x, axis_name):
-    """Identity in forward; ``psum`` of the cotangent over ``axis_name`` in
-    backward. Makes grads of tensors consumed by axis-partitioned compute
-    exact (each rank's backward contributes only its shard's part)."""
-
-    @jax.custom_vjp
-    def _f(y):
-        return y
-
-    def _fwd(y):
-        return y, None
-
-    def _bwd(_, g):
-        return (lax.psum(g, axis_name),)
-
-    _f.defvjp(_fwd, _bwd)
-    return _f(x)
-
-
-def psum_combine(x, axis_name):
-    """``psum`` in forward; *identity* in backward.
-
-    The dual of :func:`psum_grad`, for combining axis-partitioned partial
-    outputs that are then consumed replicated. Raw ``lax.psum`` is wrong
-    here: its transpose is another psum, so a replicated cotangent comes
-    back multiplied by the axis size. With the output replicated, the true
-    cotangent of each rank's partial is exactly the output's cotangent —
-    identity."""
-
-    @jax.custom_vjp
-    def _f(y):
-        return lax.psum(y, axis_name)
-
-    def _fwd(y):
-        return lax.psum(y, axis_name), None
-
-    def _bwd(_, g):
-        return (g,)
-
-    _f.defvjp(_fwd, _bwd)
-    return _f(x)
+# Canonical home is parallel/collectives.py (shared with the TP pipeline
+# layers); re-exported here for back-compat with round-3 imports.
+from deepspeed_tpu.parallel.collectives import (  # noqa: F401
+    axis_is_manual, psum_combine, psum_grad)
 
 
 class ExpertParallelFFNLayer:
@@ -130,16 +91,13 @@ class ExpertParallelFFNLayer:
         h = (x - mean) * jax.lax.rsqrt(var + 1e-5)
         h = (h * params["ln_scale"] + params["ln_bias"]).astype(dtype)
 
-        # Outside shard_map (build-time shape inference, the sequential
-        # test oracle) the expert axis is unbound: run the full bank
-        # replicated, no collectives. axis_index's unbound-name check is
-        # eager, so the probe is a clean trace-time branch.
-        try:
-            rank = lax.axis_index(ax)
-            bound = True
-        except NameError:
-            rank = 0
-            bound = False
+        # Outside the pipeline's shard_map (build-time shape inference,
+        # the sequential test oracle) the layer runs the full bank
+        # replicated, no collectives. The pipeline declares its mesh axes
+        # manual via parallel.collectives.manual_axes — an explicit flag,
+        # not the round-3 NameError probe.
+        bound = axis_is_manual(ax)
+        rank = lax.axis_index(ax) if bound else 0
 
         gate = params["gate"]
         if bound:

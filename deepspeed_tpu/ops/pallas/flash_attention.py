@@ -23,6 +23,8 @@ path (or pallas in interpreter mode when explicitly requested).
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -30,6 +32,52 @@ DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 # Additive form of a hard key mask (added to scores, so it must stay well
 # inside fp32 range): exp(s - 1e9) == 0.0 exactly in fp32.
 MASK_BIAS = -1e9
+
+# Counter-based dropout: resolution of the keep threshold (top 24 bits of
+# the hash compared against keep_prob * 2^24).
+_DROPOUT_RESOLUTION = 1 << 24
+# murmur3 fmix32 constants (as wrapping int32)
+_FMIX_C1 = -2048144789      # 0x85EBCA6B
+_FMIX_C2 = -1028477387      # 0xC2B2AE35
+_GOLDEN = -1640531527       # 0x9E3779B9
+
+
+def dropout_multiplier(seed, head, q_pos, k_pos, rate):
+    """Counter-based attention-prob dropout multiplier: 0 or 1/keep_prob.
+
+    The fused-dropout capability of the reference's transformer kernel
+    (`csrc/transformer/dropout_kernels.cu`, cuRAND Philox seeded from
+    `csrc/includes/context.h:177`), re-designed counter-based: the mask at
+    global coordinates (head, q_pos, k_pos) is a pure integer-hash
+    function (murmur3 fmix32 avalanche over a linear combination of the
+    coordinates and the step seed). Because it is plain int32 arithmetic,
+    it computes bitwise-identically inside the Pallas TPU kernels, the
+    interpret-mode kernels, the blockwise-XLA path and the dense
+    reference — which is what makes flash-with-dropout testable against
+    dense-with-the-same-mask, keeps the backward's regenerated mask equal
+    to the forward's without storing [T, S] bytes, and makes remat replay
+    the identical mask. (``pltpu.prng_random_bits`` would be
+    hardware-only: it is a zero-stub under interpret mode.)
+
+    ``seed``/``head`` scalars (traced ok), ``q_pos``/``k_pos`` int32
+    arrays that broadcast to the tile shape; ``rate`` static Python float
+    in [0, 1). Returns fp32 of the broadcast shape.
+    """
+    keep_prob = 1.0 - rate
+    h = (jnp.asarray(q_pos, jnp.int32) * jnp.int32(_GOLDEN)
+         + jnp.asarray(k_pos, jnp.int32) * jnp.int32(_FMIX_C2)
+         + jnp.asarray(head, jnp.int32) * jnp.int32(_FMIX_C1)
+         + jnp.asarray(seed, jnp.int32))
+    h = h ^ jax.lax.shift_right_logical(h, 16)
+    h = h * jnp.int32(_FMIX_C1)
+    h = h ^ jax.lax.shift_right_logical(h, 13)
+    h = h * jnp.int32(_FMIX_C2)
+    h = h ^ jax.lax.shift_right_logical(h, 16)
+    # Top 24 bits as a uniform value in [0, 2^24): unsigned comparison in
+    # int32-safe range (both operands < 2^24).
+    u24 = jax.lax.shift_right_logical(h, 8)
+    thr = jnp.int32(int(round(keep_prob * _DROPOUT_RESOLUTION)))
+    return (u24 < thr).astype(jnp.float32) * jnp.float32(1.0 / keep_prob)
 
 
 # ---------------------------------------------------------------------------
@@ -50,11 +98,26 @@ def _to_key_bias(key_padding_mask, key_bias):
     return None
 
 
+def _dropout_multiplier_full(B, H, T, S, rate, seed):
+    """The [B, H, T, S] dropout multiplier the kernels generate tile-wise,
+    materialized whole (dense reference / tests). Head coordinate is the
+    folded bh = b*H + h index, matching the kernels' grid dim 0."""
+    bh = (jnp.arange(B)[:, None] * H
+          + jnp.arange(H)[None, :])                        # [B, H]
+    return dropout_multiplier(
+        seed, bh[:, :, None, None],
+        jnp.arange(T)[None, None, :, None],
+        jnp.arange(S)[None, None, None, :], rate)
+
+
 def dense_attention(q, k, v, causal=True, sm_scale=None,
-                    key_padding_mask=None, key_bias=None):
+                    key_padding_mask=None, key_bias=None,
+                    dropout_rate=0.0, dropout_seed=None):
     """Plain attention; q,k,v: [B, T, H, D] → [B, T, H, D].
     ``key_padding_mask`` [B, S] bool (True = attend) or ``key_bias``
-    [B, S] additive fp32."""
+    [B, S] additive fp32. ``dropout_rate``/``dropout_seed``: attention-prob
+    dropout with the shared counter-based mask (post-softmax, matching
+    every other implementation bit-for-bit)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     bias = _to_key_bias(key_padding_mask, key_bias)
@@ -65,8 +128,12 @@ def dense_attention(q, k, v, causal=True, sm_scale=None,
         scores = jnp.where(mask[None, None], scores, DEFAULT_MASK_VALUE)
     if bias is not None:
         scores = scores + bias[:, None, None, :]
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhts,bshd->bthd", probs, v)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0:
+        B, T, H, _ = q.shape
+        probs = probs * _dropout_multiplier_full(
+            B, H, T, k.shape[1], dropout_rate, dropout_seed)
+    return jnp.einsum("bhts,bshd->bthd", probs.astype(q.dtype), v)
 
 
 # ---------------------------------------------------------------------------
@@ -74,9 +141,12 @@ def dense_attention(q, k, v, causal=True, sm_scale=None,
 # ---------------------------------------------------------------------------
 
 def _blockwise_attention(q, k, v, causal, sm_scale, block_k=256,
-                         key_bias=None):
+                         key_bias=None, dropout_rate=0.0, dropout_seed=None):
     """Online-softmax attention; memory O(T * block_k) per head.
-    ``key_bias`` [B, S] additive fp32 (resolved by the caller)."""
+    ``key_bias`` [B, S] additive fp32 (resolved by the caller).
+    Dropout uses the shared counter-based mask — bitwise-identical to the
+    Pallas kernels' — applied to the normalized probs (the l normalizer
+    sums the undropped probs, as softmax-then-dropout requires)."""
     B, T, H, D = q.shape
     S = k.shape[1]
     if key_bias is None:
@@ -98,6 +168,7 @@ def _blockwise_attention(q, k, v, causal, sm_scale, block_k=256,
     mb = jnp.moveaxis(kpm.reshape(B, n_blocks, block_k), 1, 0)
 
     q_pos = jnp.arange(T)
+    bh_idx = jnp.arange(B)[:, None] * H + jnp.arange(H)[None, :]  # [B, H]
 
     def body(carry, inputs):
         acc, m, l = carry
@@ -116,8 +187,14 @@ def _blockwise_attention(q, k, v, causal, sm_scale, block_k=256,
         p = jnp.exp(s - m_new[..., None])
         correction = jnp.exp(m - m_new)
         l_new = l * correction + p.sum(axis=-1)
+        p_acc = p
+        if dropout_rate > 0.0:
+            p_acc = p * dropout_multiplier(
+                dropout_seed, bh_idx[:, :, None, None],
+                q_pos[None, None, :, None],
+                kv_pos[None, None, None, :], dropout_rate)
         acc = acc * correction[..., None] + \
-            jnp.einsum("bhts,bshd->bhtd", p, v_blk)
+            jnp.einsum("bhts,bshd->bhtd", p_acc, v_blk)
         return (acc, m_new, l_new), None
 
     acc0 = jnp.zeros((B, H, T, D), jnp.float32)
@@ -154,11 +231,15 @@ def _from_bh(x, B, H):
 # re-streamed on every q-step of the dK/dV grid; at long sequence lengths
 # that stream dwarfs the q/k/v traffic itself.
 def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
-                interpret=False, key_bias=None):
+                interpret=False, key_bias=None,
+                dropout_rate=0.0, dropout_seed=None):
     """Returns (out [B,T,H,D], lse [B*H,T,1]) — lse is the softmax row
     logsumexp residual consumed by the backward kernels.
     ``key_bias`` [B, S] additive fp32 rides as a [B, S, 1] array indexed
-    per batch (bh // H)."""
+    per batch (bh // H). ``dropout_rate`` (static) / ``dropout_seed``
+    (int32 scalar, SMEM): in-kernel attention-prob dropout — applied to
+    the accumulated probs while ``l`` keeps summing the undropped probs
+    (softmax normalizes before dropout zeroes)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -171,6 +252,7 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
     n_q = T // block_q
     n_k = S // block_k
     masked = key_bias is not None
+    dropping = dropout_rate > 0.0
 
     q, k, v = _to_bh(q), _to_bh(k), _to_bh(v)
     kpm = None
@@ -178,11 +260,11 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
         kpm = key_bias.astype(jnp.float32)[..., None]        # [B, S, 1]
 
     def kernel(q_ref, k_ref, v_ref, *refs):
-        if masked:
-            kpm_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-        else:
-            o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-            kpm_ref = None
+        refs = list(refs)
+        kpm_ref = refs.pop(0) if masked else None
+        seed_ref = refs.pop(0) if dropping else None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        bh = pl.program_id(0)
         qi = pl.program_id(1)
         ki = pl.program_id(2)
 
@@ -204,11 +286,12 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)        # [bq, bk]
-            if causal:
+            if causal or dropping:
                 q_pos = qi * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 0)
                 k_pos = ki * block_k + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
+            if causal:
                 s = jnp.where(k_pos <= q_pos, s, DEFAULT_MASK_VALUE)
             if masked:
                 # [bk, 1] sublane vector → additive row bias over lanes
@@ -219,9 +302,13 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
             corr = jnp.exp(m_prev - m_new)
             l_ref[:, 0] = l_ref[:, 0] * corr + p.sum(axis=-1)
             m_ref[:, 0] = m_new
+            pd = p
+            if dropping:
+                pd = p * dropout_multiplier(
+                    seed_ref[0], bh, q_pos, k_pos, dropout_rate)
             vb = v_ref[0].astype(jnp.float32)              # [bk, D]
             acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
-                p, vb, (((1,), (0,)), ((), ())),
+                pd, vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
         @pl.when(ki == n_k - 1)
@@ -243,6 +330,9 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
         in_specs.append(pl.BlockSpec(
             (1, block_k, 1), lambda bh, qi, ki: (bh // H, ki, 0)))
         args.append(kpm)
+    if dropping:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -266,14 +356,22 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
 
 
 def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
-                interpret=False, key_bias=None):
+                interpret=False, key_bias=None,
+                dropout_rate=0.0, dropout_seed=None):
     """FlashAttention-2 backward. Two kernels:
 
     - dQ: grid (BH, n_q, n_k), accumulates dq over KV tiles in VMEM.
     - dK/dV: grid (BH, n_k, n_q), accumulates dk, dv over Q tiles in VMEM.
+      When a key bias is present it also emits per-head dbias partials
+      (column-sums of the pre-scale ds), reduced over heads in XLA — the
+      true gradient of the additive bias.
 
     delta = rowsum(dO ⊙ O) is precomputed in XLA (it is a cheap fused
-    elementwise+reduce). All matmuls run in fp32 on the MXU.
+    elementwise+reduce); with dropout, rowsum(dP ⊙ P) still equals
+    rowsum(dO ⊙ O) because the mask multiplier appears in both factors'
+    chain. Dropout masks are regenerated in-kernel from the same
+    counter-based hash as the forward — nothing [T, S]-shaped is stored.
+    All matmuls run in fp32 on the MXU.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -288,11 +386,21 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
     in_dtype = q.dtype
     H = q.shape[2]
     masked = key_bias is not None
+    dropping = dropout_rate > 0.0
     kpm = key_bias.astype(jnp.float32)[..., None] if masked else None
+    seed_arr = (jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+                if dropping else None)
     qh, kh, vh = _to_bh(q), _to_bh(k), _to_bh(v)
     oh, gh = _to_bh(out), _to_bh(g)
     delta = jnp.sum(gh.astype(jnp.float32) * oh.astype(jnp.float32),
                     axis=-1, keepdims=True)                # [BH, T, 1]
+
+    def positions(qi, ki):
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        return q_pos, k_pos
 
     def scores(q_ref, k_ref, qi, ki, kpm_ref=None):
         qb = q_ref[0].astype(jnp.float32)                  # [bq, D]
@@ -301,22 +409,26 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
+            q_pos, k_pos = positions(qi, ki)
             s = jnp.where(k_pos <= q_pos, s, DEFAULT_MASK_VALUE)
         if kpm_ref is not None:
             s = s + kpm_ref[0][:, 0][None, :]              # additive bias
         return s
 
+    def drop_tile(seed_ref, bh, qi, ki):
+        # NB: bh is bound at kernel top — pl.program_id inside a pl.when
+        # body breaks the interpret-mode lowering.
+        q_pos, k_pos = positions(qi, ki)
+        return dropout_multiplier(seed_ref[0], bh, q_pos, k_pos,
+                                  dropout_rate)
+
     def dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                   *refs):
-        if masked:
-            kpm_ref, dq_ref, dq_acc = refs
-        else:
-            dq_ref, dq_acc = refs
-            kpm_ref = None
+        refs = list(refs)
+        kpm_ref = refs.pop(0) if masked else None
+        seed_ref = refs.pop(0) if dropping else None
+        dq_ref, dq_acc = refs
+        bh = pl.program_id(0)
         qi = pl.program_id(1)
         ki = pl.program_id(2)
 
@@ -338,6 +450,8 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
             dp = jax.lax.dot_general(
                 gb, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)        # [bq, bk]
+            if dropping:
+                dp = dp * drop_tile(seed_ref, bh, qi, ki)
             ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
             kb = k_ref[0].astype(jnp.float32)
             dq_acc[:] += jax.lax.dot_general(
@@ -361,6 +475,9 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
         dq_in_specs.append(pl.BlockSpec(
             (1, block_k, 1), lambda bh, qi, ki: (bh // H, ki, 0)))
         dq_args.append(kpm)
+    if dropping:
+        dq_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dq_args.append(seed_arr)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B * H, n_q, n_k),
@@ -374,11 +491,15 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
 
     def dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                    *refs):
+        refs = list(refs)
+        kpm_ref = refs.pop(0) if masked else None
+        seed_ref = refs.pop(0) if dropping else None
         if masked:
-            kpm_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+            dk_ref, dv_ref, dbias_ref, dk_acc, dv_acc, dbias_acc = refs
         else:
             dk_ref, dv_ref, dk_acc, dv_acc = refs
-            kpm_ref = None
+            dbias_ref = dbias_acc = None
+        bh = pl.program_id(0)
         ki = pl.program_id(1)
         qi = pl.program_id(2)
 
@@ -386,6 +507,8 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
         def _init():
             dk_acc[:] = jnp.zeros_like(dk_acc)
             dv_acc[:] = jnp.zeros_like(dv_acc)
+            if masked:
+                dbias_acc[:] = jnp.zeros_like(dbias_acc)
 
         run = True
         if causal:
@@ -397,23 +520,36 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
             s = scores(q_ref, k_ref, qi, ki, kpm_ref)
             p = jnp.exp(s - lse_ref[0][:, :1])             # [bq, bk]
             gb = g_ref[0].astype(jnp.float32)              # [bq, D]
+            if dropping:
+                mult = drop_tile(seed_ref, bh, qi, ki)
+                pd = p * mult
+            else:
+                pd = p
             dv_acc[:] += jax.lax.dot_general(
-                p, gb, (((0,), (0,)), ((), ())),
+                pd, gb, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)        # [bk, D]
             vb = v_ref[0].astype(jnp.float32)
             dp = jax.lax.dot_general(
                 gb, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)        # [bq, bk]
-            ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
+            if dropping:
+                dp = dp * mult
+            ds0 = p * (dp - delta_ref[0][:, :1])           # pre-scale ds
+            ds = ds0 * sm_scale
             qb = q_ref[0].astype(jnp.float32)
             dk_acc[:] += jax.lax.dot_general(
                 ds, qb, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)        # [bk, D]
+            if masked:
+                # d(bias_j) = Σ_t ds0[t, j] (bias is added after sm_scale)
+                dbias_acc[:, 0] += ds0.sum(axis=0)
 
         @pl.when(qi == n_q - 1)
         def _finish():
             dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
             dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+            if masked:
+                dbias_ref[0] = dbias_acc[:]
 
     dkv_in_specs = [
         pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
@@ -428,54 +564,86 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
         dkv_in_specs.append(pl.BlockSpec(
             (1, block_k, 1), lambda bh, ki, qi: (bh // H, ki, 0)))
         dkv_args.append(kpm)
-    dk, dv = pl.pallas_call(
+    if dropping:
+        dkv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkv_args.append(seed_arr)
+    dkv_out_specs = [
+        pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+    ]
+    dkv_out_shapes = [
+        jax.ShapeDtypeStruct(kh.shape, in_dtype),
+        jax.ShapeDtypeStruct(vh.shape, in_dtype),
+    ]
+    dkv_scratch = [
+        pltpu.VMEM((block_k, D), jnp.float32),
+        pltpu.VMEM((block_k, D), jnp.float32),
+    ]
+    if masked:
+        # Per-head dbias partials [BH, S, 1]: each (bh, ki) block is owned
+        # by one contiguous qi sweep, so no cross-head accumulation races;
+        # the cheap head reduction happens in XLA below.
+        dkv_out_specs.append(pl.BlockSpec(
+            (1, block_k, 1), lambda bh, ki, qi: (bh, ki, 0)))
+        dkv_out_shapes.append(
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32))
+        dkv_scratch.append(pltpu.VMEM((block_k, 1), jnp.float32))
+    outs = pl.pallas_call(
         dkv_kernel,
         grid=(B * H, n_k, n_q),
         in_specs=dkv_in_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(kh.shape, in_dtype),
-            jax.ShapeDtypeStruct(vh.shape, in_dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, D), jnp.float32),
-            pltpu.VMEM((block_k, D), jnp.float32),
-        ],
+        out_specs=dkv_out_specs,
+        out_shape=dkv_out_shapes,
+        scratch_shapes=dkv_scratch,
         interpret=interpret,
     )(*dkv_args)
+    if masked:
+        dk, dv, dbias_part = outs
+        dbias = dbias_part[:, :, 0].reshape(B, H, S).sum(axis=1)  # [B, S]
+    else:
+        dk, dv = outs
+        dbias = None
 
-    return (_from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H))
+    return (_from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H),
+            dbias)
 
 
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_pallas(q, k, v, key_bias, causal, sm_scale, block_q, block_k,
-                  interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_pallas(q, k, v, key_bias, dropout_seed, causal, sm_scale,
+                  block_q, block_k, dropout_rate, interpret=False):
     out, _ = _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
-                         interpret, key_bias=key_bias)
+                         interpret, key_bias=key_bias,
+                         dropout_rate=dropout_rate,
+                         dropout_seed=dropout_seed)
     return out
 
 
-def _flash_pallas_fwd(q, k, v, key_bias, causal, sm_scale, block_q, block_k,
-                      interpret):
+def _flash_pallas_fwd(q, k, v, key_bias, dropout_seed, causal, sm_scale,
+                      block_q, block_k, dropout_rate, interpret):
     out, lse = _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
-                           interpret, key_bias=key_bias)
-    return out, (q, k, v, key_bias, out, lse)
+                           interpret, key_bias=key_bias,
+                           dropout_rate=dropout_rate,
+                           dropout_seed=dropout_seed)
+    return out, (q, k, v, key_bias, dropout_seed, out, lse)
 
 
-def _flash_pallas_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v, key_bias, out, lse = res
-    dq, dk, dv = _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale,
-                             block_q, block_k, interpret,
-                             key_bias=key_bias)
-    dkb = None if key_bias is None else jnp.zeros_like(key_bias)
-    return dq, dk, dv, dkb
+def _flash_pallas_bwd(causal, sm_scale, block_q, block_k, dropout_rate,
+                      interpret, res, g):
+    q, k, v, key_bias, dropout_seed, out, lse = res
+    dq, dk, dv, dbias = _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale,
+                                    block_q, block_k, interpret,
+                                    key_bias=key_bias,
+                                    dropout_rate=dropout_rate,
+                                    dropout_seed=dropout_seed)
+    dkb = None if key_bias is None else dbias.astype(key_bias.dtype)
+    # int32 seed: cotangent type is float0
+    dseed = (None if dropout_seed is None
+             else np.zeros(jnp.shape(dropout_seed), jax.dtypes.float0))
+    return dq, dk, dv, dkb, dseed
 
 
 _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
@@ -483,27 +651,49 @@ _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
 
 def flash_attention(q, k, v, causal=True, sm_scale=None,
                     block_q=512, block_k=512, implementation="auto",
-                    key_padding_mask=None, key_bias=None):
+                    key_padding_mask=None, key_bias=None,
+                    dropout_rate=0.0, dropout_seed=None):
     """Memory-efficient attention; q,k,v: [B, T, H, D] → [B, T, H, D].
 
     ``implementation``: "auto" (pallas on TPU, xla elsewhere), "pallas"
     (interpreter mode off-TPU — slow, for parity tests), "xla", or "dense".
     ``key_padding_mask`` [B, S] bool (True = attend) or ``key_bias``
-    [B, S] additive fp32 (soft penalties honored exactly): applied to
-    scores in every implementation; outputs at fully-masked *query*
-    positions are unspecified (mask them downstream, as the loss does).
+    [B, S] additive fp32 (soft penalties honored exactly, with true
+    gradients on every implementation): applied to scores everywhere;
+    outputs at fully-masked *query* positions are unspecified (mask them
+    downstream, as the loss does).
+
+    ``dropout_rate`` (static float) / ``dropout_seed`` (int32 scalar,
+    traced ok — e.g. derived per step from a PRNG key): attention-prob
+    dropout computed inside the kernels from a counter-based hash of the
+    global (head, query, key) coordinates (see :func:`dropout_multiplier`)
+    — the in-kernel-dropout capability of the reference's fused
+    transformer (`csrc/transformer/dropout_kernels.cu`), with the same
+    mask bits on every implementation.
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if dropout_rate:
+        if not isinstance(dropout_rate, (int, float)):
+            raise TypeError("dropout_rate must be a static Python float")
+        if not 0.0 <= dropout_rate < 1.0:
+            raise ValueError(f"dropout_rate {dropout_rate} not in [0, 1)")
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        dropout_seed = jnp.asarray(dropout_seed, jnp.int32)
     bias = _to_key_bias(key_padding_mask, key_bias)
     on_tpu = jax.devices()[0].platform == "tpu"
     if implementation == "auto":
         implementation = "pallas" if on_tpu else "xla"
     if implementation == "dense":
-        return dense_attention(q, k, v, causal, sm_scale, key_bias=bias)
+        return dense_attention(q, k, v, causal, sm_scale, key_bias=bias,
+                               dropout_rate=dropout_rate,
+                               dropout_seed=dropout_seed)
     if implementation == "xla":
         return _blockwise_attention(q, k, v, causal, sm_scale,
-                                    key_bias=bias)
+                                    key_bias=bias,
+                                    dropout_rate=dropout_rate,
+                                    dropout_seed=dropout_seed)
     if implementation == "pallas":
         T = q.shape[1]
         bq = min(block_q, T)
@@ -511,7 +701,9 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
         # Fall back when shapes don't tile cleanly.
         if T % bq != 0 or k.shape[1] % bk != 0:
             return _blockwise_attention(q, k, v, causal, sm_scale,
-                                        key_bias=bias)
-        return _flash_pallas(q, k, v, bias, causal, sm_scale,
-                             bq, bk, not on_tpu)
+                                        key_bias=bias,
+                                        dropout_rate=dropout_rate,
+                                        dropout_seed=dropout_seed)
+        return _flash_pallas(q, k, v, bias, dropout_seed, causal, sm_scale,
+                             bq, bk, float(dropout_rate), not on_tpu)
     raise ValueError(f"unknown implementation {implementation!r}")

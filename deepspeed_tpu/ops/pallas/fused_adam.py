@@ -1,0 +1,132 @@
+"""Pallas fused Adam(W) update — the ``multi_tensor_adam.cu`` analog.
+
+The XLA form (`ops/adam/fused_adam.py:adam_update`) leaves kernel
+boundaries to the compiler; this kernel makes the one-pass structure
+explicit: each tile streams (p, g, m, v) from HBM through VMEM once and
+writes (p', m', v') back in the same pass, with the three outputs aliased
+onto their inputs (true in-place update, zero extra HBM footprint —
+`csrc/adam/multi_tensor_adam.cu:1-163`'s chunked multi-tensor walk,
+re-designed as a Pallas grid over row-tiles of the flattened leaf).
+
+``ANALYSIS_MFU.md`` attributes ~6% of the 350M step to Adam state
+traffic; whether XLA was already emitting the minimal pass is exactly
+what the on-chip A/B (BENCH_PALLAS_ADAM=1) measures.
+
+Hyperparameters ride in SMEM as a single [8] fp32 vector so one compiled
+kernel serves every step of an lr schedule.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# [rows, LANES] tiles: LANES spans the 128-lane dim fully; 256 rows x 512
+# lanes x 4 B = 512 KiB per operand tile -> 7 operands ~ 3.5 MiB of VMEM.
+_LANES = 512
+_ROWS = 256
+
+
+def _adam_kernel(adam_w_mode, s_ref, p_ref, g_ref, m_ref, v_ref,
+                 po_ref, mo_ref, vo_ref):
+    lr, b1, b2, eps, wd, bc1, bc2 = (s_ref[i] for i in range(7))
+    p = p_ref[:]
+    g = g_ref[:].astype(jnp.float32)
+    if not adam_w_mode:
+        g = g + wd * p                       # ADAM_MODE_1: L2 into grad
+    m_new = b1 * m_ref[:] + (1.0 - b1) * g
+    v_new = b2 * v_ref[:] + (1.0 - b2) * g * g
+    update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    if adam_w_mode:
+        update = update + wd * p             # ADAM_MODE_0: decoupled decay
+    po_ref[:] = p - lr * update
+    mo_ref[:] = m_new
+    vo_ref[:] = v_new
+
+
+@functools.partial(jax.jit, static_argnames=("adam_w_mode", "interpret"))
+def _leaf_update(p, g, m, v, scalars, adam_w_mode=True, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    orig_shape, orig_dtype = p.shape, p.dtype
+    n = p.size
+    cols = _LANES
+    rows_total = -(-n // cols)
+    pad = rows_total * cols - n
+
+    def to2d(x, dtype):
+        x = x.reshape(-1).astype(dtype)
+        if pad:
+            x = jnp.pad(x, (0, pad))
+        return x.reshape(rows_total, cols)
+
+    p2, g2 = to2d(p, jnp.float32), to2d(g, jnp.float32)
+    m2, v2 = to2d(m, jnp.float32), to2d(v, jnp.float32)
+
+    block_rows = min(_ROWS, rows_total)
+    n_blocks = -(-rows_total // block_rows)
+    if rows_total % block_rows:
+        extra = n_blocks * block_rows - rows_total
+        p2, g2, m2, v2 = (jnp.pad(x, ((0, extra), (0, 0)))
+                          for x in (p2, g2, m2, v2))
+
+    tile = pl.BlockSpec((block_rows, cols), lambda i: (i, 0))
+    out_shape = jax.ShapeDtypeStruct(p2.shape, jnp.float32)
+    po, mo, vo = pl.pallas_call(
+        functools.partial(_adam_kernel, adam_w_mode),
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  tile, tile, tile, tile],
+        out_specs=[tile, tile, tile],
+        out_shape=[out_shape, out_shape, out_shape],
+        input_output_aliases={1: 0, 3: 1, 4: 2},
+        interpret=interpret,
+    )(scalars, p2, g2, m2, v2)
+
+    def back(x):
+        return x.reshape(-1)[:n].reshape(orig_shape)
+
+    return back(po).astype(orig_dtype), back(mo), back(vo)
+
+
+def pallas_adam_update(params, grads, state, lr, beta1=0.9, beta2=0.999,
+                       eps=1e-8, weight_decay=0.0, adam_w_mode=True,
+                       bias_correction=True, interpret=False):
+    """Drop-in for :func:`deepspeed_tpu.ops.adam.fused_adam.adam_update`
+    (same signature contract, same math) with the leaf update executed by
+    the Pallas kernel. ``state`` is an ``AdamState``; returns
+    (new_params, new_state)."""
+    from deepspeed_tpu.ops.adam.fused_adam import AdamState
+
+    step = state.step + 1
+    sf = step.astype(jnp.float32)
+    if bias_correction:
+        bc1 = 1.0 - jnp.asarray(beta1, jnp.float32) ** sf
+        bc2 = 1.0 - jnp.asarray(beta2, jnp.float32) ** sf
+    else:
+        bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+    scalars = jnp.stack([jnp.asarray(lr, jnp.float32),
+                         jnp.asarray(beta1, jnp.float32),
+                         jnp.asarray(beta2, jnp.float32),
+                         jnp.asarray(eps, jnp.float32),
+                         jnp.asarray(weight_decay, jnp.float32),
+                         bc1, bc2, jnp.asarray(0.0, jnp.float32)])
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_m = treedef.flatten_up_to(state.m)
+    flat_v = treedef.flatten_up_to(state.v)
+    new_p, new_m, new_v = [], [], []
+    for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+        pn, mn, vn = _leaf_update(p, g, m, v, scalars,
+                                  adam_w_mode=adam_w_mode,
+                                  interpret=interpret)
+        new_p.append(pn)
+        new_m.append(mn)
+        new_v.append(vn)
+
+    unflat = jax.tree_util.tree_unflatten
+    return (unflat(treedef, new_p),
+            AdamState(m=unflat(treedef, new_m), v=unflat(treedef, new_v),
+                      step=step))

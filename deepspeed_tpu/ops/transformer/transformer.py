@@ -196,14 +196,14 @@ class DeepSpeedTransformerLayer(nn.Module):
                            key_padding_mask=kpm).transpose(0, 2, 1, 3)
             elif self.use_flash_attention and (
                     attention_mask is None or
-                    _is_key_padding_shape(attention_mask.shape, B, T)) and (
-                    deterministic or cfg.attn_dropout_ratio == 0.0):
+                    _is_key_padding_shape(attention_mask.shape, B, T)):
                 # BERT-style [B,1,1,T] additive masks collapse to a key
                 # bias the flash kernels add natively (round 3) — soft
-                # penalties honored exactly. Per-query masks
-                # (e.g. [B,1,T,T]) and attention-prob dropout fall through
-                # to the dense path below (the fused kernel has no prob
-                # dropout — same contract as GPT-2's flash gate).
+                # penalties honored exactly. Attention-prob dropout runs
+                # inside the kernels (round 4, counter-based mask —
+                # the `dropout_kernels.cu` capability), so training
+                # configs stay on the flash path; only per-query masks
+                # (e.g. [B,1,T,T]) still fall through to dense.
                 from deepspeed_tpu.ops.pallas.flash_attention import (
                     flash_attention)
                 from deepspeed_tpu.ops.sparse_attention.\
@@ -211,8 +211,16 @@ class DeepSpeedTransformerLayer(nn.Module):
                 kbias = None
                 if attention_mask is not None:
                     kbias = collapse_additive_mask(attention_mask, B, T)
+                rate, seed = 0.0, None
+                if not deterministic and cfg.attn_dropout_ratio > 0.0:
+                    rate = cfg.attn_dropout_ratio
+                    seed = jax.lax.bitcast_convert_type(
+                        jax.random.bits(self.make_rng("dropout"), (),
+                                        jnp.uint32), jnp.int32)
                 ctx = flash_attention(q, k, v, causal=False,
-                                      key_bias=kbias)
+                                      key_bias=kbias,
+                                      dropout_rate=rate,
+                                      dropout_seed=seed)
             else:
                 scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
                 att = jnp.einsum("bthd,bshd->bhts", q, k).astype(

@@ -1,0 +1,95 @@
+"""Manual-collective building blocks shared by every layer that runs
+inside the pipeline's ``shard_map`` (tensor-parallel blocks in
+`parallel/pipe_tp.py`, the expert-parallel FFN in `moe/expert_pipe.py`).
+
+The Megatron ``f``/``g`` conjugate pair (reference posture: TP delegated
+to Megatron's ColumnParallelLinear/RowParallelLinear,
+`deepspeed/__init__.py:76-77`) in functional-JAX form:
+
+- :func:`psum_grad` — identity forward, psum backward (``f``): repairs
+  partial cotangents of replicated tensors consumed by axis-partitioned
+  compute.
+- :func:`psum_combine` — psum forward, identity backward (``g``):
+  combines axis-partitioned partial outputs. Raw ``lax.psum`` is wrong
+  here because its transpose is another psum — a replicated cotangent
+  would come back multiplied by the axis size.
+
+Manual mode is an explicit, trace-time property: the pipeline enters
+:func:`manual_axes` around its ``shard_map`` body, and layers ask
+:func:`axis_is_manual` — replacing the round-3 ``lax.axis_index``
+NameError probe, which misfired whenever a caller happened to bind the
+axis name for unrelated reasons (and depended on an exception message
+contract).
+"""
+
+import contextlib
+
+import jax
+from jax import lax
+
+_MANUAL_AXES = ()
+
+
+@contextlib.contextmanager
+def manual_axes(axes):
+    """Declare mesh axes as manual (inside ``shard_map``) for layers
+    traced within this context. Trace-time only — the pipeline wraps its
+    device function, so the flag is active exactly while layer bodies
+    trace."""
+    global _MANUAL_AXES
+    prev = _MANUAL_AXES
+    _MANUAL_AXES = prev + tuple(a for a in axes if a not in prev)
+    try:
+        yield
+    finally:
+        _MANUAL_AXES = prev
+
+
+def axis_is_manual(axis_name):
+    """True iff ``axis_name`` was declared manual by :func:`manual_axes`
+    (i.e. we are tracing inside the pipeline's shard_map and collectives
+    over this axis are both legal and required)."""
+    return axis_name in _MANUAL_AXES
+
+
+def psum_grad(x, axis_name):
+    """Identity in forward; ``psum`` of the cotangent over ``axis_name`` in
+    backward. Makes grads of tensors consumed by axis-partitioned compute
+    exact (each rank's backward contributes only its shard's part)."""
+
+    @jax.custom_vjp
+    def _f(y):
+        return y
+
+    def _fwd(y):
+        return y, None
+
+    def _bwd(_, g):
+        return (lax.psum(g, axis_name),)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(x)
+
+
+def psum_combine(x, axis_name):
+    """``psum`` in forward; *identity* in backward.
+
+    The dual of :func:`psum_grad`, for combining axis-partitioned partial
+    outputs that are then consumed replicated. Raw ``lax.psum`` is wrong
+    here: its transpose is another psum, so a replicated cotangent comes
+    back multiplied by the axis size. With the output replicated, the true
+    cotangent of each rank's partial is exactly the output's cotangent —
+    identity."""
+
+    @jax.custom_vjp
+    def _f(y):
+        return lax.psum(y, axis_name)
+
+    def _fwd(y):
+        return lax.psum(y, axis_name), None
+
+    def _bwd(_, g):
+        return (g,)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(x)

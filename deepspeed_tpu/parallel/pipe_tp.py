@@ -5,48 +5,144 @@ pipeline stages (`docs/_tutorials/megatron.md`; PipelineModule over
 Megatron's ColumnParallel/RowParallel). Our GSPMD TP layer library
 (`parallel/tensor_parallel.py`) relies on sharding constraints, which are
 inert inside the pipeline's manual ``shard_map`` — so the pipeline body
-needs TP written with explicit collectives, like the expert-parallel FFN
-(`moe/expert_pipe.py`):
+needs TP written with explicit collectives.
 
-- ``mp_*``-named param leaves carry their shard dim FIRST and are split
-  over the ``model`` mesh axis by the pipeline's body specs
-  (`runtime/pipe/pipeline.py:body_param_specs`);
-- column-parallel matmuls produce head/hidden shards with no comm;
-  row-parallel matmuls produce partial sums combined by one
-  ``psum_combine`` (psum forward, identity backward — the Megatron
-  ``g`` function);
-- ``psum_grad`` on the replicated input repairs the partial cotangents
-  from the column-parallel consumers (Megatron's ``f`` function).
+This module provides the reusable manual-collective layer functions
+(round 4 — previously they were fused into one bespoke GPT-2 block):
+
+- :func:`replicated_input` — Megatron ``f`` (identity fwd, grad-psum bwd)
+  on a replicated tensor about to be consumed by column-parallel compute;
+- :func:`column_parallel` / :func:`row_parallel` — the conjugate matmul
+  pair (column: output-dim sharded, no comm; row: input-dim sharded, one
+  ``psum_combine`` — Megatron ``g``);
+- :func:`split_qkv_heads` / :func:`local_attention` — head-major QKV
+  packing and the local-head attention core (the Megatron
+  head-partition);
+
+and two block architectures built from them: :class:`TPBlockLayer`
+(GPT-2-style pre-LN causal) and :class:`TPBertBlockLayer` (BERT-style
+post-LN bidirectional). Manual mode is declared by the pipeline via
+``parallel.collectives.manual_axes``; outside it (build-time shape
+inference, sequential oracles) every layer runs replicated with no
+collectives.
+
+Param-leaf convention shared with the pipeline's body specs
+(`runtime/pipe/pipeline.py:body_param_specs`): ``mp_*``-named leaves
+carry their shard dim FIRST and are split over the ``model`` mesh axis.
 """
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 import flax.linen as nn
 
-from deepspeed_tpu.moe.expert_pipe import psum_combine, psum_grad
+from deepspeed_tpu.parallel.collectives import (axis_is_manual,
+                                                psum_combine, psum_grad)
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
 
-def _axis_bound(ax):
-    """Manual-mode probe — outside shard_map (build-time shape inference,
-    sequential oracles) the layer runs replicated with no collectives."""
-    try:
-        lax.axis_index(ax)
-        return True
-    except NameError:
-        return False
+# ---------------------------------------------------------------------------
+# reusable manual-collective layer functions
+# ---------------------------------------------------------------------------
 
+def replicated_input(h, axis_name):
+    """Megatron ``f``: identity forward; in manual mode, psum of the
+    cotangent over ``axis_name`` in backward. Apply ONCE to each
+    replicated tensor feeding column-parallel compute."""
+    return psum_grad(h, axis_name) if axis_is_manual(axis_name) else h
+
+
+def column_parallel(h, w, b=None):
+    """Column-parallel matmul: ``w`` [out_local, M] (shard dim first) →
+    [B, T, out_local], no communication. The caller is responsible for
+    :func:`replicated_input` on ``h`` (once per consumed tensor)."""
+    y = h @ w.T.astype(h.dtype)
+    if b is not None:
+        y = y + b.astype(h.dtype)
+    return y
+
+
+def row_parallel(y, w, b, axis_name):
+    """Row-parallel matmul: ``w`` [in_local, M] (shard dim first) →
+    partial [B, T, M] summed across ``axis_name`` (Megatron ``g``, one
+    psum_combine) in manual mode. ``b`` [M] is replicated and added once,
+    after the combine."""
+    part = y @ w.astype(y.dtype)
+    if axis_is_manual(axis_name):
+        part = psum_combine(part, axis_name)
+    if b is not None:
+        part = part + b.astype(y.dtype)
+    return part
+
+
+def split_qkv_heads(qkv, d_head):
+    """Head-major unpack: [B, T, h_local * 3 * D] → (q, k, v), each
+    [B, T, h_local, D]. HEAD-major packing (H, 3, D) keeps whole heads
+    (q, k, v together per head) under the model-axis split of
+    ``mp_qkv``."""
+    B, T, three_hd = qkv.shape
+    h_local = three_hd // (3 * d_head)
+    qkv = qkv.reshape(B, T, h_local, 3, d_head)
+    return qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+
+
+def local_attention(q, k, v, causal, use_flash=False):
+    """Attention over the LOCAL heads (the Megatron head-partition);
+    flash kernels on TPU when ``use_flash``. Returns [B, T, hl * D]."""
+    B, T, h_local, D = q.shape
+    if use_flash:
+        y = flash_attention(q, k, v, causal=causal)
+    else:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+        s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+        s = s * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        y = jnp.einsum("bhts,bshd->bthd", p, v)
+    return y.reshape(B, T, h_local * D)
+
+
+def layer_norm(x, scale, bias, eps=1e-5):
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _tp_block_params(rng, d_model, n_head, ffn):
+    """The shared param-leaf set of both TP blocks (names double as the
+    sharding contract — see module docstring)."""
+    M, H = d_model, n_head
+    D = M // H
+    ks = jax.random.split(rng, 4)
+    init = nn.initializers.normal(0.02)
+    return {
+        "ln1_scale": jnp.ones((M,), jnp.float32),
+        "ln1_bias": jnp.zeros((M,), jnp.float32),
+        "ln2_scale": jnp.ones((M,), jnp.float32),
+        "ln2_bias": jnp.zeros((M,), jnp.float32),
+        "mp_qkv": init(ks[0], (3 * H * D, M), jnp.float32),
+        "mp_qkv_b": jnp.zeros((3 * H * D,), jnp.float32),
+        "mp_proj": init(ks[1], (H * D, M), jnp.float32),
+        "proj_b": jnp.zeros((M,), jnp.float32),
+        "mp_fc": init(ks[2], (ffn, M), jnp.float32),
+        "mp_fc_b": jnp.zeros((ffn,), jnp.float32),
+        "mp_fc_out": init(ks[3], (ffn, M), jnp.float32),
+        "fc_out_b": jnp.zeros((M,), jnp.float32),
+    }
+
+
+# ---------------------------------------------------------------------------
+# block architectures
+# ---------------------------------------------------------------------------
 
 class TPBlockLayer:
-    """GPT-2-style transformer block, tensor-parallel over ``model``.
+    """GPT-2-style pre-LN causal transformer block, tensor-parallel over
+    ``model`` — composed from the layer functions above.
 
     Param leaves (shard dim first, split over ``model`` by body specs):
       ``mp_qkv``   [n_head_local * 3 * D, M]   column-parallel QKV,
-                                               packed HEAD-major (H, 3, D)
-                                               so the model-axis split
-                                               keeps whole heads (q,k,v
-                                               together per head)
+                                               packed HEAD-major
       ``mp_qkv_b`` [n_head_local * 3 * D]
       ``mp_proj``  [n_head_local * D, M]       row-parallel attn out
       ``mp_fc``    [ffn_local, M]              column-parallel MLP in
@@ -55,9 +151,10 @@ class TPBlockLayer:
     Replicated: ``ln1/ln2`` scale+bias, ``proj_b``, ``fc_out_b`` [M]
     (row-parallel biases add once, after the psum).
 
-    ``n_head`` must divide by the model-axis size. Attention runs on the
-    LOCAL heads (flash on TPU) — the Megatron head-partition.
+    ``n_head`` must divide by the model-axis size.
     """
+
+    causal = True
 
     def __init__(self, d_model, n_head, ffn_mult=4, axis_name="model",
                  use_flash=False):
@@ -69,70 +166,62 @@ class TPBlockLayer:
         self.use_flash = use_flash
 
     def init(self, rng, x):
-        M, H = self.d_model, self.n_head
-        D = M // H
-        ks = jax.random.split(rng, 4)
-        init = nn.initializers.normal(0.02)
-        return {
-            "ln1_scale": jnp.ones((M,), jnp.float32),
-            "ln1_bias": jnp.zeros((M,), jnp.float32),
-            "ln2_scale": jnp.ones((M,), jnp.float32),
-            "ln2_bias": jnp.zeros((M,), jnp.float32),
-            "mp_qkv": init(ks[0], (3 * H * D, M), jnp.float32),
-            "mp_qkv_b": jnp.zeros((3 * H * D,), jnp.float32),
-            "mp_proj": init(ks[1], (H * D, M), jnp.float32),
-            "proj_b": jnp.zeros((M,), jnp.float32),
-            "mp_fc": init(ks[2], (self.ffn, M), jnp.float32),
-            "mp_fc_b": jnp.zeros((self.ffn,), jnp.float32),
-            "mp_fc_out": init(ks[3], (self.ffn, M), jnp.float32),
-            "fc_out_b": jnp.zeros((M,), jnp.float32),
-        }
-
-    @staticmethod
-    def _ln(x, scale, bias):
-        mean = x.mean(-1, keepdims=True)
-        var = ((x - mean) ** 2).mean(-1, keepdims=True)
-        return (x - mean) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+        return _tp_block_params(rng, self.d_model, self.n_head, self.ffn)
 
     def apply(self, params, x, rng=None):
         ax = self.axis_name
-        bound = _axis_bound(ax)
-        B, T, M = x.shape
         dtype = x.dtype
-        three_hd = params["mp_qkv"].shape[0]        # H_local * 3 * D
-        D = M // self.n_head
-        h_local = three_hd // (3 * D)
+        D = self.d_model // self.n_head
 
-        # ---- attention (column-parallel QKV, local heads, row proj) ----
-        h = self._ln(x, params["ln1_scale"], params["ln1_bias"]).astype(dtype)
-        if bound:
-            h = psum_grad(h, ax)                    # Megatron "f"
-        qkv = h @ params["mp_qkv"].T.astype(dtype) + \
-            params["mp_qkv_b"].astype(dtype)        # [B,T,hl*3*D]
-        qkv = qkv.reshape(B, T, h_local, 3, D)      # head-major packing
-        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-        if self.use_flash:
-            y = flash_attention(q, k, v, causal=True)
-        else:
-            scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
-            s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
-            mask = jnp.tril(jnp.ones((T, T), bool))
-            s = jnp.where(mask[None, None], s * scale, -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(dtype)
-            y = jnp.einsum("bhts,bshd->bthd", p, v)
-        y = y.reshape(B, T, h_local * D)
-        part = y @ params["mp_proj"].astype(dtype)  # [B,T,M] partial
-        if bound:
-            part = psum_combine(part, ax)           # Megatron "g"
-        x = x + part + params["proj_b"].astype(dtype)
+        # ---- attention (column QKV, local heads, row proj) ----------
+        h = layer_norm(x, params["ln1_scale"],
+                       params["ln1_bias"]).astype(dtype)
+        h = replicated_input(h, ax)                 # Megatron "f"
+        qkv = column_parallel(h, params["mp_qkv"], params["mp_qkv_b"])
+        q, k, v = split_qkv_heads(qkv, D)
+        y = local_attention(q, k, v, causal=self.causal,
+                            use_flash=self.use_flash)
+        x = x + row_parallel(y, params["mp_proj"], params["proj_b"], ax)
 
-        # ---- MLP (column fc, row fc_out) -------------------------------
-        h2 = self._ln(x, params["ln2_scale"], params["ln2_bias"]).astype(dtype)
-        if bound:
-            h2 = psum_grad(h2, ax)
-        ff = jax.nn.gelu(h2 @ params["mp_fc"].T.astype(dtype) +
-                         params["mp_fc_b"].astype(dtype))
-        part2 = ff @ params["mp_fc_out"].astype(dtype)
-        if bound:
-            part2 = psum_combine(part2, ax)
-        return x + part2 + params["fc_out_b"].astype(dtype)
+        # ---- MLP (column fc, row fc_out) ----------------------------
+        h2 = layer_norm(x, params["ln2_scale"],
+                        params["ln2_bias"]).astype(dtype)
+        h2 = replicated_input(h2, ax)
+        ff = jax.nn.gelu(column_parallel(h2, params["mp_fc"],
+                                         params["mp_fc_b"]))
+        return x + row_parallel(ff, params["mp_fc_out"],
+                                params["fc_out_b"], ax)
+
+
+class TPBertBlockLayer(TPBlockLayer):
+    """BERT-style post-LN bidirectional encoder block, tensor-parallel
+    over ``model`` — the second architecture composed from the same layer
+    functions (round-4 proof that pipeline-TP is a library, not one
+    hand-written block). Shares constructor, param init and the param-leaf
+    contract with :class:`TPBlockLayer` (``ln1`` = post-attention LN,
+    ``ln2`` = post-FFN LN); only the block wiring differs."""
+
+    causal = False
+
+    def apply(self, params, x, rng=None):
+        ax = self.axis_name
+        dtype = x.dtype
+        D = self.d_model // self.n_head
+
+        # ---- attention, then residual + post-LN ---------------------
+        h = replicated_input(x, ax)
+        qkv = column_parallel(h, params["mp_qkv"], params["mp_qkv_b"])
+        q, k, v = split_qkv_heads(qkv, D)
+        y = local_attention(q, k, v, causal=False,
+                            use_flash=self.use_flash)
+        att = row_parallel(y, params["mp_proj"], params["proj_b"], ax)
+        x = layer_norm(x + att, params["ln1_scale"],
+                       params["ln1_bias"]).astype(dtype)
+
+        # ---- FFN, then residual + post-LN ---------------------------
+        h2 = replicated_input(x, ax)
+        ff = jax.nn.gelu(column_parallel(h2, params["mp_fc"],
+                                         params["mp_fc_b"]))
+        out = row_parallel(ff, params["mp_fc_out"], params["fc_out_b"], ax)
+        return layer_norm(x + out, params["ln2_scale"],
+                          params["ln2_bias"]).astype(dtype)

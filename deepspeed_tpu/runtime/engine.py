@@ -497,10 +497,34 @@ class DeepSpeedEngine:
         elif name in (ADAM_OPTIMIZER, "adamw"):
             adam_w_mode = opt_params.pop("adam_w_mode", name == "adamw")
             self.opt_init_fn = init_adam_state
-            self._opt_update = lambda p, g, s, lr_, beta1: adam_update(
-                p, g, s, lr=lr_, beta1=beta1, beta2=betas[1], eps=eps,
-                weight_decay=weight_decay, adam_w_mode=adam_w_mode,
-                bias_correction=bias_correction)
+            # "pallas": true routes the leaf update through the explicit
+            # one-pass Pallas kernel (multi_tensor_adam.cu analog,
+            # ops/pallas/fused_adam.py) — TPU only, and only with
+            # unsharded optimizer state: pallas_call has no GSPMD
+            # partitioning rule, so under ZeRO it would force per-step
+            # all-gathers of exactly the state ZeRO shards.
+            want_pallas = bool(opt_params.pop("pallas", False))
+            use_pallas = want_pallas and \
+                jax.devices()[0].platform == "tpu" and \
+                self.zero_optimization_stage() == 0
+            if want_pallas and not use_pallas:
+                log_dist("optimizer 'pallas': true ignored (needs TPU and "
+                         "ZeRO stage 0); using the XLA fused update",
+                         ranks=[0])
+            if use_pallas:
+                from deepspeed_tpu.ops.pallas.fused_adam import (
+                    pallas_adam_update)
+                self._opt_update = \
+                    lambda p, g, s, lr_, beta1: pallas_adam_update(
+                        p, g, s, lr=lr_, beta1=beta1, beta2=betas[1],
+                        eps=eps, weight_decay=weight_decay,
+                        adam_w_mode=adam_w_mode,
+                        bias_correction=bias_correction)
+            else:
+                self._opt_update = lambda p, g, s, lr_, beta1: adam_update(
+                    p, g, s, lr=lr_, beta1=beta1, beta2=betas[1], eps=eps,
+                    weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+                    bias_correction=bias_correction)
         elif name == LAMB_OPTIMIZER:
             max_coeff = opt_params.pop("max_coeff", 10.0)
             min_coeff = opt_params.pop("min_coeff", 0.01)
@@ -1085,7 +1109,18 @@ class DeepSpeedEngine:
         gradients come back with a stacked data axis — then the 1-bit
         error-feedback collective + update runs in a second ``shard_map``
         over (pipe, data), each stage group averaging its own shard's
-        momentum over its data replicas."""
+        momentum over its data replicas.
+
+        Metric semantics: ``grad_norm`` here is the MEAN of the
+        per-data-replica local gradient norms (and clipping scales by the
+        MAX of them), not the norm of the data-averaged gradient that the
+        dense train steps report. The data-averaged gradient is never
+        formed on this path — materializing it (even just for its norm,
+        whose square sums cross-replica products) would reintroduce the
+        dense all-reduce the 1-bit collective exists to eliminate. The
+        mean-of-norms upper-bounds the true averaged-gradient norm
+        (triangle inequality), so treat it as a stability indicator, not
+        a cross-config-comparable quantity."""
         from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdamState
 
         for ax, size in self.mesh.shape.items():
@@ -1189,6 +1224,8 @@ class DeepSpeedEngine:
                              axis=tuple(range(1, g.ndim)))
                      for g in jax.tree_util.tree_leaves(grads))
             norms = jnp.sqrt(sq)                        # [data]
+            # mean of local norms, NOT the averaged-gradient norm — see
+            # the method docstring for why that is the only choice here.
             grad_norm = jnp.mean(norms)
             applied_norm = grad_norm
             if clip > 0:
@@ -1556,6 +1593,48 @@ class DeepSpeedEngine:
                               step=jnp.asarray(tree["step"], jnp.int32),
                               **extra)
 
+    @staticmethod
+    def _reshape_for_restage(saved_tree, template_tree, what):
+        """Pipeline restage-on-load: body param leaves are stacked
+        [stages, layers_per_stage, ...] and stages own contiguous layer
+        ranges (partition_uniform), so a checkpoint saved under a
+        different stage count holds the same layers in a different
+        row-major factorization — a pure reshape restores them (the
+        capability the reference's per-layer checkpoint files exist for,
+        `runtime/pipe/module.py:510-567`). ONLY the [stages, layers/stage]
+        leading-dim refactorization is reshaped — the per-layer payload
+        dims must match exactly, so a same-element-count leaf from a
+        genuinely different model (transposed kernel, repacked heads)
+        still raises instead of silently loading garbage."""
+        def fix(path, s, t):
+            s = jnp.asarray(s)
+            t_shape = tuple(t.shape)
+            if s.shape == t_shape:
+                return s
+            # Only pipeline-body leaves are stacked [stages, layers/stage,
+            # ...payload]: the leaf must live under the "body" key AND be
+            # at least rank-3 with identical payload dims. A 2-D transpose
+            # ([in,out] vs [out,in]) or any non-body leaf never reshapes.
+            under_body = bool(path) and \
+                getattr(path[0], "key", None) == "body"
+            restageable = (
+                under_body and s.ndim >= 3 and len(t_shape) == s.ndim and
+                s.shape[2:] == t_shape[2:] and
+                s.shape[0] * s.shape[1] == t_shape[0] * t_shape[1])
+            if not restageable:
+                raise ValueError(
+                    f"checkpoint {what} leaf {jax.tree_util.keystr(path)} "
+                    f"has shape {s.shape}, engine expects {t_shape}: not a "
+                    "pipeline restage (only the leading [stages, "
+                    "layers/stage] dims may refactor) — checkpoint is from "
+                    "a different model")
+            log_dist(
+                f"restaging {what} leaf {jax.tree_util.keystr(path)}: "
+                f"{s.shape} -> {t_shape}", ranks=[0])
+            return s.reshape(t_shape)
+        return jax.tree_util.tree_map_with_path(fix, saved_tree,
+                                                template_tree)
+
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True,
                         load_lr_scheduler_states=True):
@@ -1574,7 +1653,15 @@ class DeepSpeedEngine:
 
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
-        restored = ckptr.restore(os.path.join(path, "state"))
+        state_path = os.path.join(path, "state")
+        # Restore as host numpy arrays (placement happens below on the
+        # CURRENT mesh/shardings) — restoring with the saved shardings
+        # trips orbax's "unsafe when restoring on a different topology"
+        # path, which is exactly the elastic/restage case we support.
+        item_meta = ckptr.metadata(state_path).item_metadata
+        restore_args = jax.tree_util.tree_map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), item_meta)
+        restored = ckptr.restore(state_path, restore_args=restore_args)
 
         # Re-place on the *current* mesh/shardings: the elastic-checkpoint
         # capability (reference stage1.py:1030 re-partitions for a new dp
@@ -1608,11 +1695,16 @@ class DeepSpeedEngine:
             self.params = self._upload_offload_params()
         else:
             self.params = jax.device_put(
-                jax.tree_util.tree_map(jnp.asarray, restored["params"]),
+                self._reshape_for_restage(restored["params"], self.params,
+                                          "param"),
                 self._shardings["param"])
             if load_optimizer_states:
                 opt_tree = jax.tree_util.tree_map(jnp.asarray,
                                                   restored["opt_state"])
+                opt_tree["m"] = self._reshape_for_restage(
+                    opt_tree["m"], self.opt_state.m, "opt.m")
+                opt_tree["v"] = self._reshape_for_restage(
+                    opt_tree["v"], self.opt_state.v, "opt.v")
                 self.opt_state = jax.device_put(
                     self._opt_state_from_tree(opt_tree, self.opt_state),
                     self._opt_state_shardings())

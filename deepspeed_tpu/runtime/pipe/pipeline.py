@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.parallel.collectives import manual_axes
 from deepspeed_tpu.runtime.pipe.module import LayerSpec, TiedLayerSpec
 
 
@@ -480,8 +481,15 @@ def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
     batch_specs = jax.tree_util.tree_map(
         lambda _: P(None, "data"), batch_m)
 
+    def manual_device_fn(*args, **kwargs):
+        # Declare every mesh axis manual while the device body traces:
+        # layers with explicit collectives (TP blocks, expert-parallel
+        # FFN) switch them on via parallel.collectives.axis_is_manual.
+        with manual_axes(mesh.axis_names):
+            return device_fn(*args, **kwargs)
+
     fn = jax.shard_map(
-        partial(device_fn, use_rng=use_rng),
+        partial(manual_device_fn, use_rng=use_rng),
         mesh=mesh,
         in_specs=(body_specs, rest_specs, batch_specs, P()) +
         tuple(P() for _ in extra),

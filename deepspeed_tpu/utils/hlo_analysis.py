@@ -26,29 +26,44 @@ _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 # counting.
 _COLLECTIVES = ("all-reduce", "all-gather", "all-to-all", "reduce-scatter",
                 "collective-permute", "collective-broadcast")
+# The shape is everything between "=" and the op name — matched
+# non-greedily so nested variadic tuples like ((f32[8], f32[4]),
+# (f32[8], f32[4])) capture whole (a "[^)]*" shape class truncates them
+# at the first close-paren and silently undercounts).
 _OP_RE = re.compile(
-    r"=\s+(?P<shape>\([^)]*\)|\S+)\s+"
+    r"=\s+(?P<shape>.+?)\s+"
     r"(?P<op>" + "|".join(_COLLECTIVES) + r")(?P<suffix>-start|-done)?\(")
 
 
-def _shape_bytes(shape_text):
-    total = 0
+def _element_bytes(shape_text, skip_scalars=False):
+    """Byte size of each array element appearing in a (tuple) shape.
+    ``skip_scalars`` drops zero-rank elements (async-start context/scratch
+    scalars like ``u32[]``, which are bookkeeping, not payload)."""
+    sizes = []
     for dtype, dims in _SHAPE_RE.findall(shape_text):
         if dtype not in _DTYPE_BYTES:
             continue  # token/opaque types carry no payload
+        if skip_scalars and not dims:
+            continue
         n = 1
         if dims:
             for d in dims.split(","):
                 n *= int(d)
-        total += n * _DTYPE_BYTES[dtype]
-    return total
+        sizes.append(n * _DTYPE_BYTES[dtype])
+    return sizes
+
+
+def _shape_bytes(shape_text):
+    return sum(_element_bytes(shape_text))
 
 
 def collective_bytes(hlo_text):
     """Sum output bytes of every collective op in an HLO dump.
 
     Returns ``{op_name: bytes, ..., "total": bytes}``. Async pairs are
-    counted once (the ``-start``); tuple outputs sum their array elements.
+    counted once (the ``-start``, result element only — its output tuple
+    also aliases the operand); sync tuple outputs sum their array
+    elements.
     For ``all-reduce``/``all-to-all`` the output size equals the input
     size, so "output bytes" is the per-device payload in both directions
     of a symmetric exchange — a consistent basis for *ratios* between two
@@ -59,11 +74,21 @@ def collective_bytes(hlo_text):
         if m.group("suffix") == "-done":
             continue
         op = m.group("op")
-        b = _shape_bytes(m.group("shape"))
-        # async-start outputs are (operand_alias, result, scratch...);
-        # halve to avoid counting the aliased input buffer.
-        if m.group("suffix") == "-start" and m.group("shape").startswith("("):
-            b //= 2
+        shape = m.group("shape")
+        # async-start outputs are (operands..., results..., scratch...):
+        # count only the result half. Halving the whole tuple's bytes is
+        # exact only for symmetric collectives (all-reduce);
+        # all-gather-start / reduce-scatter-start pair shard-sized
+        # operands with differently-sized results. Scratch entries are
+        # zero-rank scalars (collective-permute-start appends two u32[]
+        # contexts) — drop them FIRST, then the remaining flattened list
+        # is (operands..., results...) with matching counts, variadic
+        # included, and the second half is the results.
+        if m.group("suffix") == "-start" and shape.startswith("("):
+            elems = _element_bytes(shape, skip_scalars=True)
+            b = sum(elems[len(elems) // 2:])
+        else:
+            b = _shape_bytes(shape)
         counts[op] = counts.get(op, 0) + b
     counts["total"] = sum(counts.values())
     return counts

@@ -12,11 +12,14 @@ from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
 
 
 def tiny_tp_pipeline_module(vocab, d_model, n_head, seq, ids_key,
-                            n_blocks=2, num_stages=2, labels_key=None):
-    """embed(table) -> n_blocks x TPBlockLayer -> head, softmax-xent loss.
+                            n_blocks=2, num_stages=2, labels_key=None,
+                            block_cls=TPBlockLayer):
+    """embed(table) -> n_blocks x ``block_cls`` -> head, softmax-xent loss.
 
     ``labels_key=None``: next-token objective (labels = ids rolled by -1);
     otherwise explicit labels from ``micro[labels_key]``.
+    ``block_cls``: any TP block with the (d_model, n_head) constructor
+    contract (TPBlockLayer, TPBertBlockLayer, ...).
     """
 
     class Embed:
@@ -46,7 +49,7 @@ def tiny_tp_pipeline_module(vocab, d_model, n_head, seq, ids_key,
         example[labels_key] = np.zeros((2, seq), np.int32)
     return PipelineModule(
         layers=[LayerSpec(Embed)] +
-               [LayerSpec(TPBlockLayer, d_model, n_head)
+               [LayerSpec(block_cls, d_model, n_head)
                 for _ in range(n_blocks)] +
                [LayerSpec(Head)],
         num_stages=num_stages, loss_fn=loss, example_input=example)

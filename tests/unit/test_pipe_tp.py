@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.parallel.mesh import build_mesh
-from deepspeed_tpu.parallel.pipe_tp import TPBlockLayer
+from deepspeed_tpu.parallel.pipe_tp import TPBertBlockLayer, TPBlockLayer
 from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
 from deepspeed_tpu.runtime.pipe.pipeline import (
     build_pipeline_parts, make_pipeline_value_and_grad_fn)
@@ -22,16 +22,17 @@ D_MODEL, N_HEAD = 8, 4
 SEQ, ROWS, MICRO = 8, 16, 4
 
 
-def _module():
+def _module(block_cls=TPBlockLayer):
     from tests.pipeline_fixtures import tiny_tp_pipeline_module
     return tiny_tp_pipeline_module(vocab=32, d_model=D_MODEL,
                                    n_head=N_HEAD, seq=SEQ, ids_key="ids",
-                                   labels_key="labels")
+                                   labels_key="labels",
+                                   block_cls=block_cls)
 
 
-def _run(mesh_shape, n_devices=8):
+def _run(mesh_shape, n_devices=8, block_cls=TPBlockLayer):
     mesh = build_mesh(mesh_shape, devices=jax.devices()[:n_devices])
-    module = _module()
+    module = _module(block_cls)
     rng = np.random.default_rng(0)
     micro = {"ids": rng.integers(0, 32, (2, SEQ)).astype(np.int32),
              "labels": rng.integers(0, 32, (2, SEQ)).astype(np.int32)}
@@ -78,3 +79,21 @@ def test_tp_pipeline_trains_through_engine():
     losses = [float(engine.train_batch(batch)) for _ in range(8)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.slow
+def test_tp_bert_pipeline_matches_replicated():
+    """Second architecture through the same TP layer library (round 4):
+    a post-LN bidirectional BERT block trains 3D (pipe=2 x model=2 x
+    data=2) with loss AND grads matching its model=1 oracle — pipeline-TP
+    is composable, not one hand-written GPT-2 block."""
+    loss_rep, grads_rep = _run({"pipe": 2, "model": 1, "data": 2},
+                               n_devices=4, block_cls=TPBertBlockLayer)
+    loss_tp, grads_tp = _run({"pipe": 2, "model": 2, "data": 2},
+                             block_cls=TPBertBlockLayer)
+    np.testing.assert_allclose(loss_tp, loss_rep, rtol=1e-5)
+    flat_rep, _ = jax.tree_util.tree_flatten(grads_rep)
+    flat_tp, _ = jax.tree_util.tree_flatten(grads_tp)
+    assert len(flat_rep) == len(flat_tp) and len(flat_tp) > 0
+    for a, b in zip(flat_rep, flat_tp):
+        np.testing.assert_allclose(b, a, rtol=3e-4, atol=1e-6)
