@@ -95,10 +95,10 @@ class CausalSelfAttention(nn.Module):
             # attention whenever dropout was active is gone.
             rate, seed = 0.0, None
             if not deterministic and cfg.dropout > 0.0:
+                from deepspeed_tpu.ops.pallas.flash_attention import (
+                    dropout_seed_from_rng)
                 rate = cfg.dropout
-                seed = jax.lax.bitcast_convert_type(
-                    jax.random.bits(self.make_rng("dropout"), (),
-                                    jnp.uint32), jnp.int32)
+                seed = dropout_seed_from_rng(self.make_rng("dropout"))
             y = flash_attention(q, k, v, causal=True,
                                 dropout_rate=rate, dropout_seed=seed)
         else:

@@ -98,6 +98,15 @@ def _to_key_bias(key_padding_mask, key_bias):
     return None
 
 
+def dropout_seed_from_rng(rng):
+    """Derive the int32 per-step dropout seed from a JAX PRNG key — the
+    one canonical way model code feeds :func:`dropout_multiplier` (every
+    attention path must use this so a shared rng stream gives identical
+    semantics everywhere)."""
+    return jax.lax.bitcast_convert_type(
+        jax.random.bits(rng, (), jnp.uint32), jnp.int32)
+
+
 def _dropout_multiplier_full(B, H, T, S, rate, seed):
     """The [B, H, T, S] dropout multiplier the kernels generate tile-wise,
     materialized whole (dense reference / tests). Head coordinate is the

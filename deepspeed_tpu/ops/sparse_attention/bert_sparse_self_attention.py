@@ -35,6 +35,9 @@ class BertSparseSelfAttention(nn.Module):
     num_attention_heads: int
     sparsity_config: Optional[SparsityConfig] = None
     dtype: jnp.dtype = jnp.float32
+    # attention-prob dropout, applied in-kernel by the sparse core when
+    # training (deterministic=False) — needs a "dropout" rng
+    attn_dropout_ratio: float = 0.0
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None,
@@ -59,7 +62,15 @@ class BertSparseSelfAttention(nn.Module):
         if attention_mask is not None:
             key_padding_mask = collapse_additive_mask(attention_mask, B, T)
 
+        rate, seed = 0.0, None
+        if not deterministic and self.attn_dropout_ratio > 0.0:
+            from deepspeed_tpu.ops.pallas.flash_attention import (
+                dropout_seed_from_rng)
+            rate = self.attn_dropout_ratio
+            seed = dropout_seed_from_rng(self.make_rng("dropout"))
+
         core = SparseSelfAttention(cfg, key_padding_mask_mode="add")
         ctx = core(heads_first(q), heads_first(k), heads_first(v),
-                   key_padding_mask=key_padding_mask)
+                   key_padding_mask=key_padding_mask,
+                   dropout_rate=rate, dropout_seed=seed)
         return ctx.transpose(0, 2, 1, 3).reshape(B, T, H)

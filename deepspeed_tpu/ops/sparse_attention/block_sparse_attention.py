@@ -105,8 +105,11 @@ def _build_lut_cached(layout_bytes, layout_shape):
 
 def _xla_impl(q, k, v, lut, nnz, block, causal, sm_scale,
               rpe=None, key_padding_mask=None, attn_mask=None,
-              key_padding_mask_mode="add", attn_mask_mode="mul"):
+              key_padding_mask_mode="add", attn_mask_mode="mul",
+              dropout_rate=0.0, dropout_seed=None):
     """q,k,v: [B, T, H, D]; lut/nnz per build_lut. Returns [B, T, H, D]."""
+    from deepspeed_tpu.ops.pallas.flash_attention import dropout_multiplier
+
     B, T, H, D = q.shape
     nq = T // block
     max_nnz = lut.shape[-1]
@@ -168,6 +171,12 @@ def _xla_impl(q, k, v, lut, nnz, block, causal, sm_scale,
         s = s.reshape(B, nq, block, max_nnz * block)
         p = jax.nn.softmax(s, axis=-1)
         p = p.reshape(B, nq, block, max_nnz, block)
+        if dropout_rate > 0.0:
+            bh = jnp.arange(B) * H + h                       # [B]
+            p = p * dropout_multiplier(
+                dropout_seed, bh[:, None, None, None, None],
+                q_pos[None, :, :, None, None],
+                k_pos[None, :, None, :, :], dropout_rate)
         return jnp.einsum("bqrjc,bqjcd->bqrd", p, vg)
 
     out = jax.vmap(per_head, in_axes=(0, 0, 0, 0))(
@@ -197,24 +206,46 @@ def _gather_attn(attn_add, lut_h, block, nq):
 # Pallas TPU kernels (no-mask fast path), forward + backward
 # ---------------------------------------------------------------------------
 
+def _block_positions(block, qblk, kblk):
+    q_pos = qblk * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, block), 0)
+    k_pos = kblk * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, block), 1)
+    return q_pos, k_pos
+
+
 def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
-                 interpret=False):
+                 interpret=False, dropout_rate=0.0, dropout_seed=None):
     """Returns (out [B,T,H,D], lse [B*H,T,1]) — the logsumexp residual
     feeds the backward kernels (compact, not lane-broadcast — see the
-    layout note in ops/pallas/flash_attention.py)."""
+    layout note in ops/pallas/flash_attention.py). Dropout uses the
+    flash kernels' counter-based hash at the same global (bh, q, k)
+    coordinates (the seed rides as a third scalar-prefetch input)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from deepspeed_tpu.ops.pallas.flash_attention import dropout_multiplier
 
     B, T, H, D = q.shape
     nq = T // block
     max_nnz = lut.shape[-1]
+    dropping = dropout_rate > 0.0
 
     q, k, v = _to_bh(q), _to_bh(k), _to_bh(v)
     lut_flat = jnp.asarray(lut.reshape(H * nq * max_nnz), jnp.int32)
     nnz_flat = jnp.asarray(nnz.reshape(H * nq), jnp.int32)
+    scalars = [lut_flat, nnz_flat]
+    if dropping:
+        scalars.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
 
-    def kernel(lut_ref, nnz_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-               acc_ref, m_ref, l_ref):
+    def kernel(lut_ref, nnz_ref, *args):
+        if dropping:
+            seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, \
+                acc_ref, m_ref, l_ref = args
+        else:
+            q_ref, k_ref, v_ref, o_ref, lse_ref, \
+                acc_ref, m_ref, l_ref = args
+            seed_ref = None
         bh = pl.program_id(0)
         qi = pl.program_id(1)
         j = pl.program_id(2)
@@ -235,10 +266,7 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)          # [blk, blk]
             if causal:
-                q_pos = qi * block + jax.lax.broadcasted_iota(
-                    jnp.int32, (block, block), 0)
-                k_pos = kblk * block + jax.lax.broadcasted_iota(
-                    jnp.int32, (block, block), 1)
+                q_pos, k_pos = _block_positions(block, qi, kblk)
                 s = jnp.where(k_pos <= q_pos, s, DEFAULT_MASK_VALUE)
             m_prev = m_ref[:, 0]
             m_new = jnp.maximum(m_prev, s.max(axis=-1))
@@ -246,9 +274,14 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
             corr = jnp.exp(m_prev - m_new)
             l_ref[:, 0] = l_ref[:, 0] * corr + p.sum(axis=-1)
             m_ref[:, 0] = m_new
+            pd = p
+            if dropping:
+                q_pos, k_pos = _block_positions(block, qi, kblk)
+                pd = p * dropout_multiplier(seed_ref[0], bh, q_pos, k_pos,
+                                            dropout_rate)
             vb = v_ref[0].astype(jnp.float32)
             acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
-                p, vb, (((1,), (0,)), ((), ())),
+                pd, vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
         @pl.when(j == max_nnz - 1)
@@ -259,24 +292,24 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
             # kernels never visit them (no LUT entries)
             lse_ref[0] = (m_ref[:, 0] + jnp.log(l))[:, None]
 
-    def k_index(bh, qi, j, lut_ref, nnz_ref):
+    def k_index(bh, qi, j, lut_ref, nnz_ref, *_):
         h = jax.lax.rem(bh, H)
         return (bh, lut_ref[(h * nq + qi) * max_nnz + j], 0)
 
+    def q_row(bh, qi, j, *_):
+        return (bh, qi, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(scalars),
         grid=(B * H, nq, max_nnz),
         in_specs=[
-            pl.BlockSpec((1, block, D),
-                         lambda bh, qi, j, lut_ref, nnz_ref: (bh, qi, 0)),
+            pl.BlockSpec((1, block, D), q_row),
             pl.BlockSpec((1, block, D), k_index),
             pl.BlockSpec((1, block, D), k_index),
         ],
         out_specs=[
-            pl.BlockSpec((1, block, D),
-                         lambda bh, qi, j, lut_ref, nnz_ref: (bh, qi, 0)),
-            pl.BlockSpec((1, block, 1),
-                         lambda bh, qi, j, lut_ref, nnz_ref: (bh, qi, 0)),
+            pl.BlockSpec((1, block, D), q_row),
+            pl.BlockSpec((1, block, 1), q_row),
         ],
         scratch_shapes=[
             pltpu.VMEM((block, D), jnp.float32),
@@ -292,18 +325,23 @@ def _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
             jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(lut_flat, nnz_flat, q, k, v)
+    )(*scalars, q, k, v)
     return _from_bh(out, B, H), lse
 
 
 def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
-                     causal, sm_scale, interpret=False):
+                     causal, sm_scale, interpret=False,
+                     dropout_rate=0.0, dropout_seed=None):
     """Block-sparse FlashAttention-2 backward: the dQ kernel walks each
     q-block's nonzero k-blocks (forward LUT); the dK/dV kernel walks each
     k-block's nonzero q-blocks (transposed LUT). The sparse [T, T] score
-    matrix never materializes in either direction."""
+    matrix never materializes in either direction. Dropout masks are
+    regenerated in-kernel from the shared counter-based hash (see
+    ops/pallas/flash_attention.py)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from deepspeed_tpu.ops.pallas.flash_attention import dropout_multiplier
 
     B, T, H, D = q.shape
     nq = T // block
@@ -311,6 +349,7 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
     max_nnz = lut.shape[-1]
     max_nnz_t = lut_t.shape[-1]
     in_dtype = q.dtype
+    dropping = dropout_rate > 0.0
 
     qh, kh, vh = _to_bh(q), _to_bh(k), _to_bh(v)
     oh, gh = _to_bh(out), _to_bh(g)
@@ -321,22 +360,32 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
     nnz_flat = jnp.asarray(nnz.reshape(H * nq), jnp.int32)
     lut_t_flat = jnp.asarray(lut_t.reshape(H * nk * max_nnz_t), jnp.int32)
     nnz_t_flat = jnp.asarray(nnz_t.reshape(H * nk), jnp.int32)
+    seed_arr = (jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+                if dropping else None)
 
     def scores_block(q_blk, k_blk, qi, kblk):
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            q_pos = qi * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 0)
-            k_pos = kblk * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 1)
+            q_pos, k_pos = _block_positions(block, qi, kblk)
             s = jnp.where(k_pos <= q_pos, s, DEFAULT_MASK_VALUE)
         return s
 
+    def drop_tile(seed_ref, bh, qblk, kblk):
+        q_pos, k_pos = _block_positions(block, qblk, kblk)
+        return dropout_multiplier(seed_ref[0], bh, q_pos, k_pos,
+                                  dropout_rate)
+
     # ---- dQ: grid (BH, nq, max_nnz) over the forward LUT ---------------
-    def dq_kernel(lut_ref, nnz_ref, q_ref, k_ref, v_ref, g_ref, lse_ref,
-                  delta_ref, dq_ref, dq_acc):
+    def dq_kernel(lut_ref, nnz_ref, *args):
+        if dropping:
+            seed_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, \
+                delta_ref, dq_ref, dq_acc = args
+        else:
+            q_ref, k_ref, v_ref, g_ref, lse_ref, \
+                delta_ref, dq_ref, dq_acc = args
+            seed_ref = None
         bh = pl.program_id(0)
         qi = pl.program_id(1)
         j = pl.program_id(2)
@@ -358,6 +407,8 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
             dp = jax.lax.dot_general(
                 gb, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
+            if dropping:
+                dp = dp * drop_tile(seed_ref, bh, qi, kblk)
             ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
             dq_acc[:] += jax.lax.dot_general(
                 ds, kb, (((1,), (0,)), ((), ())),
@@ -367,17 +418,18 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
         def _finish():
             dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
-    def k_index(bh, qi, j, lut_ref, nnz_ref):
+    def k_index(bh, qi, j, lut_ref, nnz_ref, *_):
         h = jax.lax.rem(bh, H)
         return (bh, lut_ref[(h * nq + qi) * max_nnz + j], 0)
 
-    def q_row(bh, qi, j, lut_ref, nnz_ref):
+    def q_row(bh, qi, j, *_):
         return (bh, qi, 0)
 
+    dq_scalars = [lut_flat, nnz_flat] + ([seed_arr] if dropping else [])
     dq = pl.pallas_call(
         dq_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=len(dq_scalars),
             grid=(B * H, nq, max_nnz),
             in_specs=[
                 pl.BlockSpec((1, block, D), q_row),
@@ -392,11 +444,17 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
         ),
         out_shape=jax.ShapeDtypeStruct(qh.shape, in_dtype),
         interpret=interpret,
-    )(lut_flat, nnz_flat, qh, kh, vh, gh, lse, delta)
+    )(*dq_scalars, qh, kh, vh, gh, lse, delta)
 
     # ---- dK/dV: grid (BH, nk, max_nnz_t) over the transposed LUT -------
-    def dkv_kernel(lut_t_ref, nnz_t_ref, q_ref, k_ref, v_ref, g_ref,
-                   lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc):
+    def dkv_kernel(lut_t_ref, nnz_t_ref, *args):
+        if dropping:
+            seed_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, \
+                delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = args
+        else:
+            q_ref, k_ref, v_ref, g_ref, lse_ref, \
+                delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = args
+            seed_ref = None
         bh = pl.program_id(0)
         ki = pl.program_id(1)
         j = pl.program_id(2)
@@ -415,13 +473,20 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
             s = scores_block(qb, kb, qblk, ki)
             p = jnp.exp(s - lse_ref[0][:, :1])
             gb = g_ref[0].astype(jnp.float32)
+            if dropping:
+                mult = drop_tile(seed_ref, bh, qblk, ki)
+                pd = p * mult
+            else:
+                pd = p
             dv_acc[:] += jax.lax.dot_general(
-                p, gb, (((0,), (0,)), ((), ())),
+                pd, gb, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             vb = v_ref[0].astype(jnp.float32)
             dp = jax.lax.dot_general(
                 gb, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
+            if dropping:
+                dp = dp * mult
             ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
             dk_acc[:] += jax.lax.dot_general(
                 ds, qb, (((0,), (0,)), ((), ())),
@@ -432,17 +497,18 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
             dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
             dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
-    def q_via_lut_t(bh, ki, j, lut_t_ref, nnz_t_ref):
+    def q_via_lut_t(bh, ki, j, lut_t_ref, nnz_t_ref, *_):
         h = jax.lax.rem(bh, H)
         return (bh, lut_t_ref[(h * nk + ki) * max_nnz_t + j], 0)
 
-    def k_row(bh, ki, j, lut_t_ref, nnz_t_ref):
+    def k_row(bh, ki, j, *_):
         return (bh, ki, 0)
 
+    dkv_scalars = [lut_t_flat, nnz_t_flat] + ([seed_arr] if dropping else [])
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=len(dkv_scalars),
             grid=(B * H, nk, max_nnz_t),
             in_specs=[
                 pl.BlockSpec((1, block, D), q_via_lut_t),
@@ -466,39 +532,48 @@ def _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t, nnz_t, block,
             jax.ShapeDtypeStruct(vh.shape, in_dtype),
         ],
         interpret=interpret,
-    )(lut_t_flat, nnz_t_flat, qh, kh, vh, gh, lse, delta)
+    )(*dkv_scalars, qh, kh, vh, gh, lse, delta)
 
     return _from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H)
 
 
 @functools.lru_cache(maxsize=64)
 def _make_sparse_fn(layout_bytes, layout_shape, block, causal, sm_scale,
-                    interpret):
+                    interpret, dropout_rate=0.0):
     """Build (and cache) a differentiable block-sparse attention closure for
     one static layout. Both directions run the Pallas kernels: the
     backward walks the forward LUT for dQ and a transposed LUT for
-    dK/dV."""
+    dK/dV. The closure takes a ``seed`` arg (None when dropout_rate is 0);
+    masks regenerate in-kernel in both directions."""
     lut, nnz = _build_lut_cached(layout_bytes, layout_shape)
     layout = np.frombuffer(layout_bytes,
                            dtype=np.int64).reshape(layout_shape)
     lut_t, nnz_t = build_lut(layout.transpose(0, 2, 1))
 
     @jax.custom_vjp
-    def f(q, k, v):
+    def f(q, k, v, seed):
         out, _ = _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
-                              interpret=interpret)
+                              interpret=interpret,
+                              dropout_rate=dropout_rate, dropout_seed=seed)
         return out
 
-    def f_fwd(q, k, v):
+    def f_fwd(q, k, v, seed):
         out, lse = _pallas_impl(q, k, v, lut, nnz, block, causal, sm_scale,
-                                interpret=interpret)
-        return out, (q, k, v, out, lse)
+                                interpret=interpret,
+                                dropout_rate=dropout_rate,
+                                dropout_seed=seed)
+        return out, (q, k, v, seed, out, lse)
 
     def f_bwd(res, g):
-        q, k, v, out, lse = res
-        return _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz, lut_t,
-                                nnz_t, block, causal, sm_scale,
-                                interpret=interpret)
+        q, k, v, seed, out, lse = res
+        dq, dk, dv = _pallas_bwd_impl(q, k, v, out, lse, g, lut, nnz,
+                                      lut_t, nnz_t, block, causal,
+                                      sm_scale, interpret=interpret,
+                                      dropout_rate=dropout_rate,
+                                      dropout_seed=seed)
+        dseed = (None if seed is None
+                 else np.zeros(jnp.shape(seed), jax.dtypes.float0))
+        return dq, dk, dv, dseed
 
     f.defvjp(f_fwd, f_bwd)
     return f, lut, nnz
@@ -508,13 +583,19 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
                            sm_scale=None, rpe=None, key_padding_mask=None,
                            attn_mask=None, key_padding_mask_mode="add",
                            attn_mask_mode="mul", implementation="auto",
-                           interpret=False):
+                           interpret=False,
+                           dropout_rate=0.0, dropout_seed=None):
     """Fused block-sparse attention.
 
     q,k,v: [B, T, H, D]; layout: [H, T//block, T//block] 0/1 (numpy,
     static — from ``SparsityConfig.make_layout``). rpe: [B, H, T, T];
     key_padding_mask: [B, T]; attn_mask: [T, T] (mask semantics per the
     reference softmax op, `softmax.py:219`).
+
+    ``dropout_rate`` (static) / ``dropout_seed`` (int32 scalar, traced
+    ok): in-kernel attention-prob dropout with the same counter-based
+    mask as the flash kernels (ops/pallas/flash_attention.py) — identical
+    bits on every implementation at the same (head, q, k) coordinates.
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -524,6 +605,12 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
         f"layout heads {layout.shape[0]} != tensor heads {q.shape[2]}")
     assert layout.shape[1] * block == T, (
         f"layout covers {layout.shape[1] * block} positions, seq len is {T}")
+    if dropout_rate:
+        if not 0.0 <= dropout_rate < 1.0:
+            raise ValueError(f"dropout_rate {dropout_rate} not in [0, 1)")
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        dropout_seed = jnp.asarray(dropout_seed, jnp.int32)
 
     has_extras = (rpe is not None or key_padding_mask is not None or
                   attn_mask is not None)
@@ -535,22 +622,26 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
         assert not has_extras, (
             "rpe/masks route through implementation='xla'")
         fn, _, _ = _make_sparse_fn(layout.tobytes(), layout.shape, block,
-                                   causal, float(sm_scale), interpret)
-        return fn(q, k, v)
+                                   causal, float(sm_scale), interpret,
+                                   float(dropout_rate))
+        return fn(q, k, v, dropout_seed)
     if implementation == "xla":
         lut, nnz = _build_lut_cached(layout.tobytes(), layout.shape)
         return _xla_impl(q, k, v, lut, nnz, block, causal, sm_scale,
                          rpe=rpe, key_padding_mask=key_padding_mask,
                          attn_mask=attn_mask,
                          key_padding_mask_mode=key_padding_mask_mode,
-                         attn_mask_mode=attn_mask_mode)
+                         attn_mask_mode=attn_mask_mode,
+                         dropout_rate=dropout_rate,
+                         dropout_seed=dropout_seed)
     raise ValueError(f"unknown implementation {implementation!r}")
 
 
 def masked_dense_attention(q, k, v, layout, block, causal=False,
                            sm_scale=None, rpe=None, key_padding_mask=None,
                            attn_mask=None, key_padding_mask_mode="add",
-                           attn_mask_mode="mul"):
+                           attn_mask_mode="mul",
+                           dropout_rate=0.0, dropout_seed=None):
     """Dense attention with the layout applied as an elementwise mask — the
     parity oracle for the sparse kernels (plays the role the dense-BERT
     fixture plays for the reference's `test_sparse_attention.py`)."""
@@ -579,5 +670,10 @@ def masked_dense_attention(q, k, v, layout, block, causal=False,
         mask = mask & tri[None, None]
     scores = jnp.where(mask, scores, DEFAULT_MASK_VALUE)
     probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0:
+        from deepspeed_tpu.ops.pallas.flash_attention import (
+            _dropout_multiplier_full)
+        probs = probs * _dropout_multiplier_full(B, H, T, T, dropout_rate,
+                                                 dropout_seed)
     return jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32)) \
         .astype(q.dtype)

@@ -58,8 +58,11 @@ class SparseSelfAttention:
         return self._layouts[seq_len]
 
     def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
-                 attn_mask=None):
-        """query/key/value: [B, H, T, D] → attention context [B, H, T, D]."""
+                 attn_mask=None, dropout_rate=0.0, dropout_seed=None):
+        """query/key/value: [B, H, T, D] → attention context [B, H, T, D].
+        ``dropout_rate``/``dropout_seed``: in-kernel attention-prob
+        dropout (shared counter-based mask — see
+        ops/pallas/flash_attention.py)."""
         bsz, num_heads, tgt_len, head_dim = query.shape
         if query.shape != key.shape or key.shape != value.shape:
             raise NotImplementedError(
@@ -84,5 +87,7 @@ class SparseSelfAttention:
             attn_mask=attn_mask,
             key_padding_mask_mode=self.key_padding_mask_mode,
             attn_mask_mode=self.attn_mask_mode,
-            implementation=self.implementation)
+            implementation=self.implementation,
+            dropout_rate=dropout_rate,
+            dropout_seed=dropout_seed)
         return jnp.swapaxes(out, 1, 2)

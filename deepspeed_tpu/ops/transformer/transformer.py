@@ -172,6 +172,17 @@ class DeepSpeedTransformerLayer(nn.Module):
 
         x = hidden_states.astype(dtype)
 
+        def attn_drop_args():
+            """(rate, seed) for the in-kernel attention-prob dropout of
+            the fused cores — one derivation for every branch so the
+            sparse and flash paths consume the identical rng stream."""
+            if deterministic or cfg.attn_dropout_ratio == 0.0:
+                return 0.0, None
+            from deepspeed_tpu.ops.pallas.flash_attention import (
+                dropout_seed_from_rng)
+            return (cfg.attn_dropout_ratio,
+                    dropout_seed_from_rng(self.make_rng("dropout")))
+
         # ---- attention sub-block ------------------------------------
         def attention(xin):
             qkv = xin @ attn_qkvw.astype(dtype) + attn_qkvb.astype(dtype)
@@ -190,10 +201,15 @@ class DeepSpeedTransformerLayer(nn.Module):
                 kpm = None
                 if attention_mask is not None:
                     kpm = collapse_additive_mask(attention_mask, B, T)
+                # in-kernel attn-prob dropout (round 4; the sparse core
+                # previously skipped it silently)
+                rate, seed = attn_drop_args()
                 ctx = core(q.transpose(0, 2, 1, 3),
                            k.transpose(0, 2, 1, 3),
                            v.transpose(0, 2, 1, 3),
-                           key_padding_mask=kpm).transpose(0, 2, 1, 3)
+                           key_padding_mask=kpm,
+                           dropout_rate=rate,
+                           dropout_seed=seed).transpose(0, 2, 1, 3)
             elif self.use_flash_attention and (
                     attention_mask is None or
                     _is_key_padding_shape(attention_mask.shape, B, T)):
@@ -211,12 +227,7 @@ class DeepSpeedTransformerLayer(nn.Module):
                 kbias = None
                 if attention_mask is not None:
                     kbias = collapse_additive_mask(attention_mask, B, T)
-                rate, seed = 0.0, None
-                if not deterministic and cfg.attn_dropout_ratio > 0.0:
-                    rate = cfg.attn_dropout_ratio
-                    seed = jax.lax.bitcast_convert_type(
-                        jax.random.bits(self.make_rng("dropout"), (),
-                                        jnp.uint32), jnp.int32)
+                rate, seed = attn_drop_args()
                 ctx = flash_attention(q, k, v, causal=False,
                                       key_bias=kbias,
                                       dropout_rate=rate,

@@ -328,3 +328,49 @@ def test_native_lut_matches_numpy():
     lut_np, nnz_np = _build_lut_numpy(layout)
     np.testing.assert_array_equal(nnz_c, nnz_np)
     np.testing.assert_array_equal(lut_c, lut_np)
+
+
+# --- in-kernel attention-prob dropout (round 4) ---------------------------
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_sparse_dropout_matches_masked_dense_same_seed(impl, causal):
+    """Block-sparse dropout uses the flash kernels' counter-based hash at
+    the same global (head, q, k) coordinates, so same seed ⇒ sparse ==
+    masked-dense-with-the-same-mask — forward AND gradients."""
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              num_global_blocks=1)
+    q, k, v = qkv(T=64, H=2, D=8)
+    layout = cfg.make_layout(64)
+    seed = jnp.int32(99)
+
+    def loss(fn, **kw):
+        def f(q, k, v):
+            return jnp.sum(fn(q, k, v, layout, cfg.block, causal=causal,
+                              dropout_rate=0.25, dropout_seed=seed,
+                              **kw) ** 2)
+        return f
+
+    kw = {"implementation": impl}
+    if impl == "pallas":
+        kw["interpret"] = True
+    vd, gd = jax.value_and_grad(loss(masked_dense_attention),
+                                argnums=(0, 1, 2))(q, k, v)
+    vi, gi = jax.value_and_grad(loss(block_sparse_attention, **kw),
+                                argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(vi), float(vd), rtol=1e-4)
+    for a, b in zip(gi, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_dropout_seed_changes_output():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2)
+    q, k, v = qkv(T=32, H=2, D=8)
+    layout = cfg.make_layout(32)
+    o1 = block_sparse_attention(q, k, v, layout, cfg.block,
+                                implementation="xla",
+                                dropout_rate=0.3, dropout_seed=jnp.int32(1))
+    o2 = block_sparse_attention(q, k, v, layout, cfg.block,
+                                implementation="xla",
+                                dropout_rate=0.3, dropout_seed=jnp.int32(2))
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
