@@ -177,3 +177,78 @@ def test_pipeline_onebit_curve_converges():
     curve = [float(engine.train_batch(batch)) for _ in range(60)]
     assert np.isfinite(curve).all()
     assert curve[-1] < 0.6 * curve[0], curve[::10]
+
+
+# --- round 4: reference-matrix combos not yet covered ---------------------
+# (Megatron_GPT2 run_func_test.py crosses mp x zero x gas x offload;
+# the rows below add the mp x zero, zero x gas and offload x gas cells.)
+@pytest.mark.parametrize("stage", [1, 2])
+def test_tp_zero_curve_matches_stage0(bf16_curve, stage):
+    """mp2 x zero{1,2} (reference test_mp2_gpu4_node1_zero{1,2}): tensor
+    parallelism and ZeRO sharding compose without changing numerics."""
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    c, engine = gpt2_train_curve(
+        base_gpt2_config(bf16={"enabled": True},
+                         zero_optimization={"stage": stage}),
+        mesh=build_mesh({"model": 2, "data": 4}), param_specs=True)
+    assert engine.zero_optimization_stage() == stage
+    assert_curves_close(bf16_curve, c, rtol=2e-2, name=f"tp2-zero{stage}")
+
+
+def test_zero2_gas_curve_matches_flat():
+    """zero2 x gradient accumulation (reference
+    test_mp2_gpu4_node1_zero2_gas / ds_config_func_bs8_zero2_gas3)."""
+    flat, _ = gpt2_train_curve(base_gpt2_config(
+        train_batch_size=16, bf16={"enabled": True},
+        zero_optimization={"stage": 2}))
+    c, _ = gpt2_train_curve(base_gpt2_config(
+        train_batch_size=16, gradient_accumulation_steps=2,
+        bf16={"enabled": True}, zero_optimization={"stage": 2}))
+    assert_curves_close(flat, c, rtol=3e-2, name="zero2-gas2")
+
+
+def test_offload_gas_curve_matches_flat():
+    """offload x gradient accumulation (reference
+    test_mp1_gpu2_node1_zero2_ds_offload runs gas variants)."""
+    flat, _ = gpt2_train_curve(base_gpt2_config(
+        train_batch_size=16, bf16={"enabled": True},
+        zero_optimization={"stage": 2, "cpu_offload": True}))
+    c, _ = gpt2_train_curve(base_gpt2_config(
+        train_batch_size=16, gradient_accumulation_steps=2,
+        bf16={"enabled": True},
+        zero_optimization={"stage": 2, "cpu_offload": True}))
+    assert_curves_close(flat, c, rtol=5e-2, name="offload-gas2")
+
+
+def test_lamb_curve_converges():
+    """LAMB at model level (the reference's BERT-pretraining optimizer,
+    `ds_train_bert_bsz64k_seq128.sh`): converges on the memorization
+    task like Adam does."""
+    c, _ = gpt2_train_curve(base_gpt2_config(
+        optimizer={"type": "Lamb", "params": {"lr": 2e-2}}))
+    assert np.isfinite(c).all()
+    assert c[-1] < 0.5 * c[0], (c[0], c[-1])
+
+
+def test_scheduler_drives_lr_through_training():
+    """Optimizer-scheduler func test (reference test_optimizer_scheduler):
+    the configured WarmupLR actually moves the lr the engine applies."""
+    config = base_gpt2_config(scheduler={
+        "type": "WarmupLR",
+        "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-3,
+                   "warmup_num_steps": 50}})
+    c, engine = gpt2_train_curve(config, steps=60)
+    assert np.isfinite(c).all()
+    # the ENGINE advanced the scheduler every optimizer step (not just
+    # that lr_at's pure math is right — that's unit-tested)
+    assert engine.lr_scheduler.last_batch_iteration == 59, \
+        engine.lr_scheduler.last_batch_iteration   # 0-indexed, 60 steps
+    lr_mid = engine.lr_scheduler.lr_at(25)
+    lr_end = engine.lr_scheduler.lr_at(55)
+    assert 0.0 < lr_mid < 1e-3, lr_mid
+    assert abs(lr_end - 1e-3) < 1e-9, lr_end
+    # warmup actually shaped training: a constant-lr run diverges from
+    # the warmed-up curve well beyond reduction noise
+    const, _ = gpt2_train_curve(base_gpt2_config(), steps=60)
+    assert max(abs(a - b) / max(abs(a), abs(b))
+               for a, b in zip(c, const)) > 1e-3, "scheduler had no effect"
