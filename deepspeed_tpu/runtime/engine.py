@@ -437,15 +437,16 @@ class DeepSpeedEngine:
                 world = self.dp_world_size
                 if getattr(self.loss_fn, "direct_value_and_grad_local",
                            None) is not None:
-                    # pipeline composition needs [stages, world, padded]
-                    # error buffers (per-stage collective groups); route
-                    # through the pipeline-aware init, not the wrapper's
-                    # DP-shaped one.
+                    # pipeline composition needs [stages[, model], world,
+                    # padded] error buffers (per-(stage[, model-rank])
+                    # collective groups); route through the pipeline-aware
+                    # init, not the wrapper's DP-shaped one.
                     from deepspeed_tpu.runtime.fp16.onebit_adam import (
                         init_pipeline_onebit_state)
                     stages = self.mesh.shape["pipe"]
+                    msize = self.mesh.shape.get("model", 1)
                     self.opt_init_fn = lambda p: init_pipeline_onebit_state(
-                        p, world, stages)
+                        p, world, stages, msize)
                 else:
                     self.opt_init_fn = lambda p: client_optimizer.init(
                         p, world=world)
@@ -484,10 +485,12 @@ class DeepSpeedEngine:
             if getattr(self.loss_fn, "direct_value_and_grad_local",
                        None) is not None:
                 # pipeline x 1-bit composition: error buffers per
-                # (stage, data-rank) over the stage-local flat size
+                # (stage[, model-rank], data-rank) over the device-local
+                # flat size
                 stages = self.mesh.shape["pipe"]
+                msize = self.mesh.shape.get("model", 1)
                 self.opt_init_fn = lambda p: init_pipeline_onebit_state(
-                    p, world, stages)
+                    p, world, stages, msize)
             else:
                 self.opt_init_fn = lambda p: init_onebit_state(p, world)
             self._opt_update = lambda p, g, s, lr_, beta1: onebit_adam_update(
@@ -1124,9 +1127,9 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdamState
 
         for ax, size in self.mesh.shape.items():
-            assert ax in ("data", "pipe") or size == 1, (
-                f"pipeline OneBitAdam supports pipe x data meshes; axis "
-                f"{ax!r} has size {size}")
+            assert ax in ("data", "pipe", "model") or size == 1, (
+                f"pipeline OneBitAdam supports pipe x model x data meshes; "
+                f"axis {ax!r} has size {size}")
         direct_local = self.loss_fn.direct_value_and_grad_local
         fp16 = self._config.fp16_enabled
         clip = float(self._config.gradient_clipping or 0.0)
@@ -1137,24 +1140,55 @@ class DeepSpeedEngine:
         dynamic = self.dynamic_loss_scale
         static_scale = self.static_loss_scale
         mesh = self.mesh
+        model_size = mesh.shape.get("model", 1)
         tree_map = jax.tree_util.tree_map
 
         P = PartitionSpec
         param_specs = tree_map(lambda ns: ns.spec, self._shardings["param"])
         grad_specs = tree_map(lambda sp: P("data", *tuple(sp)), param_specs)
-        err_spec = P("pipe", "data", None)
+        err_spec = (P("pipe", "model", "data", None) if model_size > 1
+                    else P("pipe", "data", None))
 
         from deepspeed_tpu.runtime.fp16.onebit_adam import (
             pipeline_onebit_splits)
-        (pb, cb), (pr, cr) = pipeline_onebit_splits(
-            self.params, self.dp_world_size, mesh.shape["pipe"])
+        splits = pipeline_onebit_splits(
+            self.params, self.dp_world_size, mesh.shape["pipe"], model_size)
+        if model_size > 1:
+            (pm, cm), (pb, cb), (pr, cr) = splits
+            # static mask: which body leaves are model-sharded (mp_*) —
+            # they compress separately from the model-replicated leaves
+            # so replicated copies see the same quantization scale on
+            # every model rank. Shared source of truth with the buffer
+            # sizing (onebit_adam.pipeline_mp_mask).
+            from deepspeed_tpu.runtime.fp16.onebit_adam import (
+                pipeline_mp_mask)
+            mp_mask = pipeline_mp_mask(self.params, model_size)
+        else:
+            (pb, cb), (pr, cr) = splits
+            pm = cm = 0
+            mp_mask = None
+
+        def split_body(tree):
+            """Local body tree → (mp leaves, replicated leaves) as list
+            pytrees, in tree_leaves order."""
+            leaves = jax.tree_util.tree_leaves(tree)
+            mp = [x for x, is_mp in zip(leaves, mp_mask) if is_mp]
+            rep = [x for x, is_mp in zip(leaves, mp_mask) if not is_mp]
+            return mp, rep
+
+        def merge_body(mp, rep, template):
+            mp_it, rep_it = iter(mp), iter(rep)
+            leaves = [next(mp_it) if is_mp else next(rep_it)
+                      for is_mp in mp_mask]
+            treedef = jax.tree_util.tree_structure(template)
+            return jax.tree_util.tree_unflatten(treedef, leaves)
 
         def upd(p_l, g_l, m_l, v_l, we_l, se_l, step, lr_, b1, ovf):
-            # Body (this stage's shard) and rest (pipe-replicated
-            # prologue/epilogue/tied) run SEPARATE compressed collectives:
-            # a joint flat buffer would give each stage group a different
-            # quantization scale for the shared rest entries and silently
-            # diverge the tied embeddings across stages.
+            # Groups that share content compress SEPARATE buffers (a
+            # joint one couples the quantization scale and silently
+            # diverges the shared copies): body vs pipe-replicated rest;
+            # under 3D also model-sharded mp leaves vs model-replicated
+            # body leaves.
             body_p = {"body": tree_map(lambda a: a[0], p_l["body"])}
             body_g = {"body": tree_map(lambda a: a[0, 0], g_l["body"])}
             body_m = {"body": tree_map(lambda a: a[0], m_l["body"])}
@@ -1166,38 +1200,80 @@ class DeepSpeedEngine:
             rest_m = {k: m_l[k] for k in rest_keys}
             rest_v = {k: v_l[k] for k in rest_keys}
 
-            we = we_l[0]                       # [1, pb + pr]
-            se = se_l[0, 0]                    # [cb + cr]
-            st_body = OnebitAdamState(m=body_m, v=body_v, step=step,
-                                      worker_error=we[:, :pb],
-                                      server_error=se[:cb])
-            st_rest = OnebitAdamState(m=rest_m, v=rest_v, step=step,
-                                      worker_error=we[:, pb:],
-                                      server_error=se[cb:])
-            new_bp, new_bst = opt_update(body_p, body_g, st_body, lr_, b1)
-            new_rp, new_rst = opt_update(rest_p, rest_g, st_rest, lr_, b1)
+            we = we_l[0, 0] if model_size > 1 else we_l[0]   # [1, P]
+            se = se_l[0, 0, 0] if model_size > 1 else se_l[0, 0]  # [C]
+
+            def slice_state(m_t, v_t, w_lo, w_hi, c_lo, c_hi):
+                return OnebitAdamState(
+                    m=m_t, v=v_t, step=step,
+                    worker_error=we[:, w_lo:w_hi],
+                    server_error=se[c_lo:c_hi])
+
+            groups = []      # (params, grads, state) per compressed group
+            if model_size > 1:
+                mp_p, rep_p = split_body(body_p)
+                mp_g, rep_g = split_body(body_g)
+                mp_m, rep_m = split_body(body_m)
+                mp_v, rep_v = split_body(body_v)
+                groups.append((mp_p, mp_g,
+                               slice_state(mp_m, mp_v, 0, pm, 0, cm)))
+                groups.append((rep_p, rep_g,
+                               slice_state(rep_m, rep_v,
+                                           pm, pm + pb, cm, cm + cb)))
+            else:
+                groups.append((body_p, body_g,
+                               slice_state(body_m, body_v, 0, pb, 0, cb)))
+            groups.append((rest_p, rest_g,
+                           slice_state(rest_m, rest_v,
+                                       pm + pb, pm + pb + pr,
+                                       cm + cb, cm + cb + cr)))
+
+            results = [opt_update(p, g, st, lr_, b1)
+                       for p, g, st in groups]
 
             def sel(old, new):
                 return tree_map(lambda o, n: jnp.where(ovf, o, n), old, new)
-            new_p = dict(sel(rest_p, new_rp),
-                         body=sel(body_p, new_bp)["body"])
-            new_m = dict(sel(rest_m, new_rst.m),
-                         body=sel(body_m, new_bst.m)["body"])
-            new_v = dict(sel(rest_v, new_rst.v),
-                         body=sel(body_v, new_bst.v)["body"])
+
+            new_rp, new_rst = results[-1]
+            if model_size > 1:
+                (mp_np, mp_nst), (rep_np, rep_nst) = results[0], results[1]
+                new_body_p = merge_body(sel(groups[0][0], mp_np),
+                                        sel(groups[1][0], rep_np),
+                                        body_p)["body"]
+                new_body_m = merge_body(sel(groups[0][2].m, mp_nst.m),
+                                        sel(groups[1][2].m, rep_nst.m),
+                                        body_p)["body"]
+                new_body_v = merge_body(sel(groups[0][2].v, mp_nst.v),
+                                        sel(groups[1][2].v, rep_nst.v),
+                                        body_p)["body"]
+                body_states = [mp_nst, rep_nst]
+            else:
+                new_bp, new_bst = results[0]
+                new_body_p = sel(body_p, new_bp)["body"]
+                new_body_m = sel(body_m, new_bst.m)["body"]
+                new_body_v = sel(body_v, new_bst.v)["body"]
+                body_states = [new_bst]
+            new_p = dict(sel(rest_p, new_rp), body=new_body_p)
+            new_m = dict(sel(rest_m, new_rst.m), body=new_body_m)
+            new_v = dict(sel(rest_v, new_rst.v), body=new_body_v)
             new_we = jnp.where(
                 ovf, we, jnp.concatenate(
-                    [new_bst.worker_error, new_rst.worker_error], axis=-1))
+                    [st.worker_error for st in body_states]
+                    + [new_rst.worker_error], axis=-1))
             new_se = jnp.where(
                 ovf, se, jnp.concatenate(
-                    [new_bst.server_error, new_rst.server_error], axis=-1))
-            new_step = jnp.where(ovf, step, new_bst.step)
+                    [st.server_error for st in body_states]
+                    + [new_rst.server_error], axis=-1))
+            new_step = jnp.where(ovf, step, new_rst.step)
 
             def restore_body(t):
                 return dict(t, body=tree_map(lambda a: a[None], t["body"]))
+            if model_size > 1:
+                we_out, se_out = new_we[None, None], new_se[None, None, None]
+            else:
+                we_out, se_out = new_we[None], new_se[None, None]
             return (restore_body(new_p), restore_body(new_m),
-                    restore_body(new_v), new_we[None], new_se[None, None],
-                    new_step)
+                    restore_body(new_v), we_out, se_out, new_step)
 
         mapped_upd = jax.shard_map(
             upd, mesh=mesh,

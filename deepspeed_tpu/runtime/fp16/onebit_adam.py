@@ -57,50 +57,95 @@ def init_onebit_state(params, world: int) -> OnebitAdamState:
     )
 
 
-def init_pipeline_onebit_state(params, world: int,
-                               num_stages: int) -> OnebitAdamState:
+def pipeline_mp_mask(params, model):
+    """Per-leaf bools in ``tree_leaves(params['body'])`` order: True for
+    model-sharded ``mp_*`` leaves. The single source of truth for the 3D
+    1-bit layout — both the error-buffer sizing here and the engine's
+    group split (`engine.py:_make_pipeline_onebit_train_step`) consume
+    it, so the slice offsets cannot drift from the group sizes."""
+    from deepspeed_tpu.runtime.pipe.pipeline import _is_mp_leaf
+    return [model > 1 and _is_mp_leaf(path, leaf)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                params["body"])[0]]
+
+
+def _pipeline_local_sizes(params, num_stages, model=1):
+    """(mp_local, rep_local, rest_n): flat element counts as seen by ONE
+    (stage, model-rank) device — ``mp_*`` body leaves divide their shard
+    dim over ``model``, every other body leaf is model-replicated."""
+    mask = pipeline_mp_mask(params, model)
+    mp_n = rep_n = 0
+    for (path, leaf), is_mp in zip(
+            jax.tree_util.tree_flatten_with_path(params["body"])[0], mask):
+        if is_mp:
+            assert leaf.shape[2] % model == 0, (path, leaf.shape, model)
+            mp_n += int(leaf.size) // model
+        else:
+            rep_n += int(leaf.size)
+    rest_n = sum(int(p.size) for k in ("prologue", "epilogue", "tied")
+                 for p in jax.tree_util.tree_leaves(params[k]))
+    assert mp_n % num_stages == 0 and rep_n % num_stages == 0, (
+        mp_n, rep_n, num_stages)
+    return mp_n // num_stages, rep_n // num_stages, rest_n
+
+
+def init_pipeline_onebit_state(params, world: int, num_stages: int,
+                               model: int = 1) -> OnebitAdamState:
     """State for the pipeline x 1-bit composition
     (`engine.py:_make_pipeline_onebit_train_step`): m/v mirror the
     (stacked, pipe-sharded) params; error-feedback buffers are per
-    (stage, data-rank) over the stage-LOCAL flat parameter count — every
-    (pipe, data) device runs its own compressed collective over ``data``
-    within its stage group, so residuals live where the shards live.
+    (stage[, model-rank], data-rank) over the device-LOCAL flat parameter
+    count — every device runs its own compressed collective over ``data``
+    within its (stage, model) group, so residuals live where the shards
+    live.
 
     ``params`` is the pipeline tree {prologue, body, epilogue, tied} with
     the body stacked [S, L/S, ...]. Homogeneous stages ⇒ one local size.
+
+    Groups that share content must compress IDENTICAL buffers or their
+    copies silently diverge (the quantization scale is the whole-buffer
+    L2, compressed.py:_compress):
+    - body vs pipe-replicated rest → separate buffers (round 3);
+    - with a ``model`` axis (3D, round 4), model-sharded ``mp_*`` leaves
+      vs model-replicated body leaves → a third split, so the replicated
+      leaves see the same scale on every model rank. Buffers concatenate
+      [mp | body_rep | rest] along the last dim; worker/server errors get
+      a model dim: [S, M, world, ...].
     """
-    body_n = sum(int(p.size)
-                 for p in jax.tree_util.tree_leaves(params["body"]))
-    rest_n = sum(int(p.size) for k in ("prologue", "epilogue", "tied")
-                 for p in jax.tree_util.tree_leaves(params[k]))
-    assert body_n % num_stages == 0, (body_n, num_stages)
-    # Body (stage-local) and rest (pipe-replicated) compress as SEPARATE
-    # buffers: one joint buffer would give every stage group a different
-    # quantization scale for the shared rest entries (the scale is the
-    # whole-buffer L2, compressed.py:_compress) and silently diverge the
-    # tied embeddings across stages. The error buffers concatenate
-    # [body | rest] along the last dim.
-    pb, cb = error_feedback_sizes(body_n // num_stages, world)
-    pr, cr = error_feedback_sizes(max(rest_n, 8), world)
+    mp_n, rep_n, rest_n = _pipeline_local_sizes(params, num_stages, model)
     zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+    m = jax.tree_util.tree_map(zeros, params)
+    v = jax.tree_util.tree_map(zeros, params)
+    pr, cr = error_feedback_sizes(max(rest_n, 8), world)
+    if model > 1:
+        pm, cm = error_feedback_sizes(max(mp_n, 8), world)
+        pb, cb = error_feedback_sizes(max(rep_n, 8), world)
+        return OnebitAdamState(
+            m=m, v=v, step=jnp.asarray(0, jnp.int32),
+            worker_error=jnp.zeros((num_stages, model, world, pm + pb + pr),
+                                   jnp.float32),
+            server_error=jnp.zeros((num_stages, model, world, cm + cb + cr),
+                                   jnp.float32),
+        )
+    pb, cb = error_feedback_sizes(mp_n + rep_n, world)
     return OnebitAdamState(
-        m=jax.tree_util.tree_map(zeros, params),
-        v=jax.tree_util.tree_map(zeros, params),
-        step=jnp.asarray(0, jnp.int32),
+        m=m, v=v, step=jnp.asarray(0, jnp.int32),
         worker_error=jnp.zeros((num_stages, world, pb + pr), jnp.float32),
         server_error=jnp.zeros((num_stages, world, cb + cr), jnp.float32),
     )
 
 
-def pipeline_onebit_splits(params, world, num_stages):
-    """((padded_body, chunk_body), (padded_rest, chunk_rest)) — the
-    concatenation layout of the pipeline state's error buffers."""
-    body_n = sum(int(p.size)
-                 for p in jax.tree_util.tree_leaves(params["body"]))
-    rest_n = sum(int(p.size) for k in ("prologue", "epilogue", "tied")
-                 for p in jax.tree_util.tree_leaves(params[k]))
-    return (error_feedback_sizes(body_n // num_stages, world),
-            error_feedback_sizes(max(rest_n, 8), world))
+def pipeline_onebit_splits(params, world, num_stages, model=1):
+    """The concatenation layout of the pipeline state's error buffers:
+    ``model == 1`` → ((padded_body, chunk_body), (padded_rest,
+    chunk_rest)); ``model > 1`` → ((padded_mp, chunk_mp), (padded_rep,
+    chunk_rep), (padded_rest, chunk_rest))."""
+    mp_n, rep_n, rest_n = _pipeline_local_sizes(params, num_stages, model)
+    rest = error_feedback_sizes(max(rest_n, 8), world)
+    if model > 1:
+        return (error_feedback_sizes(max(mp_n, 8), world),
+                error_feedback_sizes(max(rep_n, 8), world), rest)
+    return error_feedback_sizes(mp_n + rep_n, world), rest
 
 
 def onebit_adam_update(params,

@@ -112,3 +112,86 @@ def test_pipeline_onebit_rest_params_stay_pipe_consistent():
             np.testing.assert_array_equal(
                 sh, shards[0],
                 err_msg=f"pipe-divergent replicated leaf {path}")
+
+
+# --- round 4: pipe x model x data (3D) composition ------------------------
+def _train_3d(opt_cfg, steps=6, model=2):
+    import deepspeed_tpu
+    from tests.pipeline_fixtures import tiny_tp_pipeline_module
+
+    mesh = build_mesh({"pipe": 2, "model": model, "data": 8 // (2 * model)},
+                      devices=jax.devices()[:8])
+    module = tiny_tp_pipeline_module(vocab=32, d_model=8, n_head=4, seq=SEQ,
+                                     ids_key="input_ids", num_stages=None)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": ROWS,
+                "gradient_accumulation_steps": MICRO,
+                "optimizer": opt_cfg,
+                "steps_per_print": 1000},
+        model=module, mesh=mesh)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 32, (ROWS, SEQ)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(steps)]
+    return losses, engine
+
+
+@pytest.mark.slow
+def test_pipeline_onebit_3d_warmup_matches_plain_adam():
+    """pipe x model x data: during warmup the 3D 1-bit step must follow
+    plain Adam through the same 3D pipeline (round 4 — the round-3 step
+    asserted out on any mesh with a model axis)."""
+    onebit, e1 = _train_3d({"type": "OneBitAdam",
+                            "params": {"lr": 1e-3, "freeze_step": 1000}})
+    adam, _ = _train_3d({"type": "Adam",
+                         "params": {"lr": 1e-3, "bias_correction": False}})
+    np.testing.assert_allclose(onebit, adam, rtol=2e-4)
+    # [stages, model, data_world, padded] error buffers
+    assert e1.opt_state.worker_error.shape[:3] == (2, 2, 2)
+
+
+@pytest.mark.slow
+def test_pipeline_onebit_3d_compression_stage_trains():
+    """Longer warmup + smaller lr than the 2D variant: the d_model=8 toy
+    has strongly heterogeneous per-leaf gradient scales, and 1-bit's
+    frozen-variance + single-buffer-scale compression amplifies that —
+    with freeze_step=2 it diverges even on the OLD 2D (pipe x data) path,
+    so instability there is a property of the toy, not of the 3D
+    composition."""
+    losses, engine = _train_3d({"type": "OneBitAdam",
+                                "params": {"lr": 5e-4, "freeze_step": 8}},
+                               steps=14)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    assert int(engine.opt_state.step) == 14
+    assert float(jnp.abs(engine.opt_state.worker_error).sum()) > 0
+
+
+@pytest.mark.slow
+def test_pipeline_onebit_3d_replicated_leaves_stay_model_consistent():
+    """Model-replicated body leaves (ln scales, row-parallel biases) must
+    compress with the SAME quantization scale on every model rank — the
+    three-way buffer split exists exactly so their copies cannot drift.
+    Checked on raw per-device shards (a replicated out-spec with
+    check_vma=False would mask logical divergence)."""
+    _, engine = _train_3d({"type": "OneBitAdam",
+                           "params": {"lr": 5e-4, "freeze_step": 8}},
+                          steps=12)
+    import jax.tree_util as jtu
+    from deepspeed_tpu.runtime.pipe.pipeline import _is_mp_leaf
+    for path, leaf in jtu.tree_flatten_with_path(
+            engine.params["body"])[0]:
+        if _is_mp_leaf(path, leaf):
+            continue                      # model-sharded: shards differ
+        # replicated body leaf: every device in the same pipe row must
+        # hold identical bytes across the model axis. Group shards by
+        # their pipe coordinate (dim 0 index of the [S, ...] stack).
+        by_stage = {}
+        for s in leaf.addressable_shards:
+            stage = s.index[0].start or 0
+            by_stage.setdefault(stage, []).append(np.asarray(s.data))
+        for stage, shards in by_stage.items():
+            for sh in shards[1:]:
+                np.testing.assert_array_equal(
+                    sh, shards[0],
+                    err_msg=f"model-divergent replicated leaf {path} "
+                            f"stage {stage}")
