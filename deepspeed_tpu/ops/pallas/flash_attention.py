@@ -68,11 +68,7 @@ def dropout_multiplier(seed, head, q_pos, k_pos, rate):
          + jnp.asarray(k_pos, jnp.int32) * jnp.int32(_FMIX_C2)
          + jnp.asarray(head, jnp.int32) * jnp.int32(_FMIX_C1)
          + jnp.asarray(seed, jnp.int32))
-    h = h ^ jax.lax.shift_right_logical(h, 16)
-    h = h * jnp.int32(_FMIX_C1)
-    h = h ^ jax.lax.shift_right_logical(h, 13)
-    h = h * jnp.int32(_FMIX_C2)
-    h = h ^ jax.lax.shift_right_logical(h, 16)
+    h = _fmix32(h)
     # Top 24 bits as a uniform value in [0, 2^24): unsigned comparison in
     # int32-safe range (both operands < 2^24).
     u24 = jax.lax.shift_right_logical(h, 8)
@@ -105,6 +101,28 @@ def dropout_seed_from_rng(rng):
     semantics everywhere)."""
     return jax.lax.bitcast_convert_type(
         jax.random.bits(rng, (), jnp.uint32), jnp.int32)
+
+
+def _fmix32(h):
+    """murmur3 finalizer: full avalanche over an int32."""
+    h = h ^ jax.lax.shift_right_logical(h, 16)
+    h = h * jnp.int32(_FMIX_C1)
+    h = h ^ jax.lax.shift_right_logical(h, 13)
+    h = h * jnp.int32(_FMIX_C2)
+    h = h ^ jax.lax.shift_right_logical(h, 16)
+    return h
+
+
+def fold_in_seed(seed, data):
+    """Mix ``data`` (a rank index, shard id, ...) into a dropout seed with
+    full avalanche. A LINEAR stride (seed + data * C) is not enough: if C
+    collides with one of :func:`dropout_multiplier`'s coordinate
+    multipliers, the "new" seed reproduces the old mask at shifted
+    coordinates (seed + r*GOLDEN ≡ the rank-0 mask at q_pos + r). The
+    avalanche destroys any affine relationship to the coordinate terms."""
+    h = jnp.asarray(seed, jnp.int32) ^ (
+        jnp.asarray(data, jnp.int32) * jnp.int32(0x7F4A7C15))
+    return _fmix32(h)
 
 
 def _dropout_multiplier_full(B, H, T, S, rate, seed):

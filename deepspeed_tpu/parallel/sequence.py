@@ -27,17 +27,42 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.ops.pallas.flash_attention import (
     DEFAULT_MASK_VALUE,
+    dropout_multiplier,
     flash_attention,
+    fold_in_seed,
 )
 
 
-def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
+def _check_dropout_args(dropout_rate, dropout_seed):
+    if dropout_rate:
+        if not 0.0 <= dropout_rate < 1.0:
+            raise ValueError(f"dropout_rate {dropout_rate} not in [0, 1)")
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+
+
+def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
+                         dropout_rate=0.0, dropout_seed=None,
+                         data_axis_name=None):
     """Ring attention over ``axis_name``; call inside ``shard_map``.
 
     q, k, v: [B, T_local, H, D] — this device's sequence shard. Returns the
     local [B, T_local, H, D] attention output, exactly equal to the
     corresponding slice of full attention over the global sequence.
+
+    ``dropout_rate``/``dropout_seed``: in-kernel attention-prob dropout
+    with the shared counter-based mask at GLOBAL sequence coordinates —
+    every seq rank derives the same bits for the same (b, h, q, k)
+    element, so the sharded result equals dense-with-the-same-mask. The
+    batch coordinate is the shard-local row index; pass
+    ``data_axis_name`` when a data axis is also bound so each data shard
+    mixes its rank into the seed (otherwise all data shards would reuse
+    one mask pattern across the batch).
     """
+    _check_dropout_args(dropout_rate, dropout_seed)
+    if dropout_rate and data_axis_name is not None:
+        dropout_seed = fold_in_seed(dropout_seed,
+                                    jax.lax.axis_index(data_axis_name))
     B, Tloc, H, D = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / (D ** 0.5)
@@ -47,6 +72,7 @@ def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
     qf = q.astype(jnp.float32) * sm_scale
     q_pos = idx * Tloc + jnp.arange(Tloc)            # global q positions
     perm = [(j, (j + 1) % n) for j in range(n)]
+    bh_idx = jnp.arange(B)[:, None] * H + jnp.arange(H)[None, :]  # [B, H]
 
     def compute_chunk(acc, m, l, kc, vc, src):
         k_pos = src * Tloc + jnp.arange(Tloc)
@@ -58,8 +84,14 @@ def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
         p = jnp.exp(s - m_new[..., None])
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
+        pd = p
+        if dropout_rate > 0.0:
+            pd = p * dropout_multiplier(
+                dropout_seed, bh_idx[:, :, None, None],
+                q_pos[None, None, :, None],
+                k_pos[None, None, None, :], dropout_rate)
         acc = acc * corr[..., None] + \
-            jnp.einsum("bhts,bshd->bhtd", p, vc.astype(jnp.float32))
+            jnp.einsum("bhts,bshd->bhtd", pd, vc.astype(jnp.float32))
         return acc, m_new, l_new
 
     def step(carry, t):
@@ -94,25 +126,41 @@ def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
 
 
 def ulysses_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
-                            attn_fn=None):
+                            attn_fn=None, dropout_rate=0.0,
+                            dropout_seed=None, data_axis_name=None):
     """Ulysses sequence parallelism; call inside ``shard_map``.
 
     q, k, v: [B, T_local, H, D] seq shards with H divisible by the axis
     size. all_to_all → [B, T, H/n, D], run ``attn_fn`` (default
     :func:`flash_attention`) on the full sequence, all_to_all back.
+
+    Dropout is delegated to ``attn_fn`` with this rank's axis index MIXED
+    into the seed (full avalanche, :func:`fold_in_seed` — a linear stride
+    would alias the hash's coordinate multipliers): each rank attends a
+    DIFFERENT head group but sees the same local head indices, so an
+    unfolded seed would repeat the identical mask pattern across head
+    groups (correlated dropout). ``data_axis_name``: as in
+    :func:`ring_attention_local`.
     """
+    _check_dropout_args(dropout_rate, dropout_seed)
     n = jax.lax.psum(1, axis_name)
     H = q.shape[2]
     assert H % n == 0, f"heads {H} must divide seq-parallel degree {n}"
     if attn_fn is None:
         attn_fn = flash_attention   # "auto": Pallas on TPU, XLA elsewhere
+    kwargs = {}
+    if dropout_rate > 0.0:
+        seed = fold_in_seed(dropout_seed, jax.lax.axis_index(axis_name))
+        if data_axis_name is not None:
+            seed = fold_in_seed(seed, jax.lax.axis_index(data_axis_name))
+        kwargs = {"dropout_rate": dropout_rate, "dropout_seed": seed}
 
     def scatter_heads(x):   # [B, Tloc, H, D] → [B, T, H/n, D]
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                                   tiled=True)
 
     out = attn_fn(scatter_heads(q), scatter_heads(k), scatter_heads(v),
-                  causal=causal, sm_scale=sm_scale)
+                  causal=causal, sm_scale=sm_scale, **kwargs)
     # [B, T, H/n, D] → [B, Tloc, H, D]
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
@@ -126,18 +174,29 @@ def _seq_sharded_call(local_fn, mesh, q, k, v, seq_axis, data_axis):
 
 
 def ring_attention(q, k, v, mesh, causal=True, sm_scale=None,
-                   seq_axis="seq", data_axis="data"):
+                   seq_axis="seq", data_axis="data",
+                   dropout_rate=0.0, dropout_seed=None):
     """Standalone ring attention: q,k,v [B, T, H, D] global arrays sharded
     [data, seq] over ``mesh``."""
+    # fold the data rank into the seed only when there IS data sharding —
+    # at data=1 the fold would be a pure (parity-breaking) seed rewrite
+    dax = data_axis if mesh.shape[data_axis] > 1 else None
     local = functools.partial(ring_attention_local, axis_name=seq_axis,
-                              causal=causal, sm_scale=sm_scale)
+                              causal=causal, sm_scale=sm_scale,
+                              dropout_rate=dropout_rate,
+                              dropout_seed=dropout_seed,
+                              data_axis_name=dax)
     return _seq_sharded_call(local, mesh, q, k, v, seq_axis, data_axis)
 
 
 def ulysses_attention(q, k, v, mesh, causal=True, sm_scale=None,
-                      seq_axis="seq", data_axis="data", attn_fn=None):
+                      seq_axis="seq", data_axis="data", attn_fn=None,
+                      dropout_rate=0.0, dropout_seed=None):
     """Standalone Ulysses attention: q,k,v [B, T, H, D] sharded [data, seq]."""
+    dax = data_axis if mesh.shape[data_axis] > 1 else None
     local = functools.partial(ulysses_attention_local, axis_name=seq_axis,
                               causal=causal, sm_scale=sm_scale,
-                              attn_fn=attn_fn)
+                              attn_fn=attn_fn, dropout_rate=dropout_rate,
+                              dropout_seed=dropout_seed,
+                              data_axis_name=dax)
     return _seq_sharded_call(local, mesh, q, k, v, seq_axis, data_axis)

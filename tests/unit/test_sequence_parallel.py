@@ -116,3 +116,100 @@ def test_ring_attention_bf16(seq_mesh):
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(ref, np.float32),
         rtol=0.05, atol=0.05)
+
+
+# --- in-kernel attention-prob dropout (round 4) ---------------------------
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_dropout_matches_dense_same_seed(seq8_mesh, causal):
+    """Ring attention regenerates the shared counter-based mask at GLOBAL
+    sequence coordinates, so under pure seq sharding (data=1 ⇒ local
+    batch == global batch) it equals dense-with-the-same-mask — forward
+    and gradients."""
+    q, k, v = qkv(T=64)
+    seed = jnp.int32(17)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal,
+                                       dropout_rate=0.2,
+                                       dropout_seed=seed) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, seq8_mesh, causal=causal,
+                                      dropout_rate=0.2,
+                                      dropout_seed=seed) ** 2)
+
+    vd, gd = jax.value_and_grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    vr, gr = jax.value_and_grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(vr), float(vd), rtol=1e-4)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_dropout_trains_and_is_seeded(seq_mesh):
+    """Ulysses delegates dropout to the inner attention with the seed
+    folded per head-group rank (unfolded, every head group would repeat
+    the identical mask pattern). Deterministic per seed; different seeds
+    differ; grads finite."""
+    q, k, v = qkv(T=128)
+
+    def run(seed, qq=None, kk=None, vv=None):
+        return ulysses_attention(qq if qq is not None else q,
+                                 kk if kk is not None else k,
+                                 vv if vv is not None else v,
+                                 seq_mesh, causal=True,
+                                 dropout_rate=0.3,
+                                 dropout_seed=jnp.int32(seed))
+
+    a1, a2, b = run(5), run(5), run(6)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert not np.allclose(np.asarray(a1), np.asarray(b))
+
+    g = jax.grad(lambda qq: jnp.sum(run(5, qq=qq) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).max()) > 0
+
+
+def test_fold_in_seed_avalanches_not_shifts():
+    """fold_in_seed must not reduce to a coordinate shift: a LINEAR
+    stride with the hash's q_pos multiplier made rank r's mask equal
+    rank 0's mask at q_pos + r (the round-4 review catch). The folded
+    seed's mask must be ~independent of every shifted unfolded mask."""
+    from deepspeed_tpu.ops.pallas.flash_attention import (
+        dropout_multiplier, fold_in_seed)
+    T = 512
+    q = jnp.arange(T)[:, None]
+    k = jnp.arange(T)[None, :]
+    base = np.asarray(dropout_multiplier(jnp.int32(99), 0, q, k, 0.5)) > 0
+    for r in (1, 2, 3):
+        folded = np.asarray(dropout_multiplier(
+            fold_in_seed(jnp.int32(99), r), 0, q, k, 0.5)) > 0
+        for shift in range(-4, 5):
+            lo, hi = max(0, -shift), min(T, T - shift)
+            agree = (folded[lo:hi] == base[lo + shift:hi + shift]).mean()
+            # independent masks at keep=0.5 agree ~50%; a shift alias
+            # would agree 100%
+            assert 0.4 < agree < 0.6, (r, shift, agree)
+
+
+def test_ring_dropout_data_shards_decorrelated(seq_mesh):
+    """Identical batch rows placed on different data shards must get
+    DIFFERENT dropout masks (the data rank is folded into the seed);
+    without the fold every data shard reuses one mask pattern."""
+    q, k, v = qkv(T=128, B=1)
+    qq = jnp.concatenate([q, q]); kk = jnp.concatenate([k, k])
+    vv = jnp.concatenate([v, v])      # row 1 duplicates row 0
+    out = ring_attention(qq, kk, vv, seq_mesh, causal=True,
+                         dropout_rate=0.3, dropout_seed=jnp.int32(3))
+    a, b = np.asarray(out[0]), np.asarray(out[1])
+    assert not np.allclose(a, b), "data shards share one dropout mask"
+    # sanity: without dropout the duplicated rows agree exactly
+    out0 = ring_attention(qq, kk, vv, seq_mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out0[0]), np.asarray(out0[1]),
+                               rtol=1e-6)
+
+
+def test_ring_dropout_requires_seed(seq8_mesh):
+    q, k, v = qkv(T=64)
+    with pytest.raises(ValueError, match="dropout_seed"):
+        ring_attention(q, k, v, seq8_mesh, dropout_rate=0.1)
