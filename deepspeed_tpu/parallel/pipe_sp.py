@@ -1,0 +1,218 @@
+"""Sequence parallelism inside the compiled pipeline (pp x sp x dp).
+
+Long-context composed with pipeline parallelism — beyond the reference,
+whose long-context story is block-sparse attention only (SURVEY.md §5.7)
+and whose pipeline knows nothing of sequence sharding. Inside the
+pipeline's ``shard_map`` every axis is manual, so sequence parallelism
+takes the same form as the TP/EP compositions (`parallel/pipe_tp.py`,
+`moe/expert_pipe.py`): explicit collectives, gated by
+``parallel.collectives.axis_is_manual``.
+
+- :class:`SPEmbedLayer` (prologue) embeds the full microbatch sequence
+  and slices this rank's token chunk — activations flow through the
+  pipeline at [B, T/n, M], so stage-transfer ppermutes and attention
+  memory shrink by the seq degree;
+- :class:`SPBlockLayer` runs **Ulysses** attention over the ``seq`` axis
+  (`parallel/sequence.py:ulysses_attention_local` — two all_to_alls
+  re-shard tokens ⟷ heads) with weights replicated;
+- :class:`SPHeadLayer` + :func:`make_sp_token_loss` produce the weighted
+  ``(loss_sum, token_count)`` form the pipeline reduces exactly across
+  seq shards (`runtime/pipe/pipeline.py` psums the seq axis for weighted
+  losses — partial-sum semantics).
+
+Why Ulysses and not ring here: the 1F1B gates stage bodies behind
+stage-dependent ``lax.cond`` predicates (warmup/cooldown ticks,
+last-stage special-casing), so a collective inside a body only executes
+on the pipe ranks whose predicate is true that tick. Group-scoped
+collectives whose participants all share the predicate are fine — TP's
+``psum`` over ``model`` and Ulysses' ``all_to_all`` over ``seq`` both
+group within a fixed pipe rank. Ring attention's ``ppermute`` is not:
+its rendezvous spans the full device set (pairs semantics), so pipe
+ranks on the skip-branch deadlock the ranks executing it (observed as
+an XLA CPU rendezvous abort; the same hazard exists for any
+non-uniform collective under SPMD). Ring remains the right tool in the
+engine's UNgated train step (`parallel/sequence.py:ring_attention`).
+
+``n_head`` must divide by the seq degree (the Ulysses head split). At
+seq degree 1 every piece degenerates to the dense computation, so one
+module definition serves both the sharded run and its oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import flax.linen as nn
+
+from deepspeed_tpu.parallel.collectives import axis_is_manual
+from deepspeed_tpu.parallel.pipe_tp import layer_norm
+from deepspeed_tpu.parallel.sequence import ulysses_attention_local
+
+
+SEQ_AXIS = "seq"
+# The pipeline's weighted-loss reductions psum the LITERAL ``seq`` mesh
+# axis (runtime/pipe/pipeline.py) — the SP layers are fixed to it; a
+# configurable axis name would silently break those reductions.
+
+
+def _seq_info():
+    """(n, idx) for the seq axis in manual mode, else (1, 0). ``n`` is a
+    static int (axis sizes are mesh metadata)."""
+    if axis_is_manual(SEQ_AXIS):
+        return lax.axis_size(SEQ_AXIS), lax.axis_index(SEQ_AXIS)
+    return 1, 0
+
+
+class SPEmbedLayer:
+    """Prologue: token + position embedding, sliced to this seq rank's
+    chunk. Param leaves: ``wte`` [V, M], ``wpe`` [max_pos, M]
+    (replicated; their grads are per-shard partials the pipeline psums
+    over ``seq``)."""
+
+    def __init__(self, vocab, d_model, max_pos, ids_key="input_ids"):
+        self.vocab = vocab
+        self.d_model = d_model
+        self.max_pos = max_pos
+        self.ids_key = ids_key
+
+    def init(self, rng, micro):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "wte": jax.random.normal(k1, (self.vocab, self.d_model),
+                                     jnp.float32) * 0.02,
+            "wpe": jax.random.normal(k2, (self.max_pos, self.d_model),
+                                     jnp.float32) * 0.01,
+        }
+
+    def apply(self, p, micro, rng=None):
+        ids = micro[self.ids_key]                       # [B, T] full
+        B, T = ids.shape
+        x = p["wte"][ids] + p["wpe"][jnp.arange(T)]
+        n, idx = _seq_info()
+        assert T % n == 0, (
+            f"seq len {T} must divide the seq-parallel degree {n}")
+        Tloc = T // n
+        return lax.dynamic_slice_in_dim(x, idx * Tloc, Tloc, axis=1)
+
+
+class SPBlockLayer:
+    """Pre-LN causal transformer block on seq-LOCAL activations
+    [B, T/n, M]; attention is Ulysses over the ``seq`` axis (exactly full
+    causal attention over the global sequence — see the module docstring
+    for why not ring inside the 1F1B). All weights replicated."""
+
+    def __init__(self, d_model, n_head, ffn_mult=4):
+        assert d_model % n_head == 0
+        self.d_model = d_model
+        self.n_head = n_head
+        self.ffn = ffn_mult * d_model
+
+    def init(self, rng, x):
+        M = self.d_model
+        ks = jax.random.split(rng, 4)
+        init = nn.initializers.normal(0.02)
+        return {
+            "ln1_scale": jnp.ones((M,), jnp.float32),
+            "ln1_bias": jnp.zeros((M,), jnp.float32),
+            "ln2_scale": jnp.ones((M,), jnp.float32),
+            "ln2_bias": jnp.zeros((M,), jnp.float32),
+            "qkv": init(ks[0], (M, 3 * M), jnp.float32),
+            "qkv_b": jnp.zeros((3 * M,), jnp.float32),
+            "proj": init(ks[1], (M, M), jnp.float32),
+            "proj_b": jnp.zeros((M,), jnp.float32),
+            "fc": init(ks[2], (M, self.ffn), jnp.float32),
+            "fc_b": jnp.zeros((self.ffn,), jnp.float32),
+            "fc_out": init(ks[3], (self.ffn, M), jnp.float32),
+            "fc_out_b": jnp.zeros((M,), jnp.float32),
+        }
+
+    def _attention(self, q, k, v):
+        if axis_is_manual(SEQ_AXIS):
+            return ulysses_attention_local(q, k, v, SEQ_AXIS, causal=True)
+        # oracle / build-time path: plain full-sequence causal attention
+        B, T, H, D = q.shape
+        scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+        s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhts,bshd->bthd", p, v)
+
+    def apply(self, params, x, rng=None):
+        B, Tloc, M = x.shape
+        H = self.n_head
+        D = M // H
+        dtype = x.dtype
+
+        h = layer_norm(x, params["ln1_scale"],
+                       params["ln1_bias"]).astype(dtype)
+        qkv = h @ params["qkv"].astype(dtype) + params["qkv_b"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        y = self._attention(q.reshape(B, Tloc, H, D),
+                            k.reshape(B, Tloc, H, D),
+                            v.reshape(B, Tloc, H, D)).reshape(B, Tloc, M)
+        x = x + y @ params["proj"].astype(dtype) + \
+            params["proj_b"].astype(dtype)
+
+        h2 = layer_norm(x, params["ln2_scale"],
+                        params["ln2_bias"]).astype(dtype)
+        ff = jax.nn.gelu(h2 @ params["fc"].astype(dtype) +
+                         params["fc_b"].astype(dtype))
+        return x + ff @ params["fc_out"].astype(dtype) + \
+            params["fc_out_b"].astype(dtype)
+
+
+class SPHeadLayer:
+    """Epilogue: [B, T/n, M] → seq-local logits [B, T/n, V]."""
+
+    def __init__(self, d_model, vocab):
+        self.d_model = d_model
+        self.vocab = vocab
+
+    def init(self, rng, x):
+        return {"w": jax.random.normal(rng, (self.d_model, self.vocab),
+                                       jnp.float32) * 0.02}
+
+    def apply(self, p, x, rng=None):
+        return x @ p["w"]
+
+
+def make_sp_token_loss(ids_key="input_ids"):
+    """Weighted next-token CE over this rank's token chunk:
+    ``(loss_sum, count)`` — the form the pipeline psums over ``seq`` for
+    the exact global mean. Labels come from the FULL microbatch ids, so
+    chunk boundaries shift correctly (the last token of chunk r is
+    supervised by the first id of chunk r+1); only the global last token
+    is ignored."""
+
+    def loss(logits, micro):
+        ids = micro[ids_key]                            # [B, T] full
+        B, T = ids.shape
+        n, idx = _seq_info()
+        Tloc = T // n
+        start = idx * Tloc
+        labels_full = jnp.concatenate(
+            [ids[:, 1:], jnp.full((B, 1), -100, ids.dtype)], axis=1)
+        labels = lax.dynamic_slice_in_dim(labels_full, start, Tloc, axis=1)
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tok = -jnp.take_along_axis(lp, safe[..., None], -1).squeeze(-1)
+        tok = jnp.where(valid, tok, 0.0)
+        return tok.sum(), valid.sum().astype(jnp.float32)
+
+    return loss
+
+
+def sp_pipeline_module(vocab, d_model, n_head, seq_len, n_blocks=2,
+                       num_stages=None, ids_key="input_ids"):
+    """PipelineModule wiring the SP layers (pp x sp x dp composition)."""
+    import numpy as np
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+    return PipelineModule(
+        layers=[LayerSpec(SPEmbedLayer, vocab, d_model, seq_len, ids_key)] +
+               [LayerSpec(SPBlockLayer, d_model, n_head)
+                for _ in range(n_blocks)] +
+               [LayerSpec(SPHeadLayer, d_model, vocab)],
+        num_stages=num_stages, loss_fn=make_sp_token_loss(ids_key),
+        example_input={ids_key: np.zeros((2, seq_len), np.int32)})

@@ -393,6 +393,12 @@ def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
                 parts.epilogue_apply(p, xx, None), mb),
             rest, act, micro_at(0))
         weighted = isinstance(loss_probe, tuple)
+        if not weighted and mesh.shape.get("seq", 1) > 1:
+            raise ValueError(
+                "pipeline on a mesh with seq > 1 requires the weighted "
+                "(loss_sum, weight) loss form: a scalar mean loss cannot "
+                "express seq-sharded token counts, so losses and grads "
+                "would be silently mis-scaled by the seq degree")
 
         def mb_loss_pair(x, m_oc):
             res = parts.loss_fn(
@@ -434,16 +440,23 @@ def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
         # Only the last stage accumulated loss; share it everywhere so the
         # result is replicated, matching out_specs=P().
         if weighted:
-            # exact global weighted mean: sum losses / sum weights
-            num = lax.psum(lax.psum(num_sum, "pipe"), "data")
-            den = lax.psum(lax.psum(den_sum, "pipe"), "data")
+            # exact global weighted mean: sum losses / sum weights. The
+            # ``seq`` axis joins the psum — sequence-parallel layers hold
+            # per-token-shard partial sums; with replicated compute the
+            # n-fold num and den cancel (same note as the 1F1B path).
+            seq_tail = tuple(a for a in axis_tail if a == "seq")
+            loss_axes = ("pipe", "data") + seq_tail
+            num = lax.psum(num_sum, loss_axes)
+            den = lax.psum(den_sum, loss_axes)
             loss = num / jnp.maximum(den, 1.0)
+            rest_tail = tuple(a for a in axis_tail if a != "seq")
         else:
             # mean of per-(microbatch, shard) means
             loss = lax.psum(num_sum, "pipe") / M
             loss = lax.pmean(loss, "data")
-        if axis_tail:
-            loss = lax.pmean(loss, axis_tail)
+            rest_tail = axis_tail
+        if rest_tail:
+            loss = lax.pmean(loss, rest_tail)
         return loss
 
     def pipeline_loss(params, batch, rng):
@@ -590,6 +603,12 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
                 parts.epilogue_apply(cast(r), xx, None), micro_at(0)),
             rest, act)
         weighted = isinstance(loss_probe, tuple)
+        if not weighted and mesh.shape.get("seq", 1) > 1:
+            raise ValueError(
+                "pipeline on a mesh with seq > 1 requires the weighted "
+                "(loss_sum, weight) loss form: a scalar mean loss cannot "
+                "express seq-sharded token counts, so losses and grads "
+                "would be silently mis-scaled by the seq degree")
 
         zeros_body_g = jax.tree_util.tree_map(
             lambda a: jnp.zeros(a.shape, f32), body_local)
@@ -705,12 +724,23 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
         # ---- reductions + scaling --------------------------------------
         # (the loss scale is already in the accumulated grads via the vjp
         # seed; here only the mean-normalization divides through, in fp32)
+        #
+        # The ``seq`` axis is COMPUTE-partitioned (sequence-parallel
+        # layers shard the token dim; weights stay replicated), so in the
+        # weighted form its num/den/grads are partial sums → psum, with
+        # the global den normalizing. This is exact in BOTH worlds: with
+        # replicated compute every seq rank holds identical num/den/g, so
+        # the n-fold psum cancels against the n-fold den in gscale.
+        seq_tail = tuple(a for a in axis_tail if a == "seq")
         if weighted:
-            D = lax.psum(lax.psum(den_sum, "pipe"), "data")
+            loss_axes = ("pipe", "data") + seq_tail
+            D = lax.psum(den_sum, loss_axes)
             D = jnp.maximum(D, 1.0)
-            loss = lax.psum(lax.psum(num_sum, "pipe"), "data") / D
+            loss = lax.psum(num_sum, loss_axes) / D
             gscale = 1.0 / D
         else:
+            # scalar-mean losses cannot express seq-sharded token counts;
+            # sequence-parallel modules must return (loss_sum, weight)
             n_data = lax.axis_size("data")
             loss = lax.pmean(lax.psum(num_sum, "pipe") / M, "data")
             gscale = 1.0 / (M * n_data)
@@ -730,15 +760,23 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
             gr_acc = jax.tree_util.tree_map(
                 lambda a: lax.psum(lax.psum(a, "pipe"), "data") * gscale,
                 gr_acc)
-        if axis_tail:
-            loss = lax.pmean(loss, axis_tail)
+        if weighted and seq_tail:
+            # partial-sum semantics (see note above)
+            gb_acc = jax.tree_util.tree_map(
+                lambda a: lax.psum(a, seq_tail), gb_acc)
+            gr_acc = jax.tree_util.tree_map(
+                lambda a: lax.psum(a, seq_tail), gr_acc)
+        other_tail = tuple(a for a in axis_tail
+                           if not (weighted and a == "seq"))
+        if other_tail:
+            loss = lax.pmean(loss, other_tail)
             # Replicated leaves: identical per-rank grads (expert-partial
             # cotangents are already psum'd in-layer by psum_grad), so
             # pmean is exact. Expert-SHARDED leaves hold genuinely
             # different shards — never mix them across ``expert``.
             def tail_mean(path, a):
                 # NB: gb_acc leaves here are stage-LOCAL (no [S] dim).
-                axes = tuple(ax for ax in axis_tail
+                axes = tuple(ax for ax in other_tail
                              if not ((ax == "expert" and
                                       _is_expert_leaf(path, a, local=True))
                                      or (ax == "model" and
@@ -746,7 +784,7 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
                 return lax.pmean(a, axes) if axes else a
             gb_acc = jax.tree_util.tree_map_with_path(tail_mean, gb_acc)
             gr_acc = jax.tree_util.tree_map(
-                lambda a: lax.pmean(a, axis_tail), gr_acc)
+                lambda a: lax.pmean(a, other_tail), gr_acc)
         # restore the leading stage dim the shard_map out_spec strips
         # (+ a stacked data dim in data_local mode)
         gb_acc = jax.tree_util.tree_map(lambda a: a[None], gb_acc)
