@@ -137,7 +137,8 @@ def _cache_key():
     key = os.environ.get("BENCH_MODEL") or "default"
     defaults = {"BENCH_SEQ": "128", "BENCH_SPARSE": "0",
                 "BENCH_LOSS_CHUNK": "0", "BENCH_REMAT": "0",
-                "BENCH_BS": None}
+                "BENCH_BS": None, "BENCH_PALLAS_ADAM": "0",
+                "BENCH_DROPOUT": None}
     for var, dflt in defaults.items():
         v = os.environ.get(var)
         if v and v != dflt:

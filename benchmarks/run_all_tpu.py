@@ -108,18 +108,24 @@ def main():
             failed.add(group)
 
     if "gpt2" in only:
-        # flagship 350M + remat-policy variants
+        # flagship 350M + remat-policy variants + the Pallas-Adam A/B
         grun("gpt2", "gpt2_350m", [py, "bench.py"])
         grun("gpt2", "gpt2_350m_dots", [py, "bench.py"],
              env={"BENCH_REMAT": "1"})
+        grun("gpt2", "gpt2_350m_pallas_adam", [py, "bench.py"],
+             env={"BENCH_PALLAS_ADAM": "1"})
     if "gpt2_chunked" in only:
         grun("gpt2_chunked", "gpt2_350m_chunked", [py, "bench.py"],
              env={"BENCH_LOSS_CHUNK": "512"})
         grun("gpt2_chunked", "gpt2_350m_chunked_bs16", [py, "bench.py"],
              env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "16"})
     if "bert" in only:
+        # default dropout 0.1 (the reference's recipe, in-kernel since
+        # round 4); the nodrop row isolates the dropout cost itself
         grun("bert", "bert_large", [py, "bench.py"],
              env={"BENCH_MODEL": "bert_large"})
+        grun("bert", "bert_large_nodrop", [py, "bench.py"],
+             env={"BENCH_MODEL": "bert_large", "BENCH_DROPOUT": "0"})
         grun("bert", "bert_large_seq512", [py, "bench.py"],
              env={"BENCH_MODEL": "bert_large", "BENCH_SEQ": "512"})
         # seq512: at seq128 the fixed local window covers the whole
