@@ -225,3 +225,50 @@ class TPBertBlockLayer(TPBlockLayer):
         out = row_parallel(ff, params["mp_fc_out"], params["fc_out_b"], ax)
         return layer_norm(x + out, params["ln2_scale"],
                           params["ln2_bias"]).astype(dtype)
+
+
+def tp_pipeline_module(vocab, d_model, n_head, seq_len, n_blocks=2,
+                       num_stages=None, ids_key="input_ids",
+                       block_cls=TPBlockLayer):
+    """PipelineModule wiring TP blocks (the dp x pp x tp composition):
+    embed -> ``n_blocks`` x ``block_cls`` -> head, with a masked
+    next-token CE in the weighted ``(loss_sum, count)`` form (final
+    position ignored, no wraparound)."""
+    import numpy as np
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+    class _Embed:
+        def init(self, rng, micro):
+            return {"emb": jax.random.normal(
+                rng, (vocab, d_model), jnp.float32) * 0.1}
+
+        def apply(self, p, micro, rng=None):
+            return p["emb"][micro[ids_key]]
+
+    class _Head:
+        def init(self, rng, x):
+            return {"w": jax.random.normal(
+                rng, (d_model, vocab), jnp.float32) * 0.1}
+
+        def apply(self, p, x, rng=None):
+            return x @ p["w"]
+
+    def loss(logits, micro):
+        ids = micro[ids_key]
+        B, T = ids.shape
+        labels = jnp.concatenate(
+            [ids[:, 1:], jnp.full((B, 1), -100, ids.dtype)], axis=1)
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tok = -jnp.take_along_axis(lp, safe[..., None], -1).squeeze(-1)
+        tok = jnp.where(valid, tok, 0.0)
+        return tok.sum(), valid.sum().astype(jnp.float32)
+
+    return PipelineModule(
+        layers=[LayerSpec(_Embed)] +
+               [LayerSpec(block_cls, d_model, n_head)
+                for _ in range(n_blocks)] +
+               [LayerSpec(_Head)],
+        num_stages=num_stages, loss_fn=loss,
+        example_input={ids_key: np.zeros((2, seq_len), np.int32)})
