@@ -85,11 +85,19 @@ def split_qkv_heads(qkv, d_head):
     return qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
 
 
-def local_attention(q, k, v, causal, use_flash=False):
+def local_attention(q, k, v, causal, use_flash=False, dropout_rate=0.0,
+                    dropout_seed=None, head_offset=0, n_heads_global=None):
     """Attention over the LOCAL heads (the Megatron head-partition);
-    flash kernels on TPU when ``use_flash``. Returns [B, T, hl * D]."""
+    flash kernels on TPU when ``use_flash``. Returns [B, T, hl * D].
+
+    Attention-prob dropout uses the shared counter-based hash at GLOBAL
+    head coordinates (``head_offset`` = this rank's first head,
+    ``n_heads_global`` = total heads), so the mask is invariant to the
+    model-axis sharding — a sharded run reproduces the replicated run
+    bitwise. With dropout active the dense path runs (the flash kernel's
+    mask coordinates are shard-local)."""
     B, T, h_local, D = q.shape
-    if use_flash:
+    if use_flash and dropout_rate == 0.0:
         y = flash_attention(q, k, v, causal=causal)
     else:
         scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
@@ -98,8 +106,18 @@ def local_attention(q, k, v, causal, use_flash=False):
         if causal:
             mask = jnp.tril(jnp.ones((T, T), bool))
             s = jnp.where(mask[None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        y = jnp.einsum("bhts,bshd->bthd", p, v)
+        p = jax.nn.softmax(s, axis=-1)
+        if dropout_rate > 0.0:
+            from deepspeed_tpu.ops.pallas.flash_attention import (
+                dropout_multiplier)
+            Hg = n_heads_global if n_heads_global is not None else h_local
+            bh = (jnp.arange(B)[:, None] * Hg
+                  + head_offset + jnp.arange(h_local)[None, :])   # [B, hl]
+            p = p * dropout_multiplier(
+                dropout_seed, bh[:, :, None, None],
+                jnp.arange(T)[None, None, :, None],
+                jnp.arange(T)[None, None, None, :], dropout_rate)
+        y = jnp.einsum("bhts,bshd->bthd", p.astype(q.dtype), v)
     return y.reshape(B, T, h_local * D)
 
 
@@ -157,21 +175,57 @@ class TPBlockLayer:
     causal = True
 
     def __init__(self, d_model, n_head, ffn_mult=4, axis_name="model",
-                 use_flash=False):
+                 use_flash=False, dropout=0.0):
         assert d_model % n_head == 0
         self.d_model = d_model
         self.n_head = n_head
         self.ffn = ffn_mult * d_model
         self.axis_name = axis_name
         self.use_flash = use_flash
+        self.dropout = dropout
 
     def init(self, rng, x):
         return _tp_block_params(rng, self.d_model, self.n_head, self.ffn)
+
+    def _drop_ctx(self, params, rng):
+        """(rate, attn_seed, head_offset, hidden_drop_fn) —
+        sharding-invariant dropout: attention masks hash GLOBAL head
+        coordinates and hidden masks come from the rng key, which is
+        identical on every MODEL rank (replicated activations must drop
+        the same units) but folded with the DATA rank so different batch
+        shards draw independent noise (the pipeline's mb_rng folds
+        microbatch + stage only)."""
+        if rng is None or self.dropout == 0.0:
+            return 0.0, None, 0, lambda t, sub: t
+        if self.use_flash:
+            from deepspeed_tpu.utils.logging import log_dist
+            log_dist("TP block dropout > 0 runs the DENSE attention path "
+                     "(O(T^2) scores): the flash kernel's dropout "
+                     "coordinates are shard-local. Expect higher memory "
+                     "at long sequence lengths.", ranks=[0])
+        from deepspeed_tpu.ops.pallas.flash_attention import (
+            dropout_seed_from_rng)
+        if axis_is_manual("data"):
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+        seed = dropout_seed_from_rng(rng)
+        rank = (jax.lax.axis_index(self.axis_name)
+                if axis_is_manual(self.axis_name) else 0)
+        D = self.d_model // self.n_head
+        h_local = params["mp_qkv"].shape[0] // (3 * D)
+        keep = 1.0 - self.dropout
+
+        def hidden_drop(t, sub):
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(rng, sub), keep, t.shape)
+            return jnp.where(mask, t / keep, 0.0).astype(t.dtype)
+
+        return self.dropout, seed, rank * h_local, hidden_drop
 
     def apply(self, params, x, rng=None):
         ax = self.axis_name
         dtype = x.dtype
         D = self.d_model // self.n_head
+        rate, seed, head_off, hidden_drop = self._drop_ctx(params, rng)
 
         # ---- attention (column QKV, local heads, row proj) ----------
         h = layer_norm(x, params["ln1_scale"],
@@ -180,8 +234,12 @@ class TPBlockLayer:
         qkv = column_parallel(h, params["mp_qkv"], params["mp_qkv_b"])
         q, k, v = split_qkv_heads(qkv, D)
         y = local_attention(q, k, v, causal=self.causal,
-                            use_flash=self.use_flash)
-        x = x + row_parallel(y, params["mp_proj"], params["proj_b"], ax)
+                            use_flash=self.use_flash,
+                            dropout_rate=rate, dropout_seed=seed,
+                            head_offset=head_off,
+                            n_heads_global=self.n_head)
+        att = row_parallel(y, params["mp_proj"], params["proj_b"], ax)
+        x = x + hidden_drop(att, 1)
 
         # ---- MLP (column fc, row fc_out) ----------------------------
         h2 = layer_norm(x, params["ln2_scale"],
@@ -189,8 +247,9 @@ class TPBlockLayer:
         h2 = replicated_input(h2, ax)
         ff = jax.nn.gelu(column_parallel(h2, params["mp_fc"],
                                          params["mp_fc_b"]))
-        return x + row_parallel(ff, params["mp_fc_out"],
-                                params["fc_out_b"], ax)
+        out = row_parallel(ff, params["mp_fc_out"],
+                           params["fc_out_b"], ax)
+        return x + hidden_drop(out, 2)
 
 
 class TPBertBlockLayer(TPBlockLayer):
@@ -207,15 +266,19 @@ class TPBertBlockLayer(TPBlockLayer):
         ax = self.axis_name
         dtype = x.dtype
         D = self.d_model // self.n_head
+        rate, seed, head_off, hidden_drop = self._drop_ctx(params, rng)
 
         # ---- attention, then residual + post-LN ---------------------
         h = replicated_input(x, ax)
         qkv = column_parallel(h, params["mp_qkv"], params["mp_qkv_b"])
         q, k, v = split_qkv_heads(qkv, D)
         y = local_attention(q, k, v, causal=False,
-                            use_flash=self.use_flash)
+                            use_flash=self.use_flash,
+                            dropout_rate=rate, dropout_seed=seed,
+                            head_offset=head_off,
+                            n_heads_global=self.n_head)
         att = row_parallel(y, params["mp_proj"], params["proj_b"], ax)
-        x = layer_norm(x + att, params["ln1_scale"],
+        x = layer_norm(x + hidden_drop(att, 1), params["ln1_scale"],
                        params["ln1_bias"]).astype(dtype)
 
         # ---- FFN, then residual + post-LN ---------------------------
@@ -223,7 +286,7 @@ class TPBertBlockLayer(TPBlockLayer):
         ff = jax.nn.gelu(column_parallel(h2, params["mp_fc"],
                                          params["mp_fc_b"]))
         out = row_parallel(ff, params["mp_fc_out"], params["fc_out_b"], ax)
-        return layer_norm(x + out, params["ln2_scale"],
+        return layer_norm(x + hidden_drop(out, 2), params["ln2_scale"],
                           params["ln2_bias"]).astype(dtype)
 
 
