@@ -51,6 +51,14 @@ def bert_flops_per_token(cfg, seq_len, attn_density=1.0):
 
 
 
+def _peak_hbm(jax):
+    """Device peak-HBM bytes, or None off-TPU / when stats are absent."""
+    try:
+        return jax.devices()[0].memory_stats().get("peak_bytes_in_use")
+    except Exception:
+        return None
+
+
 def time_engine_steps(engine, batch, steps, warmup=2):
     """Warm up, then time `steps` train_batch calls. float() forces full
     materialization — on the axon relay, block_until_ready alone can
@@ -120,7 +128,7 @@ def run_once_bert(jax, bs, seq_len, steps, sparse=False):
     tokens_per_sec = bs * seq_len * steps / dt
     tflops = tokens_per_sec * bert_flops_per_token(
         cfg, seq_len, attn_density) / 1e12
-    return bs * steps / dt, tokens_per_sec, tflops
+    return bs * steps / dt, tokens_per_sec, tflops, _peak_hbm(jax)
 
 
 def emit(payload):
@@ -286,13 +294,7 @@ def run_once_gpt2_offload(jax, cfg_fn, batch_size, seq_len, steps,
     dt = time_engine_steps(engine, batch, steps, warmup=1)
     tokens_per_sec = batch_size * seq_len * steps / dt
     tflops = tokens_per_sec * model_flops_per_token(cfg, seq_len) / 1e12
-    peak_hbm = None
-    try:
-        stats = jax.devices()[0].memory_stats()
-        peak_hbm = stats.get("peak_bytes_in_use")
-    except Exception:
-        pass
-    return tokens_per_sec, tflops, peak_hbm
+    return tokens_per_sec, tflops, _peak_hbm(jax)
 
 
 def run_once(jax, cfg_fn, batch_size, seq_len, steps, remat, on_tpu):
@@ -409,8 +411,8 @@ def main():
             bbs = int(os.environ.get("BENCH_BS", "128" if bseq <= 128
                                      else "32"))
             bsparse = os.environ.get("BENCH_SPARSE", "0") == "1"
-            sps, tps, tflops = run_once_bert(jax, bs=bbs, seq_len=bseq,
-                                             steps=20, sparse=bsparse)
+            sps, tps, tflops, bpeak = run_once_bert(
+                jax, bs=bbs, seq_len=bseq, steps=20, sparse=bsparse)
             bchunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0"))
             btag = f", chunked-CE{bchunk}" if bchunk else ""
             btag += ", sparse-attn" if bsparse else ""
@@ -421,6 +423,8 @@ def main():
                              f"seq{bseq}, bs{bbs}{btag})",
                    "value": round(sps, 1), "unit": "samples/sec/chip",
                    "vs_baseline": round(tflops / base, 3)}
+            if bpeak:
+                out["peak_hbm_gb"] = round(bpeak / 2 ** 30, 2)
             save_tpu_result(out)
             emit(out)
         except Exception as e:
