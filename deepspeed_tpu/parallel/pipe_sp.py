@@ -98,13 +98,22 @@ class SPBlockLayer:
     """Pre-LN causal transformer block on seq-LOCAL activations
     [B, T/n, M]; attention is Ulysses over the ``seq`` axis (exactly full
     causal attention over the global sequence — see the module docstring
-    for why not ring inside the 1F1B). All weights replicated."""
+    for why not ring inside the 1F1B). All weights replicated.
 
-    def __init__(self, d_model, n_head, ffn_mult=4):
+    ``dropout``: attention-prob dropout runs inside the Ulysses inner
+    kernel (per-head-group folded seeds — decorrelated, seq-degree
+    VARIANT noise) and hidden dropout hashes GLOBAL token coordinates —
+    invariant to the seq split, so a hidden-dropout-only block still
+    matches its seq=1 oracle bitwise."""
+
+    def __init__(self, d_model, n_head, ffn_mult=4, dropout=0.0,
+                 attn_dropout=None):
         assert d_model % n_head == 0
         self.d_model = d_model
         self.n_head = n_head
         self.ffn = ffn_mult * d_model
+        self.dropout = dropout
+        self.attn_dropout = dropout if attn_dropout is None else attn_dropout
 
     def init(self, rng, x):
         M = self.d_model
@@ -125,23 +134,54 @@ class SPBlockLayer:
             "fc_out_b": jnp.zeros((M,), jnp.float32),
         }
 
-    def _attention(self, q, k, v):
+    def _attention(self, q, k, v, rate, seed):
         if axis_is_manual(SEQ_AXIS):
-            return ulysses_attention_local(q, k, v, SEQ_AXIS, causal=True)
+            return ulysses_attention_local(q, k, v, SEQ_AXIS, causal=True,
+                                           dropout_rate=rate,
+                                           dropout_seed=seed)
         # oracle / build-time path: plain full-sequence causal attention
-        B, T, H, D = q.shape
-        scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
-        s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhts,bshd->bthd", p, v)
+        from deepspeed_tpu.ops.pallas.flash_attention import dense_attention
+        return dense_attention(q, k, v, causal=True,
+                               dropout_rate=rate, dropout_seed=seed)
+
+    def _hidden_drop(self, t, seed, sub):
+        """Hidden dropout hashed at GLOBAL (token, feature) coordinates —
+        the mask a given token draws is independent of which seq shard
+        holds it, keeping seq-degree invariance under dropout. The seed
+        is re-mixed per sublayer so the hidden coordinate space cannot
+        collide with the attention masks' (same hash, same step seed)."""
+        from deepspeed_tpu.ops.pallas.flash_attention import (
+            dropout_multiplier, fold_in_seed)
+        B, Tloc, M = t.shape
+        n, idx = _seq_info()
+        pos = idx * Tloc + jnp.arange(Tloc)
+        return t * dropout_multiplier(
+            fold_in_seed(seed, 1000 + sub),
+            jnp.arange(B)[:, None, None], pos[None, :, None],
+            jnp.arange(M)[None, None, :], self.dropout).astype(t.dtype)
 
     def apply(self, params, x, rng=None):
         B, Tloc, M = x.shape
         H = self.n_head
         D = M // H
         dtype = x.dtype
+        attn_rate, seed = 0.0, None
+        hidden_drop = lambda t, sub: t
+        if rng is not None and (self.dropout > 0.0 or
+                                self.attn_dropout > 0.0):
+            from deepspeed_tpu.ops.pallas.flash_attention import (
+                dropout_seed_from_rng)
+            # The pipeline's mb_rng folds (microbatch, stage, section)
+            # only — fold the data rank here so batch shards draw
+            # independent noise (same contract as pipe_tp._drop_ctx;
+            # identical on both sides of the seq-invariance test, so the
+            # invariance is untouched).
+            if axis_is_manual("data"):
+                rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            seed = dropout_seed_from_rng(rng)
+            attn_rate = self.attn_dropout
+            if self.dropout > 0.0:
+                hidden_drop = lambda t, sub: self._hidden_drop(t, seed, sub)
 
         h = layer_norm(x, params["ln1_scale"],
                        params["ln1_bias"]).astype(dtype)
@@ -149,16 +189,19 @@ class SPBlockLayer:
         q, k, v = jnp.split(qkv, 3, axis=-1)
         y = self._attention(q.reshape(B, Tloc, H, D),
                             k.reshape(B, Tloc, H, D),
-                            v.reshape(B, Tloc, H, D)).reshape(B, Tloc, M)
-        x = x + y @ params["proj"].astype(dtype) + \
+                            v.reshape(B, Tloc, H, D),
+                            attn_rate, seed).reshape(B, Tloc, M)
+        att = y @ params["proj"].astype(dtype) + \
             params["proj_b"].astype(dtype)
+        x = x + hidden_drop(att, 1)
 
         h2 = layer_norm(x, params["ln2_scale"],
                         params["ln2_bias"]).astype(dtype)
         ff = jax.nn.gelu(h2 @ params["fc"].astype(dtype) +
                          params["fc_b"].astype(dtype))
-        return x + ff @ params["fc_out"].astype(dtype) + \
+        out = ff @ params["fc_out"].astype(dtype) + \
             params["fc_out_b"].astype(dtype)
+        return x + hidden_drop(out, 2)
 
 
 class SPHeadLayer:
@@ -204,14 +247,16 @@ def make_sp_token_loss(ids_key="input_ids"):
 
 
 def sp_pipeline_module(vocab, d_model, n_head, seq_len, n_blocks=2,
-                       num_stages=None, ids_key="input_ids"):
+                       num_stages=None, ids_key="input_ids",
+                       dropout=0.0, attn_dropout=None):
     """PipelineModule wiring the SP layers (pp x sp x dp composition)."""
     import numpy as np
     from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
 
     return PipelineModule(
         layers=[LayerSpec(SPEmbedLayer, vocab, d_model, seq_len, ids_key)] +
-               [LayerSpec(SPBlockLayer, d_model, n_head)
+               [LayerSpec(SPBlockLayer, d_model, n_head,
+                          dropout=dropout, attn_dropout=attn_dropout)
                 for _ in range(n_blocks)] +
                [LayerSpec(SPHeadLayer, d_model, vocab)],
         num_stages=num_stages, loss_fn=make_sp_token_loss(ids_key),
