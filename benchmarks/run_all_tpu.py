@@ -31,6 +31,16 @@ def record(tag, payload):
                             **payload}) + "\n")
 
 
+def _row_is_live(row):
+    """A row counts as a LIVE capture only if it is error-free, not a
+    replayed cache entry, and not bench.py's CPU-smoke fallback. bench.py
+    exits rc=0 in all three failure shapes (it emits the error as JSON),
+    so rc alone cannot drive the probe loop's retry set."""
+    if "error" in row or row.get("cached"):
+        return False
+    return "cpu-smoke" not in row.get("metric", "")
+
+
 def run(tag, cmd, env=None, timeout=1800):
     log(f"{tag}: {' '.join(cmd)}")
     e = dict(os.environ)
@@ -44,16 +54,22 @@ def run(tag, cmd, env=None, timeout=1800):
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout, env=e, cwd=REPO)
-        lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
-        for ln in lines:
+        rows = []
+        for ln in r.stdout.splitlines():
+            if not ln.startswith("{"):
+                continue
             try:
-                record(tag, json.loads(ln))
+                rows.append(json.loads(ln))
             except json.JSONDecodeError:
-                pass
+                continue
+            record(tag, rows[-1])
         if r.returncode != 0:
             record(tag, {"error": r.stderr[-800:] or f"rc={r.returncode}"})
-        log(f"{tag}: done rc={r.returncode} ({len(lines)} rows)")
-        return r.returncode == 0
+        live = r.returncode == 0 and rows and all(
+            _row_is_live(row) for row in rows)
+        log(f"{tag}: done rc={r.returncode} ({len(rows)} rows"
+            + ("" if live else ", NOT live — will retry") + ")")
+        return live
     except subprocess.TimeoutExpired:
         record(tag, {"error": f"timeout after {timeout}s"})
         log(f"{tag}: TIMEOUT")
