@@ -36,8 +36,9 @@ def _row_is_live(row):
     replayed cache entry, and not bench.py's CPU-smoke fallback. bench.py
     exits rc=0 in all three failure shapes (it emits the error as JSON),
     so rc alone cannot drive the probe loop's retry set."""
-    if "error" in row or row.get("cached"):
+    if "error" in row or row.get("cached") or row.get("smoke"):
         return False
+    # Belt-and-braces: older bench builds only marked smoke in the label.
     return "cpu-smoke" not in row.get("metric", "")
 
 

@@ -1382,7 +1382,13 @@ class DeepSpeedEngine:
             self.timers("train_batch").start()
         self.tput_timer.start()
         placed = self._shard_batch(batch)
-        self._rng, step_rng = jax.random.split(self._rng)
+        # Derive the step rng from the CHECKPOINTED step counter rather
+        # than an in-memory split chain: a resumed engine replays the
+        # exact dropout masks the original would have drawn, so training
+        # curves stay continuous across save/load even with dropout on.
+        # Stream id 0 keeps this disjoint from backward()'s micro stream.
+        step_rng = jax.random.fold_in(
+            jax.random.fold_in(self._rng, 0), self.global_steps)
         lr_in = jnp.asarray(self._current_host_lr(), jnp.float32)
         if self._offload:
             metrics = self._train_batch_offload(placed, step_rng, lr_in)
@@ -1524,7 +1530,13 @@ class DeepSpeedEngine:
 
             self._micro_grad_fn = jax.jit(grad_fn)
         placed = self._place_rows(batch)
-        self._rng, rng = jax.random.split(self._rng)
+        # Counter-derived like train_batch's step rng (micro_steps is
+        # checkpointed), so manual forward/backward loops also resume
+        # with identical dropout masks. Stream id 1: a micro step must
+        # never replay a train_batch step's mask even when the two
+        # counters pass through equal values.
+        rng = jax.random.fold_in(
+            jax.random.fold_in(self._rng, 1), self.micro_steps)
         scale = jnp.asarray(self.loss_scale, jnp.float32)
         loss_val, grads = self._micro_grad_fn(self.params, placed, rng, scale)
         if self._grad_buffer is None:
@@ -1619,6 +1631,9 @@ class DeepSpeedEngine:
         meta = {
             "global_steps": self.global_steps,
             "micro_steps": self.micro_steps,
+            # The dropout base key: resume determinism must not depend on
+            # the resuming process passing the same seed= to initialize().
+            "rng_base_key": np.asarray(self._rng).tolist(),
             "dp_world_size": self.dp_world_size,
             "mp_world_size": self.mp_world_size,
             "lr_scheduler": self.lr_scheduler.state_dict()
@@ -1802,6 +1817,9 @@ class DeepSpeedEngine:
             meta = json.load(f)
         self.global_steps = meta["global_steps"]
         self.micro_steps = meta["micro_steps"]
+        if meta.get("rng_base_key") is not None:
+            self._rng = jnp.asarray(meta["rng_base_key"],
+                                    np.asarray(self._rng).dtype)
         if load_lr_scheduler_states and meta.get("lr_scheduler") and \
                 self.lr_scheduler is not None and \
                 hasattr(self.lr_scheduler, "load_state_dict"):

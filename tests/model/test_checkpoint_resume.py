@@ -59,6 +59,41 @@ def test_resume_continues_curve(tmp_path, config_overrides):
                         name="post-resume")
 
 
+def test_resume_continues_curve_with_dropout(tmp_path):
+    """Dropout must not break resume continuity: the per-step rng is
+    fold_in(base_key, global_steps) — a counter the checkpoint carries —
+    not an in-memory split chain, so a resumed engine replays the exact
+    masks the uninterrupted run would have drawn."""
+    config = base_gpt2_config()
+    batch = fixed_batch()
+    total, half = 12, 6
+
+    def dropout_engine(seed=0, engine_seed=0):
+        model = GPT2LMHead(gpt2_tiny(dropout=0.1))
+        params = init_gpt2_params(model, jax.random.PRNGKey(seed))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=config, loss_fn=make_gpt2_loss_fn(model), params=params,
+            seed=engine_seed)
+        return engine
+
+    e_full = dropout_engine()
+    full_curve = [float(e_full.train_batch(batch)) for _ in range(total)]
+
+    e_a = dropout_engine()
+    for _ in range(half):
+        e_a.train_batch(batch)
+    ckpt = str(tmp_path / "ckpt")
+    e_a.save_checkpoint(ckpt, tag="mid")
+
+    # Different param-init AND engine rng seeds: both must be overwritten
+    # by the checkpoint (params + the saved rng base key).
+    e_b = dropout_engine(seed=123, engine_seed=999)
+    e_b.load_checkpoint(ckpt, tag="mid")
+    second_half = [float(e_b.train_batch(batch)) for _ in range(total - half)]
+    assert_curves_close(full_curve[half:], second_half, rtol=1e-6,
+                        name="post-resume-dropout")
+
+
 def test_resume_restores_loss_scale_and_counters(tmp_path):
     config = base_gpt2_config(
         fp16={"enabled": True, "initial_scale_power": 10})
