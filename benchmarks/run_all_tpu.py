@@ -136,6 +136,8 @@ def main():
              env={"BENCH_LOSS_CHUNK": "512"})
         grun("gpt2_chunked", "gpt2_350m_chunked_bs16", [py, "bench.py"],
              env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "16"})
+        grun("gpt2_chunked", "gpt2_350m_chunked_bs32", [py, "bench.py"],
+             env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "32"})
     if "bert" in only:
         # default dropout 0.1 (the reference's recipe, in-kernel since
         # round 4); the nodrop row isolates the dropout cost itself
