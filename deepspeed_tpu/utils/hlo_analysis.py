@@ -92,3 +92,42 @@ def collective_bytes(hlo_text):
         counts[op] = counts.get(op, 0) + b
     counts["total"] = sum(counts.values())
     return counts
+
+
+# Per-device ring-algorithm send bytes as a multiple of the op's OUTPUT
+# bytes (N = ring size): all-reduce sends 2·(N-1)/N · M; all-gather sends
+# (N-1)/N · M (output M, shard M/N moved N-1 times); reduce-scatter
+# output is the M/N shard but each device sends M·(N-1)/N = (N-1)·out;
+# all-to-all and collective-permute move (N-1)/N and 1× their payload.
+_RING_SEND_FACTORS = {
+    "all-reduce": lambda n: 2 * (n - 1) / n,
+    "all-gather": lambda n: (n - 1) / n,
+    "reduce-scatter": lambda n: float(n - 1),
+    "all-to-all": lambda n: (n - 1) / n,
+    "collective-permute": lambda n: 1.0,
+    "collective-broadcast": lambda n: 1.0,
+}
+# Every parsed collective must have a send factor — fail at import, not
+# at some caller's KeyError, when _COLLECTIVES grows.
+assert set(_RING_SEND_FACTORS) == set(_COLLECTIVES)
+
+
+def ring_send_bytes(hlo_text, n_devices):
+    """Per-device bytes each device *sends* under ring algorithms.
+
+    Converts ``collective_bytes``'s output-bytes basis into the send-volume
+    basis the ZeRO paper's communication claims use (2M for an all-reduce
+    of M bytes, M for all-gather / reduce-scatter) so ratios between
+    compiled programs can be compared against published numbers directly.
+    Approximation: every collective is assumed to span ``n_devices`` (true
+    for the single-axis ZeRO tests this backs; subgroup collectives would
+    need per-op replica-group parsing).
+    """
+    out = collective_bytes(hlo_text)
+    sends = {}
+    for op, b in out.items():
+        if op == "total":
+            continue
+        sends[op] = int(b * _RING_SEND_FACTORS[op](n_devices))
+    sends["total"] = sum(sends.values())
+    return sends

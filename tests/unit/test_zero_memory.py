@@ -9,63 +9,15 @@ replicated gradient across grad-accumulation microbatches (the IPG-bucket
 machinery, stage2.py:613-738), stage 3 shards parameters.
 """
 
-import jax
-import jax.numpy as jnp
-import numpy as np
 import pytest
 
-import deepspeed_tpu
-from tests.unit.simple_model import base_config
-
 # Model must be big enough that sharded-vs-replicated dominates fixed
-# overheads: 8 layers x 512x512 fp32 ≈ 8.4 MB params.
-HIDDEN = 512
-NLAYERS = 8
-
-
-def init_params(rng):
-    keys = jax.random.split(rng, NLAYERS)
-    return {
-        f"linear_{i}": {
-            "kernel": jax.random.normal(
-                k, (HIDDEN, HIDDEN), jnp.float32) * 0.02,
-            "bias": jnp.zeros((HIDDEN,), jnp.float32),
-        }
-        for i, k in enumerate(keys)
-    }
-
-
-def loss_fn(params, batch, rng=None):
-    x = batch["x"]
-    for i in range(NLAYERS):
-        layer = params[f"linear_{i}"]
-        x = x @ layer["kernel"] + layer["bias"]
-        if i < NLAYERS - 1:
-            x = jax.nn.relu(x)
-    return jnp.mean(jnp.square(x - batch["y"]))
+# overheads: 8 layers x 512x512 fp32 ≈ 8.4 MB params (zero_fixtures).
+from tests.unit.zero_fixtures import NLAYERS, HIDDEN, lowered_train_step
 
 
 def compiled_stats(stage, accum=4):
-    cfg = base_config(
-        train_batch_size=16 * accum,
-        gradient_accumulation_steps=accum,
-        bf16={"enabled": True},
-        zero_optimization={"stage": stage},
-    )
-    params = init_params(jax.random.PRNGKey(0))
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        config=cfg, loss_fn=loss_fn, params=params)
-    rng = np.random.default_rng(0)
-    raw = {
-        "x": rng.normal(size=(16 * accum, HIDDEN)).astype(np.float32),
-        "y": rng.normal(size=(16 * accum, HIDDEN)).astype(np.float32),
-    }
-    engine.train_batch(raw)  # builds the compiled step lazily
-    batch = engine._shard_batch(raw)
-    lowered = engine._compiled_train_step.lower(
-        engine.params, engine.opt_state, engine.device_state, batch,
-        jax.random.PRNGKey(1), jnp.asarray(1e-3, jnp.float32))
-    ma = lowered.compile().memory_analysis()
+    ma = lowered_train_step(stage, accum=accum).memory_analysis()
     return {
         "args": ma.argument_size_in_bytes,
         "temp": ma.temp_size_in_bytes,
