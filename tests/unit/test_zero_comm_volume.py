@@ -13,12 +13,14 @@ param bytes):
 - stage 1/2: + exactly one param-sized all-gather — the sharded master
   update's param refresh (the reference's stage1.py:692 all_gather; the
   weight-update-sharding scheme of PAPERS.md "Automatic Cross-Replica
-  Sharding"). Grads stay a full all-reduce: ``grad_epilogue`` consumes
-  the FULL gradient for the global-norm/clip metric, which blocks the
-  reduce-scatter form (identified comm lever: shard-local norm^2 + a
-  scalar psum would free XLA to emit RS and cut ring-send volume by a
-  third; left unchanged because the full-grad norm is what every
-  train-step flavor reports today).
+  Sharding"). Grads appear as a full all-reduce *on this backend*: a
+  controlled experiment (grad -> sharded constraint -> sharded update,
+  with NO full-gradient consumer at all) still gets all-reduce + slice
+  from the CPU partitioner, so the all-reduce is backend pass behavior
+  (TPU's partitioner owns the all-reduce->reduce-scatter rewrite), not
+  a property of our graph — the reference's ``reduce_scatter: true``
+  capability (zero/config.py) is expressed here by the sharded-layout
+  constraints and realized by XLA where the backend supports it.
 - stage 3: params sharded; per-use gathers re-total ~M (+~3% layout
   padding). Ring-send total lands at ~1.5x stage 0 — the ZeRO paper's
   stage-3 number, reproduced from compiled programs rather than claimed.
