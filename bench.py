@@ -441,6 +441,7 @@ def main():
         from deepspeed_tpu.models.gpt2 import gpt2_350m as cfg_fn
         cfg_name, batch_size, seq_len, steps = "350M", 8, 1024, 20
         batch_size = int(os.environ.get("BENCH_BS", batch_size))
+        seq_len = int(os.environ.get("BENCH_SEQ", seq_len))
     else:  # CPU smoke mode
         from deepspeed_tpu.models.gpt2 import gpt2_125m as cfg_fn
         cfg_name, batch_size, seq_len, steps = "125M(cpu-smoke)", 2, 128, 2

@@ -138,6 +138,11 @@ def main():
              env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "16"})
         grun("gpt2_chunked", "gpt2_350m_chunked_bs32", [py, "bench.py"],
              env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "32"})
+        # Longer sequence at constant tokens/step: attention fraction
+        # doubles (flash), logits cost per token constant.
+        grun("gpt2_chunked", "gpt2_350m_chunked_seq2048", [py, "bench.py"],
+             env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "4",
+                  "BENCH_SEQ": "2048"})
     if "bert" in only:
         # default dropout 0.1 (the reference's recipe, in-kernel since
         # round 4); the nodrop row isolates the dropout cost itself
