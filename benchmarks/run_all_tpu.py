@@ -118,58 +118,72 @@ def main():
     log("capturing" + ("" if not args.force else " (--force: TPU state unverified)"))
     py = sys.executable
 
-    failed = set()
-
-    def grun(group, tag, cmd, **kw):
-        if not run(tag, cmd, **kw):
-            failed.add(group)
-
-    if "gpt2" in only:
+    # Ordered measurement plan: (group, tag, cmd, kwargs). Executed
+    # sequentially; after any failure the tunnel is re-probed and, if it
+    # is gone, the pass aborts — every group without a live row stays
+    # pending for the probe loop's next UP window instead of burning a
+    # 30-minute timeout per remaining row against a wedged tunnel.
+    plan = [
         # flagship 350M + remat-policy variants + the Pallas-Adam A/B
-        grun("gpt2", "gpt2_350m", [py, "bench.py"])
-        grun("gpt2", "gpt2_350m_dots", [py, "bench.py"],
-             env={"BENCH_REMAT": "1"})
-        grun("gpt2", "gpt2_350m_pallas_adam", [py, "bench.py"],
-             env={"BENCH_PALLAS_ADAM": "1"})
-    if "gpt2_chunked" in only:
-        grun("gpt2_chunked", "gpt2_350m_chunked", [py, "bench.py"],
-             env={"BENCH_LOSS_CHUNK": "512"})
-        grun("gpt2_chunked", "gpt2_350m_chunked_bs16", [py, "bench.py"],
-             env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "16"})
-        grun("gpt2_chunked", "gpt2_350m_chunked_bs32", [py, "bench.py"],
-             env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "32"})
+        ("gpt2", "gpt2_350m", [py, "bench.py"], {}),
+        ("gpt2", "gpt2_350m_dots", [py, "bench.py"],
+         {"env": {"BENCH_REMAT": "1"}}),
+        ("gpt2", "gpt2_350m_pallas_adam", [py, "bench.py"],
+         {"env": {"BENCH_PALLAS_ADAM": "1"}}),
+        ("gpt2_chunked", "gpt2_350m_chunked", [py, "bench.py"],
+         {"env": {"BENCH_LOSS_CHUNK": "512"}}),
+        ("gpt2_chunked", "gpt2_350m_chunked_bs16", [py, "bench.py"],
+         {"env": {"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "16"}}),
+        ("gpt2_chunked", "gpt2_350m_chunked_bs32", [py, "bench.py"],
+         {"env": {"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "32"}}),
         # Longer sequence at constant tokens/step: attention fraction
         # doubles (flash), logits cost per token constant.
-        grun("gpt2_chunked", "gpt2_350m_chunked_seq2048", [py, "bench.py"],
-             env={"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "4",
-                  "BENCH_SEQ": "2048"})
-    if "bert" in only:
-        # default dropout 0.1 (the reference's recipe, in-kernel since
-        # round 4); the nodrop row isolates the dropout cost itself
-        grun("bert", "bert_large", [py, "bench.py"],
-             env={"BENCH_MODEL": "bert_large"})
-        grun("bert", "bert_large_nodrop", [py, "bench.py"],
-             env={"BENCH_MODEL": "bert_large", "BENCH_DROPOUT": "0"})
-        grun("bert", "bert_large_seq512", [py, "bench.py"],
-             env={"BENCH_MODEL": "bert_large", "BENCH_SEQ": "512"})
+        ("gpt2_chunked", "gpt2_350m_chunked_seq2048", [py, "bench.py"],
+         {"env": {"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "4",
+                  "BENCH_SEQ": "2048"}}),
+        # BERT: default dropout 0.1 (the reference's recipe, in-kernel
+        # since round 4); the nodrop row isolates the dropout cost
+        ("bert", "bert_large", [py, "bench.py"],
+         {"env": {"BENCH_MODEL": "bert_large"}}),
+        ("bert", "bert_large_nodrop", [py, "bench.py"],
+         {"env": {"BENCH_MODEL": "bert_large", "BENCH_DROPOUT": "0"}}),
+        ("bert", "bert_large_seq512", [py, "bench.py"],
+         {"env": {"BENCH_MODEL": "bert_large", "BENCH_SEQ": "512"}}),
         # seq512: at seq128 the fixed local window covers the whole
         # layout (fully dense) and would measure nothing sparse
-        grun("bert", "bert_large_sparse", [py, "bench.py"],
-             env={"BENCH_MODEL": "bert_large", "BENCH_SPARSE": "1",
-                  "BENCH_SEQ": "512"})
-    if "offload" in only:
-        grun("offload", "gpt2_760m_offload", [py, "bench.py"],
-             env={"BENCH_MODEL": "gpt2_760m"}, timeout=2400)
-        grun("offload", "gpt2_1.5b_offload", [py, "bench.py"],
-             env={"BENCH_MODEL": "gpt2_1.5b"}, timeout=3600)
-    if "longctx" in only:
-        grun("longctx", "longctx_speed", [py, "benchmarks/long_context.py",
-                                          "--study", "speed"], timeout=2400)
-        grun("longctx", "longctx_maxseq", [py, "benchmarks/long_context.py",
-                                           "--study", "maxseq"], timeout=2400)
-    if "sweep" in only:
-        grun("sweep", "block_sweep", [py, "benchmarks/long_context.py",
-                                      "--study", "block"], timeout=2400)
+        ("bert", "bert_large_sparse", [py, "bench.py"],
+         {"env": {"BENCH_MODEL": "bert_large", "BENCH_SPARSE": "1",
+                  "BENCH_SEQ": "512"}}),
+        ("offload", "gpt2_760m_offload", [py, "bench.py"],
+         {"env": {"BENCH_MODEL": "gpt2_760m"}, "timeout": 2400}),
+        ("offload", "gpt2_1.5b_offload", [py, "bench.py"],
+         {"env": {"BENCH_MODEL": "gpt2_1.5b"}, "timeout": 3600}),
+        ("longctx", "longctx_speed",
+         [py, "benchmarks/long_context.py", "--study", "speed"],
+         {"timeout": 2400}),
+        ("longctx", "longctx_maxseq",
+         [py, "benchmarks/long_context.py", "--study", "maxseq"],
+         {"timeout": 2400}),
+        ("sweep", "block_sweep",
+         [py, "benchmarks/long_context.py", "--study", "block"],
+         {"timeout": 2400}),
+    ]
+    plan = [step for step in plan if step[0] in only]
+
+    failed = set()
+    for i, (group, tag, cmd, kw) in enumerate(plan):
+        if not run(tag, cmd, **kw):
+            failed.add(group)
+            # Same 120 s liveness threshold as the startup gate and the
+            # probe loop — a shorter probe here would abort a rare live
+            # window just because the tunnel answered slowly once.
+            alive, detail = tpu_probe()
+            if not alive and not args.force:
+                rest = {g for g, *_ in plan[i + 1:]}
+                failed |= rest
+                log(f"tunnel gone mid-capture ({detail}); aborting pass, "
+                    f"pending groups: {','.join(sorted(rest)) or 'none'}")
+                break
     record("capture_summary", {"requested": sorted(only),
                                "failed_groups": sorted(failed)})
     log(f"capture complete → {OUT}"
