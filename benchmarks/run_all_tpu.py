@@ -18,7 +18,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "BENCH_TPU_RESULTS.jsonl")
-ALL_GROUPS = "gpt2,gpt2_chunked,bert,offload,longctx,sweep"
+ALL_GROUPS = "gpt2,gpt2_chunked,bert,offload,longctx,sweep,profile"
 
 
 def log(msg):
@@ -167,6 +167,13 @@ def main():
         ("sweep", "block_sweep",
          [py, "benchmarks/long_context.py", "--study", "block"],
          {"timeout": 2400}),
+        # Last: measured step-time attribution (ANALYSIS_MFU's budget
+        # table from a real device trace instead of a model).
+        ("profile", "profile_350m",
+         [py, "benchmarks/profile_step.py"], {"timeout": 2400}),
+        ("profile", "profile_350m_chunked",
+         [py, "benchmarks/profile_step.py"],
+         {"env": {"BENCH_LOSS_CHUNK": "512"}, "timeout": 2400}),
     ]
     plan = [step for step in plan if step[0] in only]
 
