@@ -47,10 +47,13 @@ def classify(name):
 
 
 def aggregate_xplanes(trace_dir):
-    """Total device-plane op durations by name across all xplane files.
+    """Mean per-device op durations by name across all xplane files.
 
-    Returns (per_name_ps: dict, device_total_ps). Only device planes
-    (TPU/GPU/"XLA Op" lines) are counted — host threads are bookkeeping.
+    Returns ``(per_name_ps, device_total_ps, n_device_planes)`` — sums
+    are divided by the number of device planes so multi-chip traces
+    (one plane per chip, each recording the full per-shard step) report
+    one device's step time, comparable to ANALYSIS_MFU's budget. Only
+    device planes count — host threads are bookkeeping.
     """
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
@@ -60,6 +63,7 @@ def aggregate_xplanes(trace_dir):
         raise FileNotFoundError(f"no .xplane.pb under {trace_dir}")
     per_name = {}
     total = 0
+    n_planes = 0
     for path in paths:
         space = xplane_pb2.XSpace()
         with open(path, "rb") as f:
@@ -69,19 +73,22 @@ def aggregate_xplanes(trace_dir):
             if not ("TPU" in pname or "GPU" in pname
                     or "/device:" in pname):
                 continue
+            n_planes += 1
             meta = {m.id: m.name for m in plane.event_metadata.values()}
             for line in plane.lines:
-                # XLA op lines carry the per-op events; "Steps"/"XLA
+                # XLA-op lines carry the per-op events; "Steps"/"XLA
                 # Modules" lines would double-count the same wall time.
-                lname = line.name.lower()
-                if "xla op" not in lname and "xla ops" not in lname:
+                if "xla op" not in line.name.lower():
                     continue
                 for ev in line.events:
                     name = meta.get(ev.metadata_id, str(ev.metadata_id))
                     dur = ev.duration_ps
                     per_name[name] = per_name.get(name, 0) + dur
                     total += dur
-    return per_name, total
+    if n_planes > 1:
+        per_name = {k: v / n_planes for k, v in per_name.items()}
+        total /= n_planes
+    return per_name, total, n_planes
 
 
 def emit(payload):
@@ -92,8 +99,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--keep-trace", default=None,
-                    help="persist the raw trace under this dir")
-    ap.add_argument("--model", default=os.environ.get("BENCH_MODEL", ""))
+                    help="persist the raw trace under this dir (a fresh "
+                         "run-specific subdir — re-running never "
+                         "aggregates a previous run's xplanes)")
     args = ap.parse_args()
 
     import bench  # repo-root bench: subprocess backend probe
@@ -116,17 +124,17 @@ def main():
         GPT2LMHead, gpt2_350m, gpt2_tiny, init_gpt2_params,
         make_gpt2_loss_fn)
 
+    chunk = int(os.environ.get("BENCH_LOSS_CHUNK", "0"))
+    chunk_tag = f", chunked-CE{chunk}" if chunk else ""
     if on_tpu:
         cfg_fn, bs, seq = gpt2_350m, 8, 1024
-        label = "GPT-2 350M (bf16, seq1024, bs8)"
+        label = f"GPT-2 350M (bf16, seq1024, bs8{chunk_tag})"
     else:  # CPU plumbing check
         cfg_fn, bs, seq = gpt2_tiny, 2, 64
-        label = "GPT-2 tiny (cpu-smoke)"
-
-    import jax.numpy as jnp  # noqa: F401  (bench helpers expect jnp ready)
+        label = f"GPT-2 tiny (cpu-smoke{chunk_tag})"
 
     cfg = cfg_fn(n_positions=seq, use_flash_attention=on_tpu,
-                 loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "0")))
+                 loss_chunk=chunk)
     model = GPT2LMHead(cfg)
     params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq)
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -141,13 +149,17 @@ def main():
     for _ in range(2):  # compile + warm
         float(engine.train_batch(batch))
 
-    trace_dir = args.keep_trace or tempfile.mkdtemp(prefix="ds_tpu_prof_")
+    if args.keep_trace:
+        os.makedirs(args.keep_trace, exist_ok=True)
+        trace_dir = tempfile.mkdtemp(prefix="run_", dir=args.keep_trace)
+    else:
+        trace_dir = tempfile.mkdtemp(prefix="ds_tpu_prof_")
     with jax.profiler.trace(trace_dir):
         for _ in range(args.steps):
             loss = engine.train_batch(batch)
         float(loss)
 
-    per_name, total_ps = aggregate_xplanes(trace_dir)
+    per_name, total_ps, n_planes = aggregate_xplanes(trace_dir)
     cats = {}
     for name, ps in per_name.items():
         cats[classify(name)] = cats.get(classify(name), 0) + ps
@@ -156,6 +168,7 @@ def main():
     out = {
         "metric": f"{label} step-time attribution (device op time)",
         "steps": args.steps,
+        "device_planes": n_planes,
         "device_ms_per_step": round(total_ps * ms / args.steps, 3),
         "categories_ms_per_step": {
             k: round(v * ms / args.steps, 3)
