@@ -40,7 +40,8 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import (
 )
 from deepspeed_tpu.runtime.lr_schedules import get_lr_scheduler, OneCycle
 from deepspeed_tpu.runtime.utils import check_overflow, clip_by_global_norm, global_norm
-from deepspeed_tpu.runtime.zero.sharding import build_zero_shardings, constrain_tree
+from deepspeed_tpu.runtime.zero.sharding import (
+    build_zero_shardings, constrain_tree, make_param_caster)
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
 from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
 from deepspeed_tpu.ops.adam.fused_adam import adam_update, init_adam_state
@@ -117,7 +118,8 @@ def step_metrics(loss_sum, accum, grad_norm, applied_norm, lr, scale,
     }
 
 
-def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None):
+def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None,
+                          cast_params=None):
     """Build ``accumulate(params, batch, rng, scale) -> (loss_sum, grads)``:
     scaled-loss value-and-grad over one microbatch, or a ``lax.scan`` over
     ``accum`` microbatches (batch leading dim = accum). Shared by the dense
@@ -128,10 +130,17 @@ def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None):
     layout, so the replicated full gradient never materializes across
     microbatches (the IPG-partition contract of reference stage2.py:613-738;
     constraining only after the scan would leave the carry layout to XLA's
-    guess)."""
+    guess).
 
-    def cast_params(p):
-        return jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
+    ``cast_params`` overrides the default fp32→compute-dtype cast — the
+    ZeRO-3 path passes the cast-then-gather transform
+    (`zero/sharding.py:make_param_caster`) so param all-gathers ride the
+    wire at 16 bit."""
+
+    if cast_params is None:
+        def cast_params(p):
+            return jax.tree_util.tree_map(
+                lambda x: x.astype(compute_dtype), p)
 
     # A loss_fn may carry a hand-written (loss, grads) implementation that
     # cannot be expressed as jax.grad of a scalar function — the executed
@@ -705,8 +714,18 @@ class DeepSpeedEngine:
         static_scale = self.static_loss_scale
         grad_constrain = (lambda g: constrain_tree(g, grad_shardings)) \
             if grad_shardings is not None else None
+        # ZeRO-3: per-use param gathers ride the wire at compute dtype
+        # (cast-then-gather, exact; zero/sharding.py:make_param_caster) —
+        # the analog of the reference gathering updated fp16 (not fp32
+        # master) params at stage 1 (stage1.py:692).
+        caster = None
+        if self.zero_optimization_stage() >= 3 and \
+                compute_dtype != jnp.float32:
+            caster = make_param_caster(self.params, param_shardings,
+                                       self.mesh, compute_dtype)
         accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum,
-                                           constrain=grad_constrain)
+                                           constrain=grad_constrain,
+                                           cast_params=caster)
         pld_fn = self._pld_theta_fn()
 
         def train_step(params, opt_state, dstate, batch, rng, lr_in):

@@ -25,6 +25,7 @@ dedicated reduction stream.
 from jax.sharding import NamedSharding, PartitionSpec
 
 import jax
+import jax.numpy as jnp
 
 
 def zero_partition_spec(shape, base_spec, mesh, axis="data"):
@@ -100,3 +101,77 @@ def constrain_tree(tree, sharding_tree):
     return jax.tree_util.tree_map(
         lambda x, s: jax.lax.with_sharding_constraint(x, s),
         tree, sharding_tree)
+
+
+def _gather_cast_leaf(mesh, spec, dtype, axis):
+    """Cast-then-gather for one stage-3 param leaf: the fp32 shard is cast
+    to the compute dtype LOCALLY and the all-gather moves the 16-bit
+    copy, halving per-use param traffic vs XLA's default gather-then-cast
+    (a plain ``with_sharding_constraint`` cannot express this: sharding
+    propagation walks the replicated constraint back through the convert
+    and gathers fp32). Bitwise-exact — cast is elementwise, so
+    cast∘gather == gather∘cast. The reference's analog is stage 1's fp16
+    param all-gather (`stage1.py:692`: updated fp16 shards, not fp32
+    masters, ride NCCL).
+
+    Backward is pinned by custom_vjp to the EXACT path: the compute-dtype
+    cotangent is cast to fp32 first, then reduced/resharded in fp32 —
+    the 16-bit wire never touches gradient accumulation numerics.
+    """
+    dim = list(spec).index(axis)
+    out_spec = PartitionSpec(*[None if s == axis else s for s in spec])
+
+    def inner(xs):
+        return jax.lax.all_gather(xs.astype(dtype), axis, axis=dim,
+                                  tiled=True)
+
+    fwd_impl = jax.shard_map(inner, mesh=mesh, in_specs=(spec,),
+                             out_specs=out_spec, check_vma=False)
+
+    @jax.custom_vjp
+    def gather16(x):
+        return fwd_impl(x)
+
+    def fwd(x):
+        return fwd_impl(x), None
+
+    def bwd(_, ct):
+        ctf = ct.astype(jnp.float32)
+        return (jax.lax.with_sharding_constraint(
+            ctf, NamedSharding(mesh, spec)),)
+
+    gather16.defvjp(fwd, bwd)
+    return gather16
+
+
+def make_param_caster(params, param_shardings, mesh, dtype, axis="data"):
+    """``cast(params) -> compute-dtype params`` for ZeRO-3 train steps.
+
+    Leaves sharded over ``axis`` (per ``param_shardings``) take the
+    cast-then-gather path; everything else is a plain astype. Returns
+    None when nothing is sharded over ``axis`` (stages < 3, fp32
+    compute, or a 1-device data axis) so callers can keep the default
+    cast.
+    """
+    if mesh.shape.get(axis, 1) == 1:
+        return None
+
+    found = {"gather": False}
+
+    def leaf_fn(leaf, sharding):
+        spec = tuple(sharding.spec)
+        # Only plain `axis` entries are handled; tuple sub-specs (e.g.
+        # ("data", "model") on one dim) fall back to the default cast.
+        if axis in spec:
+            found["gather"] = True
+            return _gather_cast_leaf(mesh, PartitionSpec(*spec), dtype, axis)
+        return lambda x: x.astype(dtype)
+
+    fns = jax.tree_util.tree_map(leaf_fn, params, param_shardings)
+    if not found["gather"]:
+        return None
+
+    def cast(p):
+        return jax.tree_util.tree_map(lambda f, x: f(x), fns, p)
+
+    return cast

@@ -40,23 +40,35 @@ def loss_fn(params, batch, rng=None):
     return jnp.mean(jnp.square(x - batch["y"]))
 
 
-def lowered_train_step(stage, accum=1):
-    """Build the engine at ``stage``, run one step, and return the
-    lowered-compiled train step (callers read .as_text() /
-    .memory_analysis())."""
-    bs = 16 * accum
-    cfg = base_config(train_batch_size=bs,
+def build_engine(stage, accum=1):
+    cfg = base_config(train_batch_size=16 * accum,
                       gradient_accumulation_steps=accum,
                       bf16={"enabled": True},
                       zero_optimization={"stage": stage})
     params = init_params(jax.random.PRNGKey(0))
     engine, _, _, _ = deepspeed_tpu.initialize(
         config=cfg, loss_fn=loss_fn, params=params)
+    return engine
+
+
+def make_batch(accum=1):
     rng = np.random.default_rng(0)
-    raw = {"x": rng.normal(size=(bs, HIDDEN)).astype(np.float32),
-           "y": rng.normal(size=(bs, HIDDEN)).astype(np.float32)}
+    bs = 16 * accum
+    return {"x": rng.normal(size=(bs, HIDDEN)).astype(np.float32),
+            "y": rng.normal(size=(bs, HIDDEN)).astype(np.float32)}
+
+
+def lowered_train_step(stage, accum=1, compiler_options=None):
+    """Build the engine at ``stage``, run one step, and return the
+    lowered-compiled train step (callers read .as_text() /
+    .memory_analysis(); pass ``compiler_options`` e.g. for an
+    xla_dump_to pass dump)."""
+    engine = build_engine(stage, accum=accum)
+    raw = make_batch(accum=accum)
     engine.train_batch(raw)  # builds the compiled step lazily
     batch = engine._shard_batch(raw)
-    return engine._compiled_train_step.lower(
+    lowered = engine._compiled_train_step.lower(
         engine.params, engine.opt_state, engine.device_state, batch,
-        jax.random.PRNGKey(1), jnp.asarray(1e-3, jnp.float32)).compile()
+        jax.random.PRNGKey(1), jnp.asarray(1e-3, jnp.float32))
+    return lowered.compile(compiler_options) if compiler_options \
+        else lowered.compile()
