@@ -279,7 +279,11 @@ def run_once_gpt2_offload(jax, cfg_fn, batch_size, seq_len, steps,
     config = {
         "train_batch_size": batch_size,
         "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2, "cpu_offload": True},
+        # 16-bit grad transfer = the reference's offload behavior
+        # (stage2.py:793 moves fp16 grads to pinned host memory); halves
+        # the D2H wire, which the axon tunnel makes doubly precious.
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "offload_16bit_grads": True},
         # no BENCH_PALLAS_ADAM knob here: the offload path updates via the
         # host C++ Adam, never the device _opt_update — the knob would be
         # a silent no-op mislabeling the A/B.

@@ -282,6 +282,11 @@ class DeepSpeedEngine:
             params, base_specs, self.mesh, self.zero_optimization_stage())
         self._offload = bool(self._config.zero_enabled and
                              self._config.zero_config.cpu_offload)
+        if self._config.zero_config.offload_16bit_grads and \
+                not self._offload:
+            log_dist("offload_16bit_grads: true has no effect without "
+                     "cpu_offload: true (grads only cross the wire on the "
+                     "offload path)", ranks=[0])
         if self._offload:
             # ZeRO-Offload (reference stage2.py cpu_offload + csrc cpu_adam):
             # fp32 masters + moments live in host RAM inside the C++
@@ -800,8 +805,15 @@ class DeepSpeedEngine:
         scale_args = self._scale_args()
         dynamic = self.dynamic_loss_scale
         static_scale = self.static_loss_scale
-        accumulate = make_grad_accumulator(loss_fn, self.compute_dtype,
-                                           accum)
+        compute_dtype = self.compute_dtype
+        # bf16 only: it shares fp32's exponent range, so casting the
+        # UNSCALED gradient is safe. fp16 would flush components under
+        # ~6e-5 to zero/subnormal — the reference avoids this by moving
+        # still-scaled fp16 grads (stage2.py:793); our epilogue unscales
+        # on device, so fp16 transfer would defeat loss scaling.
+        grads_16bit = (self._config.zero_config.offload_16bit_grads and
+                       compute_dtype == jnp.bfloat16)
+        accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum)
         pld_fn = self._pld_theta_fn()
 
         def grad_step(params, dstate, batch, rng, lr_in):
@@ -816,6 +828,13 @@ class DeepSpeedEngine:
             # scopes offload to single-process runs, asserted at init).
             grads, overflow, grad_norm, applied_norm = grad_epilogue(
                 grads, scale, accum, fp16, clip)
+            if grads_16bit:
+                # Reference parity: stage-2 offload moves fp16 grads to
+                # pinned host memory (stage2.py:793) — 16-bit halves the
+                # D2H wire; the host C++ Adam widens to fp32 during its
+                # existing copy into the flat grad buffer (no extra pass).
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(compute_dtype), grads)
             lr = lr_fn(dstate.global_step) if lr_fn is not None else lr_in
             beta1 = mom_fn(dstate.global_step)
             dstate_out = loss_scale_epilogue(dstate, overflow, fp16, dynamic,

@@ -18,6 +18,8 @@ from deepspeed_tpu.runtime.zero.constants import (
     ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS_DEFAULT,
     ZERO_OPTIMIZATION_CPU_OFFLOAD,
     ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT,
+    ZERO_OPTIMIZATION_OFFLOAD_16BIT_GRADS,
+    ZERO_OPTIMIZATION_OFFLOAD_16BIT_GRADS_DEFAULT,
     ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,
     ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT,
     ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS,
@@ -45,6 +47,7 @@ class DeepSpeedZeroConfig:
         self.overlap_comm = None
         self.load_from_fp32_weights = None
         self.cpu_offload = None
+        self.offload_16bit_grads = None
         self.elastic_checkpoint = None
 
         if ZERO_OPTIMIZATION in param_dict:
@@ -103,6 +106,10 @@ class DeepSpeedZeroConfig:
             zero_config_dict,
             ZERO_OPTIMIZATION_CPU_OFFLOAD,
             ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT)
+        self.offload_16bit_grads = get_scalar_param(
+            zero_config_dict,
+            ZERO_OPTIMIZATION_OFFLOAD_16BIT_GRADS,
+            ZERO_OPTIMIZATION_OFFLOAD_16BIT_GRADS_DEFAULT)
         self.elastic_checkpoint = get_scalar_param(
             zero_config_dict,
             ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,

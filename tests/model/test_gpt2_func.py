@@ -108,6 +108,18 @@ def test_offload_curve_matches_device(bf16_curve):
     assert_curves_close(bf16_curve, c, rtol=5e-2, name="offload")
 
 
+def test_offload_16bit_grads_curve_matches_device(bf16_curve):
+    """Reference-parity grad transfer (stage2.py:793 moves fp16 grads to
+    host): bf16 D2H grads halve the wire and must stay on the same curve
+    — the grads were computed through a bf16 backward anyway, so the
+    extra rounding is one cast of an already-bf16-noise-limited value."""
+    c, _ = gpt2_train_curve(base_gpt2_config(
+        bf16={"enabled": True},
+        zero_optimization={"stage": 2, "cpu_offload": True,
+                           "offload_16bit_grads": True}))
+    assert_curves_close(bf16_curve, c, rtol=5e-2, name="offload-16bit")
+
+
 # --- pipeline parallelism: curve invariant to the mesh split --------------
 def test_pipeline_curve_invariant_to_stage_count():
     import deepspeed_tpu
