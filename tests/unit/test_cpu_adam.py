@@ -167,3 +167,45 @@ def test_engine_zero_offload_checkpoint_roundtrip(tmp_path):
     l1 = float(e1.train_batch(fixed))
     l2 = float(e2.train_batch(fixed))
     assert abs(l1 - l2) < 1e-3
+
+
+def test_offload_16bit_grads_wire_dtype():
+    """offload_16bit_grads must deliver bf16 gradients to the host Adam
+    (half the D2H wire) — and must NOT engage under fp16 compute, where
+    casting the unscaled gradient would flush sub-6e-5 components and
+    defeat loss scaling (bf16 keeps fp32's exponent range)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (GPT2LMHead, gpt2_tiny,
+                                           init_gpt2_params,
+                                           make_gpt2_loss_fn)
+
+    def run_one(precision_block, expect):
+        cfg = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2, "cpu_offload": True,
+                                  "offload_16bit_grads": True},
+            **precision_block,
+        }
+        model = GPT2LMHead(gpt2_tiny())
+        params = init_gpt2_params(model, jax.random.PRNGKey(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=cfg, loss_fn=make_gpt2_loss_fn(model), params=params)
+        seen = {}
+        real_step = engine.cpu_optimizer.step
+
+        def spy_step(grads, **kw):
+            seen["dtype"] = {np.dtype(np.asarray(g).dtype).name
+                             for g in jax.tree_util.tree_leaves(grads)}
+            return real_step(grads, **kw)
+
+        engine.cpu_optimizer.step = spy_step
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 255, (8, 32)).astype(np.int32)}
+        engine.train_batch(batch)
+        assert seen["dtype"] == {expect}, seen
+
+    run_one({"bf16": {"enabled": True}}, "bfloat16")
+    # fp16: the 16-bit-transfer gate must NOT engage (fp32 on the wire).
+    run_one({"fp16": {"enabled": True, "initial_scale_power": 8}},
+            "float32")
