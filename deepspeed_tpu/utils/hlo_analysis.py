@@ -7,6 +7,16 @@ wire volume is a *compile-time* artifact: every collective is an HLO op
 with a static shape, so the bytes a compiled step moves per device can be
 read off the HLO text. ``collective_bytes`` does exactly that — the basis
 of the pinned byte-ratio test in ``tests/unit/test_onebit_adam.py``.
+
+LIMITATION — flat programs only: each HLO op is counted ONCE, but an op
+inside a ``while``/``scan`` body executes trip-count times. The pinned
+proofs (1-bit collective, ZeRO stage volumes at accum=1) are flat in
+their collectives — grad exchange and param refresh sit outside the
+accumulation scan. The executed-1F1B pipeline is NOT: its per-tick
+``ppermute`` lives inside the schedule scan, so this accounting cannot
+express pipeline transfer volume (measured: the static number is one
+tick's buffer regardless of micro-batch count). Pinning that would need
+trip-count-aware parsing.
 """
 
 import re
