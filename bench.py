@@ -16,12 +16,24 @@ config falls back to remat=True and a smaller batch rather than dying.
 
 import json
 import os
+import sys
 import time
 import traceback
 
 import numpy as np
 
 BASELINE_TFLOPS = 64.0  # reference best published per-GPU (V100)
+
+
+def hb(msg):
+    """Heartbeat for the capture watchdog (run_all_tpu.py): a stderr line
+    at every phase boundary. Round 4 lost a 33-min tunnel window because a
+    row wedged silently inside param init for 22 minutes — the watchdog
+    kills a child whose output goes quiet, so every potentially-blocking
+    phase (backend touch, init, compile, timed steps) must announce
+    itself."""
+    print(f"[bench-hb {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
 
 
 def model_flops_per_token(cfg, seq_len):
@@ -63,13 +75,16 @@ def time_engine_steps(engine, batch, steps, warmup=2):
     """Warm up, then time `steps` train_batch calls. float() forces full
     materialization — on the axon relay, block_until_ready alone can
     return before execution completes."""
-    for _ in range(warmup):
+    for i in range(warmup):
         float(engine.train_batch(batch))
+        hb(f"warmup step {i + 1}/{warmup} done")
+    hb(f"timing {steps} steps")
     t0 = time.perf_counter()
     loss = None
     for _ in range(steps):
         loss = engine.train_batch(batch)
     float(loss)
+    hb("timed block done")
     return time.perf_counter() - t0
 
 
@@ -108,7 +123,9 @@ def run_once_bert(jax, bs, seq_len, steps, sparse=False):
                      loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK",
                                                    "0")))
     model = BertForMaskedLM(cfg)
+    hb(f"bert init params (seq{seq_len}, bs{bs})")
     params = init_bert_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
+    hb("bert params ready; building engine")
     config = {
         "train_batch_size": bs,
         "bf16": {"enabled": True},
@@ -222,17 +239,24 @@ def init_backend_with_retry(retries=5, delay=10.0):
     """jax.devices() with retries — the axon TPU tunnel can be transiently
     UNAVAILABLE (BENCH_r01: rc=1 on first touch). Falls back to whatever
     backend is available if the preferred one never comes up."""
+    hb("probing backend (subprocess, 240s cap)")
     if probe_platform() is None:
         # Backend hangs or dies in a child — never touch it here. If a
         # live TPU measurement exists from a previous run, report it
         # (explicitly labeled as cached); otherwise run the CPU smoke.
+        hb("backend unreachable")
         cached = load_tpu_result()
         if cached is not None:
+            last_live = cached.pop("cached_at", "?")
             cached["note"] = (
                 "TPU tunnel unreachable at bench time; this is the last "
-                f"LIVE on-chip measurement (taken {cached.pop('cached_at', '?')}; "
+                f"LIVE on-chip measurement (taken {last_live}; "
                 "sweep in BENCHNOTES.md)")
             cached["cached"] = True
+            # Structured liveness (VERDICT r4 #8): machine-parseable
+            # fields so the driver's BENCH_r*.json needs no string match.
+            cached["live"] = False
+            cached["last_live"] = last_live
             emit(cached)
             raise SystemExit(0)
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -240,6 +264,7 @@ def init_backend_with_retry(retries=5, delay=10.0):
 
         jax.config.update("jax_platforms", "cpu")
         return jax, jax.devices()
+    hb("backend probe ok; importing jax in-process")
     import jax
 
     last = None
@@ -263,11 +288,15 @@ def init_backend_with_retry(retries=5, delay=10.0):
 
 
 def run_once_gpt2_offload(jax, cfg_fn, batch_size, seq_len, steps,
-                          loss_chunk=512):
+                          loss_chunk=512, host_init=False):
     """North-star config (BASELINE.json): GPT-2 1.5B on ONE chip via
     ZeRO-Offload (host fp32 masters + C++ Adam) + remat + chunked CE.
     The reference's analog capability: 13B on one 32 GB V100
-    (docs/_tutorials/zero-offload.md:9) — v5e has 16 GB HBM."""
+    (docs/_tutorials/zero-offload.md:9) — v5e has 16 GB HBM.
+
+    ``host_init``: initialize fp32 params on the host CPU backend —
+    required past ~2B params, where the transient fp32 init tree alone
+    would blow the 16 GB HBM before offload ever gets the masters."""
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import (
         GPT2LMHead, init_gpt2_params, make_gpt2_loss_fn)
@@ -275,7 +304,21 @@ def run_once_gpt2_offload(jax, cfg_fn, batch_size, seq_len, steps,
     cfg = cfg_fn(n_positions=seq_len, remat=True, use_flash_attention=True,
                  loss_chunk=loss_chunk)
     model = GPT2LMHead(cfg)
-    params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
+    hb(f"offload init params ({cfg.n_layer}L/{cfg.n_embd}d"
+       + (", host-side" if host_init else "") + ")")
+    import contextlib
+    cpu0 = None
+    if host_init:
+        try:
+            cpu0 = jax.devices("cpu")[0]
+        except RuntimeError:
+            pass
+    ctx = jax.default_device(cpu0) if cpu0 is not None \
+        else contextlib.nullcontext()
+    with ctx:
+        params = init_gpt2_params(model, jax.random.PRNGKey(0),
+                                  seq_len=seq_len)
+    hb("offload params ready; building engine")
     config = {
         "train_batch_size": batch_size,
         "bf16": {"enabled": True},
@@ -310,7 +353,9 @@ def run_once(jax, cfg_fn, batch_size, seq_len, steps, remat, on_tpu):
                  use_flash_attention=on_tpu,
                  loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "0")))
     model = GPT2LMHead(cfg)
+    hb(f"gpt2 init params ({cfg.n_layer}L/{cfg.n_embd}d, bs{batch_size})")
     params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
+    hb("gpt2 params ready; building engine")
     loss_fn = make_gpt2_loss_fn(model)
 
     config = {
@@ -368,6 +413,72 @@ def main():
     platform = devices[0].platform
     on_tpu = platform == "tpu"
     bench_model = os.environ.get("BENCH_MODEL", "")
+    if bench_model == "capacity":
+        # Capacity ladder (VERDICT r4 next-round #3): climb model sizes
+        # under the full memory stack (offload + remat + chunked CE +
+        # 16-bit grad wire) until OOM; report tokens/sec + peak HBM per
+        # size and the resulting max. The reference's proportional claim:
+        # 13B on one 32 GB V100 (docs/_tutorials/zero-offload.md:9).
+        if not on_tpu:
+            emit({"metric": "capacity ladder max params", "value": 0,
+                  "unit": "B params", "vs_baseline": 0.0,
+                  "error": f"requires a TPU; backend is {platform!r}"})
+            return
+        import gc
+        from deepspeed_tpu.models.gpt2 import (
+            gpt2_1_5b, gpt2_2_7b, gpt2_4b)
+        ladder = [("1.5B", gpt2_1_5b, 1.56, False),
+                  ("2.7B", gpt2_2_7b, 2.68, True),
+                  ("4.1B", gpt2_4b, 4.23, True)]
+        max_ok = 0.0
+        for name, cfg_fn, n_bil, host_init in ladder:
+            hb(f"capacity ladder: {name}")
+            row = {"metric": f"GPT-2 {name} ZeRO-Offload train "
+                             "tokens/sec/chip (bf16, seq1024, remat, "
+                             "chunked-CE, 16-bit grads)",
+                   "unit": "tokens/sec/chip"}
+            done = False
+            for bs in (4, 2):
+                try:
+                    tps, tflops, peak = run_once_gpt2_offload(
+                        jax, cfg_fn, batch_size=bs, seq_len=1024,
+                        steps=int(os.environ.get("BENCH_STEPS", "3")),
+                        host_init=host_init)
+                    row.update(value=round(tps, 1), bs=bs,
+                               vs_baseline=round(tflops / BASELINE_TFLOPS,
+                                                 3), live=True)
+                    if peak:
+                        row["peak_hbm_gb"] = round(peak / 2 ** 30, 2)
+                    max_ok, done = n_bil, True
+                    break
+                except Exception as e:
+                    is_oom = ("RESOURCE_EXHAUSTED" in str(e)
+                              or isinstance(e, MemoryError))
+                    gc.collect()
+                    if not is_oom:
+                        # Non-OOM failure: report it (this row will be
+                        # retried — unlike a clean OOM, which is an
+                        # ANSWER, not an error).
+                        row.update(value=0, vs_baseline=0.0,
+                                   error=f"{type(e).__name__}: {e}")
+                        done = True
+                        break
+                    hb(f"{name} bs{bs} OOM")
+            if not done:
+                # OOM at every batch size: that IS the measurement.
+                row.update(value=0, vs_baseline=0.0, oom=True, live=True,
+                           note="does not fit one v5e-16GB with "
+                                "offload+remat+chunked-CE")
+            emit(row)
+            gc.collect()
+            if row.get("oom") or "error" in row:
+                break
+        emit({"metric": "capacity ladder max trainable on one v5e-16GB",
+              "value": max_ok, "unit": "B params", "live": True,
+              "vs_baseline": round(max_ok / 13.0, 3),
+              "note": "vs_baseline = fraction of the reference's "
+                      "13B-on-32GB-V100 (v5e has half the HBM)"})
+        return
     if bench_model in ("gpt2_1.5b", "gpt2_760m"):
         # North star: largest single-chip model via ZeRO-Offload.
         if not on_tpu:
@@ -391,6 +502,7 @@ def main():
                    "vs_baseline": round(tflops / BASELINE_TFLOPS, 3)}
             if peak:
                 out["peak_hbm_gb"] = round(peak / 2 ** 30, 2)
+            out["live"] = True
             save_tpu_result(out)
             emit(out)
         except Exception as e:
@@ -429,6 +541,7 @@ def main():
                    "vs_baseline": round(tflops / base, 3)}
             if bpeak:
                 out["peak_hbm_gb"] = round(bpeak / 2 ** 30, 2)
+            out["live"] = True
             save_tpu_result(out)
             emit(out)
         except Exception as e:
@@ -470,6 +583,7 @@ def main():
                 "unit": "tokens/sec/chip",
                 "vs_baseline": round(tflops / BASELINE_TFLOPS, 3),
             }
+            out["live"] = on_tpu
             if smoke:
                 # Structured marker (capture tooling keys on this, not on
                 # the display string) — a smoke row is NOT a live capture.

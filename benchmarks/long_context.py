@@ -19,9 +19,14 @@ Usage (on TPU): python benchmarks/long_context.py [--study all|speed|block|maxse
 import argparse
 import functools
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import hb  # noqa: E402 - the one heartbeat contract the watchdog keys on
 
 
 def _materialize(out):
@@ -82,10 +87,12 @@ def study_speed(jax, emit):
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
     B, H, D = 1, 16, 64
     for T in (4096, 8192, 16384):
+        hb(f"speed study: seq {T} dense")
         q, k, v = make_inputs(jax, B, T, H, D, jax.numpy.bfloat16)
         dense = fwd_bwd(functools.partial(
             flash_attention, causal=True, implementation="pallas"))
         d_ms = _timeit(dense, q, k, v)
+        hb(f"speed study: seq {T} sparse")
         attn, layout = sparse_attn_fn(jax, T, H, block=128)
         density = float(layout.sum()) / layout.size
         s_ms = _timeit(fwd_bwd(attn), q, k, v)
@@ -98,6 +105,7 @@ def study_block(jax, emit):
     B, H, D, T = 1, 16, 64, 4096
     q, k, v = make_inputs(jax, B, T, H, D, jax.numpy.bfloat16)
     for block in (16, 32, 64, 128):
+        hb(f"block sweep: block {block}")
         attn, _ = sparse_attn_fn(jax, T, H, block=block,
                                  num_local=512 // block,
                                  num_global=128 // block)
@@ -114,6 +122,7 @@ def study_maxseq(jax, emit):
 
     def fits(make_fn, T):
         try:
+            hb(f"maxseq study: trying seq {T}")
             q, k, v = make_inputs(jax, B, T, H, D, jax.numpy.bfloat16)
             _materialize(fwd_bwd(make_fn(T))(q, k, v))
             return True

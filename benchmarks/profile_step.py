@@ -136,7 +136,9 @@ def main():
     cfg = cfg_fn(n_positions=seq, use_flash_attention=on_tpu,
                  loss_chunk=chunk)
     model = GPT2LMHead(cfg)
+    bench.hb(f"profile: init params ({label})")
     params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq)
+    bench.hb("profile: params ready; building engine")
     engine, _, _, _ = deepspeed_tpu.initialize(
         config={"train_batch_size": bs, "bf16": {"enabled": True},
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
@@ -146,8 +148,9 @@ def main():
     batch = {"input_ids": rng.integers(
         0, cfg.vocab_size, (bs, seq)).astype(np.int32)}
 
-    for _ in range(2):  # compile + warm
+    for i in range(2):  # compile + warm
         float(engine.train_batch(batch))
+        bench.hb(f"profile: warmup {i + 1}/2 done")
 
     if args.keep_trace:
         os.makedirs(args.keep_trace, exist_ok=True)
@@ -158,6 +161,7 @@ def main():
         for _ in range(args.steps):
             loss = engine.train_batch(batch)
         float(loss)
+    bench.hb("profile: trace captured; aggregating xplanes")
 
     per_name, total_ps, n_planes = aggregate_xplanes(trace_dir)
     cats = {}

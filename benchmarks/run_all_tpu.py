@@ -6,19 +6,29 @@ its own subprocess (a wedge/OOM in one measurement cannot kill the rest),
 appending JSON rows to BENCH_TPU_RESULTS.jsonl. bench.py invocations also
 refresh BENCH_TPU_CACHE.json per BENCH_MODEL key.
 
-Usage: python benchmarks/run_all_tpu.py [--only gpt2,bert,offload,longctx,sweep]
+Usage: python benchmarks/run_all_tpu.py [--only bert128,off760,...]
+(groups are fine-grained — see ALL_GROUPS — so a retry after a tunnel
+drop re-runs only what was lost, not a whole multi-row family)
 """
 
 import argparse
 import json
 import os
+import socket
 import subprocess
 import sys
+import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "BENCH_TPU_RESULTS.jsonl")
-ALL_GROUPS = "gpt2,gpt2_chunked,bert,offload,longctx,sweep,profile"
+ALL_GROUPS = ("bert128,bert512,off760,off15,capacity,lc_speed,lc_max,"
+              "sweep,chunked,padam,bertx,gpt2,profile")
+# The axon relay's remote-compile endpoint. A TCP connection-refused here
+# is a DEFINITIVE tunnel-process-gone signal (the round-4 mid-run failure
+# errored with "127.0.0.1:8093/remote_compile: Connection refused"); a
+# successful connect proves nothing (the tunnel wedges while listening).
+TUNNEL_ADDR = ("127.0.0.1", int(os.environ.get("AXON_TUNNEL_PORT", "8093")))
 
 
 def log(msg):
@@ -42,8 +52,54 @@ def _row_is_live(row):
     return "cpu-smoke" not in row.get("metric", "")
 
 
-def run(tag, cmd, env=None, timeout=1800):
-    log(f"{tag}: {' '.join(cmd)}")
+def tunnel_tcp_refused():
+    """True only on a definitive connection-refused (tunnel process gone).
+
+    Timeouts / other socket errors return False: a busy-but-alive tunnel
+    must not kill a row; the stall watchdog handles wedged-but-listening."""
+    try:
+        with socket.create_connection(TUNNEL_ADDR, timeout=5):
+            return False
+    except ConnectionRefusedError:
+        return True
+    except OSError:
+        return False
+
+
+class _Reader(threading.Thread):
+    """Drain one child pipe, keeping lines + a last-activity timestamp."""
+
+    def __init__(self, pipe, activity):
+        super().__init__(daemon=True)
+        self.pipe, self.activity, self.lines = pipe, activity, []
+        self.start()
+
+    def run(self):
+        for ln in self.pipe:
+            self.lines.append(ln)
+            self.activity[0] = time.monotonic()
+        self.pipe.close()
+
+
+def run(tag, cmd, env=None, timeout=900, stall=420, tcp_watch=False):
+    """Run one measurement row under a watchdog.
+
+    Round 4 burned 25 of a 33-minute tunnel window on one row that had
+    wedged silently inside device init (VERDICT r4 missing #1 / weak #2).
+    Three kill conditions, all much tighter than the old flat
+    subprocess timeout:
+      * wall clock > ``timeout`` (per-row cap, value-sized not 30 min);
+      * no stdout/stderr activity for ``stall`` s — bench.py and the
+        study scripts emit [bench-hb] heartbeats at every phase
+        boundary, so silence means a wedged device call, not a long
+        compile;
+      * with ``tcp_watch`` (set only when the startup TPU probe
+        succeeded, i.e. the axon relay demonstrably exists — NOT under
+        --force on a relay-less box, where the port is legitimately
+        dead): the tunnel's TCP endpoint refuses twice in a row
+        (~30 s) — the relay process is gone, no row can complete.
+    """
+    log(f"{tag}: {' '.join(cmd)} (cap {timeout}s, stall {stall}s)")
     e = dict(os.environ)
     e.pop("JAX_PLATFORMS", None)     # let the TPU backend load
     # Persistent XLA compile cache: the tunnel may not stay up long, and
@@ -52,29 +108,63 @@ def run(tag, cmd, env=None, timeout=1800):
                  os.path.join(REPO, ".jax_cache"))
     if env:
         e.update(env)
-    try:
-        r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout, env=e, cwd=REPO)
-        rows = []
-        for ln in r.stdout.splitlines():
-            if not ln.startswith("{"):
-                continue
+    t0 = time.monotonic()
+    activity = [t0]
+    # New session: the watchdog kills the WHOLE process group — bench.py
+    # spawns a jax-probe grandchild whose 240 s timeout lives in bench.py
+    # itself; killing only the direct child would orphan it blocked
+    # forever on jax.devices() against a wedged tunnel.
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=e,
+                            cwd=REPO, start_new_session=True)
+    out_r = _Reader(proc.stdout, activity)
+    err_r = _Reader(proc.stderr, activity)
+    kill_reason = None
+    refused_streak = 0
+    last_tcp = t0
+    while proc.poll() is None:
+        time.sleep(5)
+        now = time.monotonic()
+        if now - t0 > timeout:
+            kill_reason = f"row cap: {timeout}s wall clock"
+        elif now - activity[0] > stall:
+            kill_reason = f"stalled: {stall}s without output"
+        elif tcp_watch and now - last_tcp >= 15:
+            last_tcp = now
+            refused_streak = refused_streak + 1 if tunnel_tcp_refused() \
+                else 0
+            if refused_streak >= 2:
+                kill_reason = "tunnel TCP endpoint refused twice"
+        if kill_reason:
             try:
-                rows.append(json.loads(ln))
-            except json.JSONDecodeError:
-                continue
-            record(tag, rows[-1])
-        if r.returncode != 0:
-            record(tag, {"error": r.stderr[-800:] or f"rc={r.returncode}"})
-        live = r.returncode == 0 and rows and all(
-            _row_is_live(row) for row in rows)
-        log(f"{tag}: done rc={r.returncode} ({len(rows)} rows"
-            + ("" if live else ", NOT live — will retry") + ")")
-        return live
-    except subprocess.TimeoutExpired:
-        record(tag, {"error": f"timeout after {timeout}s"})
-        log(f"{tag}: TIMEOUT")
+                os.killpg(proc.pid, 9)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            break
+    proc.wait()
+    out_r.join(timeout=10)
+    err_r.join(timeout=10)
+    rc = proc.returncode
+    rows = []
+    for ln in out_r.lines:
+        if not ln.startswith("{"):
+            continue
+        try:
+            rows.append(json.loads(ln))
+        except json.JSONDecodeError:
+            continue
+        record(tag, rows[-1])
+    stderr_tail = "".join(err_r.lines)[-800:]
+    if kill_reason:
+        record(tag, {"error": f"killed by watchdog ({kill_reason})"})
+        log(f"{tag}: KILLED ({kill_reason})")
         return False
+    if rc != 0:
+        record(tag, {"error": stderr_tail or f"rc={rc}"})
+    live = rc == 0 and rows and all(_row_is_live(row) for row in rows)
+    log(f"{tag}: done rc={rc} ({len(rows)} rows"
+        + ("" if live else ", NOT live — will retry") + ")")
+    return live
 
 
 def tpu_probe(timeout_s=120):
@@ -109,77 +199,112 @@ def main():
                              "rows will carry errors/CPU-smoke values)")
     args = parser.parse_args()
     only = set(args.only.split(","))
+    known = set(ALL_GROUPS.split(","))
+    unknown = only - known
+    if unknown:
+        # Fail loudly: groups were renamed in round 5 (fine-grained
+        # retries) — a caller holding old names (e.g. a probe loop from
+        # a previous round still in memory) would otherwise silently
+        # filter the plan down to nothing and mark everything captured.
+        log(f"unknown group(s) {sorted(unknown)}; valid: {ALL_GROUPS}")
+        return 1
 
+    tcp_watch = False
     if not args.force:
         alive, detail = tpu_probe()
         if not alive:
             log(f"TPU not reachable ({detail}); nothing captured")
             return 1
+        # The probe succeeded, so the relay exists on this box — the
+        # TCP-refused watchdog signal is meaningful (and NOT meaningful
+        # under --force on a relay-less dev box, where the port is dead
+        # by construction and every row would be killed at ~20 s).
+        tcp_watch = True
     log("capturing" + ("" if not args.force else " (--force: TPU state unverified)"))
     py = sys.executable
 
-    # Ordered measurement plan: (group, tag, cmd, kwargs). Executed
-    # sequentially; after any failure the tunnel is re-probed and, if it
-    # is gone, the pass aborts — every group without a live row stays
-    # pending for the probe loop's next UP window instead of burning a
-    # 30-minute timeout per remaining row against a wedged tunnel.
+    # Ordered measurement plan: (group, tag, cmd, kwargs), VALUE-ORDERED
+    # (VERDICT r4 next-round #1): never-measured head-to-heads first —
+    # BERT-Large seq128/512 (the reference's headline recipe), then the
+    # 760M/1.5B offload north star + the capacity ladder, then the
+    # long-context studies, then the A/Bs (chunked CE, Pallas Adam), and
+    # the already-measured flagship LAST. Groups are fine-grained so a
+    # retry after a tunnel drop re-runs only what was actually lost.
+    # Executed sequentially; after any failure the tunnel is re-probed
+    # and, if gone, the pass aborts — remaining groups stay pending for
+    # the probe loop's next UP window.
     plan = [
-        # flagship 350M + remat-policy variants + the Pallas-Adam A/B
-        ("gpt2", "gpt2_350m", [py, "bench.py"], {}),
-        ("gpt2", "gpt2_350m_dots", [py, "bench.py"],
-         {"env": {"BENCH_REMAT": "1"}}),
-        ("gpt2", "gpt2_350m_pallas_adam", [py, "bench.py"],
-         {"env": {"BENCH_PALLAS_ADAM": "1"}}),
-        ("gpt2_chunked", "gpt2_350m_chunked", [py, "bench.py"],
-         {"env": {"BENCH_LOSS_CHUNK": "512"}}),
-        ("gpt2_chunked", "gpt2_350m_chunked_bs16", [py, "bench.py"],
-         {"env": {"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "16"}}),
-        ("gpt2_chunked", "gpt2_350m_chunked_bs32", [py, "bench.py"],
-         {"env": {"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "32"}}),
-        # Longer sequence at constant tokens/step: attention fraction
-        # doubles (flash), logits cost per token constant.
-        ("gpt2_chunked", "gpt2_350m_chunked_seq2048", [py, "bench.py"],
-         {"env": {"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "4",
-                  "BENCH_SEQ": "2048"}}),
-        # BERT: default dropout 0.1 (the reference's recipe, in-kernel
-        # since round 4); the nodrop row isolates the dropout cost
-        ("bert", "bert_large", [py, "bench.py"],
+        # 1. The reference's headline bench: BERT-Large MLM
+        #    (V100: 64 TFLOPS / 272 samples/s seq128; 53 / 52 seq512).
+        ("bert128", "bert_large", [py, "bench.py"],
          {"env": {"BENCH_MODEL": "bert_large"}}),
-        ("bert", "bert_large_nodrop", [py, "bench.py"],
-         {"env": {"BENCH_MODEL": "bert_large", "BENCH_DROPOUT": "0"}}),
-        ("bert", "bert_large_seq512", [py, "bench.py"],
+        ("bert512", "bert_large_seq512", [py, "bench.py"],
          {"env": {"BENCH_MODEL": "bert_large", "BENCH_SEQ": "512"}}),
-        # seq512: at seq128 the fixed local window covers the whole
-        # layout (fully dense) and would measure nothing sparse
-        ("bert", "bert_large_sparse", [py, "bench.py"],
-         {"env": {"BENCH_MODEL": "bert_large", "BENCH_SPARSE": "1",
-                  "BENCH_SEQ": "512"}}),
-        ("offload", "gpt2_760m_offload", [py, "bench.py"],
-         {"env": {"BENCH_MODEL": "gpt2_760m"}, "timeout": 2400}),
-        ("offload", "gpt2_1.5b_offload", [py, "bench.py"],
-         {"env": {"BENCH_MODEL": "gpt2_1.5b"}, "timeout": 3600}),
-        ("longctx", "longctx_speed",
+        # 2. Offload north star (reference: 13B on one 32 GB V100).
+        ("off760", "gpt2_760m_offload", [py, "bench.py"],
+         {"env": {"BENCH_MODEL": "gpt2_760m"},
+          "timeout": 1500, "stall": 600}),
+        ("off15", "gpt2_1.5b_offload", [py, "bench.py"],
+         {"env": {"BENCH_MODEL": "gpt2_1.5b"},
+          "timeout": 2100, "stall": 900}),
+        # 3. Capacity ladder: max trainable size on one 16 GB v5e.
+        ("capacity", "capacity_ladder", [py, "bench.py"],
+         {"env": {"BENCH_MODEL": "capacity"},
+          "timeout": 3000, "stall": 900}),
+        # 4. Long-context studies (reference README: 6.3x / 10x claims).
+        ("lc_speed", "longctx_speed",
          [py, "benchmarks/long_context.py", "--study", "speed"],
-         {"timeout": 2400}),
-        ("longctx", "longctx_maxseq",
+         {"timeout": 1500, "stall": 600}),
+        ("lc_max", "longctx_maxseq",
          [py, "benchmarks/long_context.py", "--study", "maxseq"],
-         {"timeout": 2400}),
+         {"timeout": 1500, "stall": 600}),
         ("sweep", "block_sweep",
          [py, "benchmarks/long_context.py", "--study", "block"],
-         {"timeout": 2400}),
+         {"timeout": 1500, "stall": 600}),
+        # 5. Chunked-CE A/B (+ batch/seq scaling enabled by its memory
+        #    savings).
+        ("chunked", "gpt2_350m_chunked", [py, "bench.py"],
+         {"env": {"BENCH_LOSS_CHUNK": "512"}, "timeout": 600}),
+        ("chunked", "gpt2_350m_chunked_bs16", [py, "bench.py"],
+         {"env": {"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "16"},
+          "timeout": 600}),
+        ("chunked", "gpt2_350m_chunked_bs32", [py, "bench.py"],
+         {"env": {"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "32"},
+          "timeout": 600}),
+        # Longer sequence at constant tokens/step: attention fraction
+        # doubles (flash), logits cost per token constant.
+        ("chunked", "gpt2_350m_chunked_seq2048", [py, "bench.py"],
+         {"env": {"BENCH_LOSS_CHUNK": "512", "BENCH_BS": "4",
+                  "BENCH_SEQ": "2048"}, "timeout": 600}),
+        # 6. Pallas-Adam A/B (validate-or-delete, VERDICT r4 #5).
+        ("padam", "gpt2_350m_pallas_adam", [py, "bench.py"],
+         {"env": {"BENCH_PALLAS_ADAM": "1"}, "timeout": 600}),
+        # 7. BERT variants: dropout-cost isolation + sparse attention
+        #    (seq512: at seq128 the local window covers the whole layout).
+        ("bertx", "bert_large_nodrop", [py, "bench.py"],
+         {"env": {"BENCH_MODEL": "bert_large", "BENCH_DROPOUT": "0"}}),
+        ("bertx", "bert_large_sparse", [py, "bench.py"],
+         {"env": {"BENCH_MODEL": "bert_large", "BENCH_SPARSE": "1",
+                  "BENCH_SEQ": "512"}}),
+        # 8. Flagship refresh (already measured live in r4) + remat A/B.
+        ("gpt2", "gpt2_350m", [py, "bench.py"], {"timeout": 600}),
+        ("gpt2", "gpt2_350m_dots", [py, "bench.py"],
+         {"env": {"BENCH_REMAT": "1"}, "timeout": 600}),
         # Last: measured step-time attribution (ANALYSIS_MFU's budget
         # table from a real device trace instead of a model).
         ("profile", "profile_350m",
-         [py, "benchmarks/profile_step.py"], {"timeout": 2400}),
+         [py, "benchmarks/profile_step.py"],
+         {"timeout": 1200, "stall": 600}),
         ("profile", "profile_350m_chunked",
          [py, "benchmarks/profile_step.py"],
-         {"env": {"BENCH_LOSS_CHUNK": "512"}, "timeout": 2400}),
+         {"env": {"BENCH_LOSS_CHUNK": "512"},
+          "timeout": 1200, "stall": 600}),
     ]
     plan = [step for step in plan if step[0] in only]
 
     failed = set()
     for i, (group, tag, cmd, kw) in enumerate(plan):
-        if not run(tag, cmd, **kw):
+        if not run(tag, cmd, tcp_watch=tcp_watch, **kw):
             failed.add(group)
             # Same 120 s liveness threshold as the startup gate and the
             # probe loop — a shorter probe here would abort a rare live

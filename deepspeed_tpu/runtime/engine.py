@@ -137,6 +137,7 @@ def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None,
     (`zero/sharding.py:make_param_caster`) so param all-gathers ride the
     wire at 16 bit."""
 
+    user_caster = cast_params
     if cast_params is None:
         def cast_params(p):
             return jax.tree_util.tree_map(
@@ -147,6 +148,14 @@ def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None,
     # 1F1B pipeline (pipe/pipeline.py:make_pipeline_value_and_grad_fn)
     # interleaves forward and backward ticks, which AD cannot.
     direct = getattr(loss_fn, "direct_value_and_grad", None)
+    if direct is not None and user_caster is not None:
+        # ADVICE r4: the direct path runs the loss_fn's own casts, so a
+        # ZeRO-3 cast-then-gather caster built for it would silently fall
+        # back to XLA's fp32 gather-then-cast — surface the lost
+        # param-traffic halving instead of eating it.
+        log_dist("cast_params is ignored on the direct value-and-grad "
+                 "path: the 16-bit cast-then-gather wire does not apply; "
+                 "param gathers will ride at fp32", ranks=[0])
 
     def micro_grads(params, micro_batch, rng, scale, loss_kwargs):
         if direct is not None:
@@ -287,6 +296,16 @@ class DeepSpeedEngine:
             log_dist("offload_16bit_grads: true has no effect without "
                      "cpu_offload: true (grads only cross the wire on the "
                      "offload path)", ranks=[0])
+        if self._config.zero_config.offload_16bit_grads and \
+                self._offload and self._config.fp16_enabled:
+            # ADVICE r4: the 16-bit wire is bf16-gated (fp16 would flush
+            # unscaled sub-6e-5 grad components) — say so instead of
+            # silently transferring fp32.
+            log_dist("offload_16bit_grads: true is inert under fp16 "
+                     "compute (grads are unscaled on device before "
+                     "transfer; fp16 would flush sub-6e-5 components). "
+                     "Grads transfer at fp32 — use bf16 to get the "
+                     "16-bit wire", ranks=[0])
         if self._offload:
             # ZeRO-Offload (reference stage2.py cpu_offload + csrc cpu_adam):
             # fp32 masters + moments live in host RAM inside the C++

@@ -113,19 +113,21 @@ def test_chunked_xent_with_zero3_matches_dense_curve():
     reads params['wte'] directly — GSPMD must handle the sharded table
     inside the scan body identically to the dense head).
 
-    Tolerance note (round-4 diagnosis of the round-3 red run): in bf16 the
-    chunked and dense curves differ by ~1.5e-4 after a few Adam steps. That
-    is NOT a ZeRO-3 interaction — the chunked-vs-dense divergence is
-    bitwise-identical at stages 0, 1 and 3 — it is bf16 rounding of the
-    ``wte`` cotangent: the scan accumulates per-chunk head gradients with
-    bf16 adds while the dense head computes one fp32-accumulated matmul.
-    Measured against an fp64 oracle, dense dwte is itself 2.5e-3 off and
-    chunked 4e-3 — both at the bf16 noise floor (eps 2^-8 ≈ 4e-3), and in
-    fp32 the two curves agree to 1e-7 (and grads to 5e-5, see
-    test_chunked_loss_fn_grads_match_dense). So this test asserts the two
-    things that are actually exact: ZeRO-3 must be loss-transparent
-    (sharded == unsharded curve, tight), and chunked-vs-dense must sit at
-    the bf16 noise floor (2e-3, ~10x the observed 1.5e-4)."""
+    Tolerance history: round 3 observed ~1.5e-4 curve divergence — bf16
+    rounding of per-chunk ``wte`` cotangent partials in the scan
+    accumulation (the dense head gets one fp32-accumulated matmul).
+    Round 5 removed that accumulation noise: the head primal stays fp32
+    across the scan and the per-chunk cotangent is produced directly in
+    fp32 (``_head_matmul``'s ``preferred_element_type`` backward), so
+    cross-chunk sums never round to bf16. Measured divergence is now
+    ~3.9e-5 after 5 Adam steps. The residue is irreducible for ANY
+    chunked algorithm: chunked and dense produce fp32 cotangent sums that
+    differ by summation order (~1e-7 rel), and the single downcast to the
+    bf16 param dtype turns a boundary-straddling 1e-7 difference into a
+    1-ulp (≈4e-3) flip on isolated elements, which Adam then amplifies
+    into small curve drift. So: ZeRO-3 must be loss-transparent (sharded
+    == unsharded curve, tight), and chunked-vs-dense must sit at 2e-4
+    (~5x the observed 3.9e-5, ~8x tighter than the pre-fix bound)."""
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHead,
                                            init_gpt2_params,
@@ -153,5 +155,5 @@ def test_chunked_xent_with_zero3_matches_dense_curve():
     dense_z3 = train(0, 3)
     # ZeRO-3 sharding must not change the chunked curve at all.
     np.testing.assert_allclose(chunked_z3, chunked_z0, rtol=1e-6)
-    # Chunked vs dense: bf16 noise floor only (see docstring).
-    np.testing.assert_allclose(chunked_z3, dense_z3, rtol=2e-3)
+    # Chunked vs dense: fp32-accumulated head cotangent (see docstring).
+    np.testing.assert_allclose(chunked_z3, dense_z3, rtol=2e-4)
