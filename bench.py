@@ -341,7 +341,11 @@ def run_once_gpt2_offload(jax, cfg_fn, batch_size, seq_len, steps,
     dt = time_engine_steps(engine, batch, steps, warmup=1)
     tokens_per_sec = batch_size * seq_len * steps / dt
     tflops = tokens_per_sec * model_flops_per_token(cfg, seq_len) / 1e12
-    return tokens_per_sec, tflops, _peak_hbm(jax)
+    # Host fraction of the step (VERDICT r4 #2 "host wait < 20%"): wall
+    # time of the last overlapped host phase (D2H ∥ C++ Adam ∥ bf16
+    # convert, then upload submit) over the mean step time.
+    host_frac = engine.last_host_phase_s / max(dt / steps, 1e-9)
+    return tokens_per_sec, tflops, _peak_hbm(jax), round(host_frac, 3)
 
 
 def run_once(jax, cfg_fn, batch_size, seq_len, steps, remat, on_tpu):
@@ -440,13 +444,14 @@ def main():
             done = False
             for bs in (4, 2):
                 try:
-                    tps, tflops, peak = run_once_gpt2_offload(
+                    tps, tflops, peak, host_frac = run_once_gpt2_offload(
                         jax, cfg_fn, batch_size=bs, seq_len=1024,
                         steps=int(os.environ.get("BENCH_STEPS", "3")),
                         host_init=host_init)
                     row.update(value=round(tps, 1), bs=bs,
                                vs_baseline=round(tflops / BASELINE_TFLOPS,
-                                                 3), live=True)
+                                                 3), live=True,
+                               host_frac=host_frac)
                     if peak:
                         row["peak_hbm_gb"] = round(peak / 2 ** 30, 2)
                     max_ok, done = n_bil, True
@@ -473,11 +478,22 @@ def main():
             gc.collect()
             if row.get("oom") or "error" in row:
                 break
-        emit({"metric": "capacity ladder max trainable on one v5e-16GB",
-              "value": max_ok, "unit": "B params", "live": True,
-              "vs_baseline": round(max_ok / 13.0, 3),
-              "note": "vs_baseline = fraction of the reference's "
-                      "13B-on-32GB-V100 (v5e has half the HBM)"})
+        # The summary is authoritative ("max trainable") ONLY if the
+        # ladder ended on an OOM or ran out of rungs — a transient error
+        # leaves larger rungs untested, so the row must not claim live.
+        aborted = "error" in row
+        summary = {"metric": "capacity ladder max trainable on one "
+                             "v5e-16GB",
+                   "value": max_ok, "unit": "B params",
+                   "live": not aborted,
+                   "vs_baseline": round(max_ok / 13.0, 3),
+                   "note": "vs_baseline = fraction of the reference's "
+                           "13B-on-32GB-V100 (v5e has half the HBM)"}
+        if aborted:
+            summary["note"] = ("ladder aborted on a non-OOM error before "
+                               "larger rungs were tested; max is a lower "
+                               "bound only. " + summary["note"])
+        emit(summary)
         return
     if bench_model in ("gpt2_1.5b", "gpt2_760m"):
         # North star: largest single-chip model via ZeRO-Offload.
@@ -492,14 +508,15 @@ def main():
         name = bench_model[5:]
         try:
             bs = int(os.environ.get("BENCH_BS", "4"))
-            tps, tflops, peak = run_once_gpt2_offload(
+            tps, tflops, peak, host_frac = run_once_gpt2_offload(
                 jax, cfg_fn, batch_size=bs, seq_len=1024,
                 steps=int(os.environ.get("BENCH_STEPS", "3")))
             out = {"metric": f"GPT-2 {name} ZeRO-Offload train "
                              f"tokens/sec/chip (bf16, seq1024, bs{bs}, "
                              "remat, chunked-CE)",
                    "value": round(tps, 1), "unit": "tokens/sec/chip",
-                   "vs_baseline": round(tflops / BASELINE_TFLOPS, 3)}
+                   "vs_baseline": round(tflops / BASELINE_TFLOPS, 3),
+                   "host_frac": host_frac}
             if peak:
                 out["peak_hbm_gb"] = round(peak / 2 ** 30, 2)
             out["live"] = True

@@ -65,9 +65,15 @@ class DeepSpeedCPUAdam:
         self.exp_avg_sq = np.zeros(self.total, np.float32)
         self._step = 0
         self._grad_buf = np.empty(self.total, np.float32)
+        self._pool = None        # lazy 1-thread worker for step_overlapped
+        self._chunks = None
+        self._chunk_bytes = None
+        self._bf16_buf = None
 
     def __del__(self):
         try:
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
             self.lib.ds_destroy_adam(self.opt_id)
         except Exception:
             pass
@@ -89,6 +95,98 @@ class DeepSpeedCPUAdam:
             _fptr(self.master), _fptr(self._grad_buf), _fptr(self.exp_avg),
             _fptr(self.exp_avg_sq), ctypes.c_int64(self.total))
         assert rc == 0, f"ds_adam_step failed with {rc}"
+        return self.params()
+
+    # -- overlapped step ---------------------------------------------------
+    def _chunk_plan(self, chunk_bytes):
+        """Group whole leaves into contiguous flat ranges of ~chunk_bytes.
+
+        Chunks are leaf-aligned because the D2H copy granularity is the
+        leaf (``np.asarray`` materializes a whole array); a leaf larger
+        than the target gets its own chunk — its Adam still overlaps the
+        copies of the leaves that follow it."""
+        target = max(1, chunk_bytes // 4)      # fp32 elements
+        chunks = []                            # (leaf_lo, leaf_hi, off, n)
+        i = 0
+        while i < len(self.sizes):
+            j, n = i, 0
+            while j < len(self.sizes) and (n == 0 or
+                                           n + self.sizes[j] <= target):
+                n += self.sizes[j]
+                j += 1
+            chunks.append((i, j, self.offsets[i], n))
+            i = j
+        return chunks
+
+    def _update_range(self, step, lr, beta1, off, n, to_bf16):
+        """Adam (+ optional bf16 convert) on flat range [off, off+n) —
+        the worker half of the overlapped step. The C kernel is stateless
+        per call (config lookup only) and elementwise, so range calls are
+        bitwise-identical to one full-buffer call."""
+        rc = self.lib.ds_adam_step(
+            self.opt_id, ctypes.c_int64(step), ctypes.c_float(lr),
+            ctypes.c_float(beta1), _fptr(self.master[off:]),
+            _fptr(self._grad_buf[off:]), _fptr(self.exp_avg[off:]),
+            _fptr(self.exp_avg_sq[off:]), ctypes.c_int64(n))
+        assert rc == 0, f"ds_adam_step failed with {rc}"
+        if to_bf16:
+            self.lib.ds_fp32_to_bf16(
+                _fptr(self.master[off:]),
+                self._bf16_buf[off:].ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint16)),
+                ctypes.c_int64(n))
+
+    def step_overlapped(self, grads, lr=None, beta1=None, bf16_out=False,
+                        chunk_bytes=1 << 26):
+        """One Adam step with the host phase software-pipelined.
+
+        The reference's ZeRO-Offload is an overlap design (stage2.py:793
+        async grad D2H during backward; cpu_adam.cpp fused async fp16
+        copy-back). The TPU analog: start async D2H for EVERY grad leaf
+        up front (``copy_to_host_async``), then walk leaf-aligned chunks —
+        the main thread lands chunk k+1's bytes into the flat grad buffer
+        (blocking only until that leaf's transfer arrives) while a worker
+        thread runs the C++ Adam (and, with ``bf16_out``, the fused
+        fp32→bf16 convert) on chunk k. ctypes releases the GIL, so copy
+        and compute genuinely overlap. Chunk ranges are disjoint across
+        master/grad/moment/bf16 buffers — no locking needed.
+
+        Returns the params pytree (fp32 views), or with ``bf16_out`` the
+        flat bf16 master copy ready for one device upload.
+        """
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(max_workers=1)
+        if self._chunks is None or chunk_bytes != self._chunk_bytes:
+            self._chunks = self._chunk_plan(chunk_bytes)
+            self._chunk_bytes = chunk_bytes
+        if bf16_out and self._bf16_buf is None:
+            self._bf16_buf = np.empty(self.total, np.uint16)
+        g_leaves = self.treedef.flatten_up_to(grads)
+        for g in g_leaves:
+            start = getattr(g, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:
+                    pass           # non-addressable/committed: asarray blocks
+        self._step += 1
+        step = self._step
+        eff_lr = -1.0 if lr is None else lr
+        eff_b1 = -1.0 if beta1 is None else beta1
+        futs = []
+        for (li, lj, off, n) in self._chunks:
+            for k in range(li, lj):
+                o, s = self.offsets[k], self.sizes[k]
+                self._grad_buf[o:o + s] = np.asarray(
+                    g_leaves[k], np.float32).reshape(-1)
+            futs.append(self._pool.submit(
+                self._update_range, step, eff_lr, eff_b1, off, n, bf16_out))
+        for f in futs:
+            f.result()             # propagate worker failures
+        if bf16_out:
+            import ml_dtypes
+            return self._bf16_buf.view(ml_dtypes.bfloat16)
         return self.params()
 
     def params(self):

@@ -313,10 +313,21 @@ class DeepSpeedEngine:
             # and the compiled step produces gradients, not updates.
             assert self.optimizer_name in (ADAM_OPTIMIZER, "adamw"), (
                 f"cpu_offload supports adam/adamw, got {self.optimizer_name}")
-            assert jax.process_count() == 1, (
-                "cpu_offload fetches the full gradient to this host's RAM; "
-                "multi-process (multi-host) offload with per-process shards "
-                "is not implemented yet")
+            # Offload×DP (round 5, reference stage-2 offload semantics:
+            # each rank updates only its gradient partition,
+            # stage2.py:1410-1423): under multi-process the compiled step
+            # emits the gradient as a flat [D, chunk] array sharded over
+            # the data axis, each process's host Adam updates its
+            # contiguous shard of the flat master buffer, and the updated
+            # params reassemble on device via an XLA all-gather riding
+            # ICI — no host-side parameter exchange.
+            self._offload_dp = jax.process_count() > 1
+            if self._offload_dp:
+                other = {k: v for k, v in self.mesh.shape.items()
+                         if k != "data" and v > 1}
+                assert not other, (
+                    "multi-process cpu_offload supports pure data-parallel "
+                    f"meshes only; non-data axes present: {other}")
             from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
             opt_params = dict(self._config.optimizer_params or {})
             self.cpu_optimizer = DeepSpeedCPUAdam(
@@ -328,8 +339,13 @@ class DeepSpeedEngine:
                 bias_correction=opt_params.get("bias_correction", True),
                 adamw_mode=opt_params.get("adam_w_mode",
                                           self.optimizer_name == "adamw"))
+            if self._offload_dp:
+                D = self.mesh.shape["data"]
+                self._off_D = D
+                self._off_chunk = -(-self.cpu_optimizer.total // D)
             self.params = self._upload_offload_params()
             self.opt_state = None
+            self.last_host_phase_s = 0.0
         else:
             self.cpu_optimizer = None
             # Copy (never alias) the caller's params: the compiled train
@@ -794,13 +810,15 @@ class DeepSpeedEngine:
         # with donation suffices.
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
-    def _upload_offload_params(self):
+    def _upload_offload_params(self, flat_bf16=None):
         """Device copy of the host fp32 masters at compute dtype. Under
         bf16 the conversion runs in the fused C++ kernel on one flat buffer
-        (the reference's fused fp16 copy-back, csrc/adam/cpu_adam.cpp)."""
+        (the reference's fused fp16 copy-back, csrc/adam/cpu_adam.cpp);
+        ``flat_bf16`` passes a buffer that ``step_overlapped`` already
+        converted chunk-by-chunk under the copy/compute overlap."""
         opt = self.cpu_optimizer
         if self.compute_dtype == jnp.bfloat16:
-            flat = opt.params_bf16_flat()
+            flat = opt.params_bf16_flat() if flat_bf16 is None else flat_bf16
             leaves = [flat[off:off + size].reshape(shape)
                       for off, size, shape in zip(opt.offsets, opt.sizes,
                                                   opt.shapes)]
@@ -834,6 +852,13 @@ class DeepSpeedEngine:
                        compute_dtype == jnp.bfloat16)
         accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum)
         pld_fn = self._pld_theta_fn()
+        # Offload×DP: emit the gradient as a flat [D, chunk] array sharded
+        # over the data axis — each process D2H-pulls only its shard (1/D
+        # of the wire), the stage-2 partition the reference implements
+        # with per-rank IPG buckets (stage2.py:613-738).
+        flat_dp = (self._off_D, self._off_chunk) if self._offload_dp \
+            else None
+        mesh = self.mesh
 
         def grad_step(params, dstate, batch, rng, lr_in):
             scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
@@ -841,10 +866,11 @@ class DeepSpeedEngine:
             loss_kw = {"pld_theta": pld_fn(dstate.global_step)} \
                 if pld_fn is not None else None
             loss_sum, grads = accumulate(params, batch, rng, scale, loss_kw)
-            # No ZeRO grad-sharding constraint here: the full gradient is
-            # about to be fetched to host RAM anyway (the partitioned-
-            # offload variant would fetch per-process shards; this engine
-            # scopes offload to single-process runs, asserted at init).
+            # No ZeRO grad-sharding constraint on the TREE: single-process
+            # offload fetches the full gradient to host RAM; offload×DP
+            # instead reshards the FLAT gradient to [D, chunk] over the
+            # data axis below (flat_dp) so each process pulls only its
+            # 1/D shard — the stage-2 partition, applied post-epilogue.
             grads, overflow, grad_norm, applied_norm = grad_epilogue(
                 grads, scale, accum, fp16, clip)
             if grads_16bit:
@@ -861,6 +887,15 @@ class DeepSpeedEngine:
             metrics = step_metrics(loss_sum, accum, grad_norm, applied_norm,
                                    lr, scale, overflow)
             metrics["beta1"] = beta1
+            if flat_dp is not None:
+                D, chunk = flat_dp
+                leaves = jax.tree_util.tree_leaves(grads)
+                flat = jnp.concatenate([l.reshape(-1) for l in leaves])
+                flat = jnp.pad(flat, (0, D * chunk - flat.shape[0]))
+                flat = jax.lax.with_sharding_constraint(
+                    flat.reshape(D, chunk),
+                    NamedSharding(mesh, PartitionSpec("data")))
+                return flat, dstate_out, metrics
             return grads, dstate_out, metrics
 
         return jax.jit(grad_step, donate_argnums=(1,))
@@ -868,16 +903,141 @@ class DeepSpeedEngine:
     def _train_batch_offload(self, placed, step_rng, lr_in):
         """Host half of the offload step: pull grads, C++ Adam update on
         the masters, push compute-dtype params back (the reference's
-        async_accumulate + CPUAdam.step + copy-back, stage2.py:793-1423)."""
+        async_accumulate + CPUAdam.step + copy-back, stage2.py:793-1423).
+
+        The host phase is software-pipelined (round 5): all grad D2H
+        transfers start async up front, then per ~64 MB leaf-aligned
+        chunk the C++ Adam (+ fused bf16 convert) of chunk k runs in a
+        worker thread while chunk k+1's bytes land — the TPU analog of
+        the reference's overlap design. ``last_host_phase_s`` records the
+        host wall time so bench rows can report the host fraction of the
+        step."""
+        if self._offload_dp:
+            return self._train_batch_offload_dp(placed, step_rng, lr_in)
         grads, self.device_state, metrics = self._compiled_train_step(
             self.params, self.device_state, placed, step_rng, lr_in)
-        if not bool(metrics["overflow"]):
-            host_grads = jax.tree_util.tree_map(
-                lambda g: np.asarray(g), grads)
-            self.cpu_optimizer.step(host_grads, lr=float(metrics["lr"]),
-                                    beta1=float(metrics["beta1"]))
-            self.params = self._upload_offload_params()
+        if not bool(metrics["overflow"]):   # blocks until device step done
+            t0 = time.perf_counter()
+            bf16 = self.compute_dtype == jnp.bfloat16
+            out = self.cpu_optimizer.step_overlapped(
+                grads, lr=float(metrics["lr"]),
+                beta1=float(metrics["beta1"]), bf16_out=bf16)
+            self.params = self._upload_offload_params(
+                flat_bf16=out if bf16 else None)
+            self.last_host_phase_s = time.perf_counter() - t0
         return metrics
+
+    def _train_batch_offload_dp(self, placed, step_rng, lr_in):
+        """Offload×DP host phase (reference stage-2 offload semantics):
+        pull only this process's shard of the flat gradient, C++ Adam on
+        the matching contiguous master range, reassemble full params on
+        device via the XLA all-gather in the assemble jit. Host work and
+        wire bytes are 1/D per process — DP over processes IS the
+        parallelism (the reference parallelizes its CPU Adam the same
+        way: each rank steps its own partition)."""
+        flat_shard, self.device_state, metrics = self._compiled_train_step(
+            self.params, self.device_state, placed, step_rng, lr_in)
+        if bool(metrics["overflow"]):
+            return metrics
+        t0 = time.perf_counter()
+        opt = self.cpu_optimizer
+        chunk, total = self._off_chunk, opt.total
+        shards = list(flat_shard.addressable_shards)
+        for s in shards:
+            start = getattr(s.data, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        rows = []
+        for s in shards:
+            r = s.index[0].start or 0
+            rows.append(r)
+            lo = r * chunk
+            n = max(0, min(chunk, total - lo))
+            if n:
+                opt._grad_buf[lo:lo + n] = np.asarray(
+                    s.data, np.float32).reshape(-1)[:n]
+        rows = sorted(set(rows))
+        assert rows == list(range(rows[0], rows[-1] + 1)), (
+            f"non-contiguous local grad rows {rows}: the flat-shard "
+            "partition assumes process-major device order on the data "
+            "axis")
+        lo = rows[0] * chunk
+        hi = min((rows[-1] + 1) * chunk, total)
+        bf16 = self.compute_dtype == jnp.bfloat16
+        if bf16 and opt._bf16_buf is None:
+            opt._bf16_buf = np.empty(total, np.uint16)
+        opt._step += 1
+        if hi > lo:
+            opt._update_range(opt._step, float(metrics["lr"]),
+                              float(metrics["beta1"]), lo, hi - lo, bf16)
+        self.params = self._offload_assemble_params()
+        self.last_host_phase_s = time.perf_counter() - t0
+        return metrics
+
+    def _scatter_local_rows(self, src, np_dtype):
+        """Global [D, chunk] array over the data axis, each addressable
+        device's row filled from this process's flat host buffer ``src``
+        (zero-padded past ``total``). The one place the host-range ↔
+        data-axis-row mapping lives — used by both the param reassembly
+        and the checkpoint gather."""
+        opt = self.cpu_optimizer
+        D, chunk, total = self._off_D, self._off_chunk, opt.total
+        sharding = NamedSharding(self.mesh, PartitionSpec("data"))
+        imap = sharding.devices_indices_map((D, chunk))
+        arrays = []
+        for d in sharding.addressable_devices:
+            r = imap[d][0].start or 0
+            lo = r * chunk
+            n = max(0, min(chunk, total - lo))
+            row = np.zeros((1, chunk), np_dtype)
+            if n:
+                row[0, :n] = src[lo:lo + n]
+            arrays.append(jax.device_put(row, d))
+        return jax.make_array_from_single_device_arrays(
+            (D, chunk), sharding, arrays)
+
+    def _offload_assemble_params(self):
+        """Build the global [D, chunk] compute-dtype param array from this
+        process's freshly-updated master range and run the assemble jit —
+        XLA inserts the all-gather from the data-sharded input to the
+        engine's param shardings."""
+        opt = self.cpu_optimizer
+        total = opt.total
+        if self.compute_dtype == jnp.bfloat16:
+            import ml_dtypes
+            src = opt._bf16_buf.view(ml_dtypes.bfloat16)
+            np_dtype = ml_dtypes.bfloat16
+        else:
+            src = opt.master
+            np_dtype = np.dtype(self.compute_dtype)
+        garr = self._scatter_local_rows(src, np_dtype)
+        if getattr(self, "_offload_assemble_fn", None) is None:
+            offsets, sizes, shapes = opt.offsets, opt.sizes, opt.shapes
+            treedef = opt.treedef
+
+            def assemble(flat2d):
+                flat = flat2d.reshape(-1)[:total]
+                leaves = [flat[o:o + s].reshape(shp)
+                          for o, s, shp in zip(offsets, sizes, shapes)]
+                return jax.tree_util.tree_unflatten(treedef, leaves)
+
+            self._offload_assemble_fn = jax.jit(
+                assemble, out_shardings=self._shardings["param"])
+        return self._offload_assemble_fn(garr)
+
+    def _offload_sync_host_state(self):
+        """Make every process's full host master/moment buffers current
+        (each process only updates its own range during offload×DP
+        training) — an all-gather at fp32 through the device mesh, used
+        before checkpointing so the saved state is complete and
+        precision-lossless."""
+        opt = self.cpu_optimizer
+        total = opt.total
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        gather = jax.jit(lambda x: x, out_shardings=rep)
+        for buf in (opt.master, opt.exp_avg, opt.exp_avg_sq):
+            garr = self._scatter_local_rows(buf, np.float32)
+            buf[:] = np.asarray(gather(garr)).reshape(-1)[:total]
 
     def _sparse_grad_flags(self):
         """Pytree of bools (params structure): which leaves take the CSR
@@ -1667,7 +1827,10 @@ class DeepSpeedEngine:
         ckptr = ocp.PyTreeCheckpointer()
         # Under cpu_offload the device params are a compute-dtype copy;
         # checkpoint the fp32 host masters instead so no precision is lost
-        # (parity with the non-offload fp32 param save).
+        # (parity with the non-offload fp32 param save). Under offload×DP
+        # each process holds only its own range fresh — gather first.
+        if self._offload and getattr(self, "_offload_dp", False):
+            self._offload_sync_host_state()
         ckpt_params = self.cpu_optimizer.params() if self._offload \
             else self.params
         state = {

@@ -192,14 +192,15 @@ def test_offload_16bit_grads_wire_dtype():
         engine, _, _, _ = deepspeed_tpu.initialize(
             config=cfg, loss_fn=make_gpt2_loss_fn(model), params=params)
         seen = {}
-        real_step = engine.cpu_optimizer.step
+        # The engine's host phase calls the overlapped step (round 5).
+        real_step = engine.cpu_optimizer.step_overlapped
 
         def spy_step(grads, **kw):
             seen["dtype"] = {np.dtype(np.asarray(g).dtype).name
                              for g in jax.tree_util.tree_leaves(grads)}
             return real_step(grads, **kw)
 
-        engine.cpu_optimizer.step = spy_step
+        engine.cpu_optimizer.step_overlapped = spy_step
         rng = np.random.default_rng(0)
         batch = {"input_ids": rng.integers(0, 255, (8, 32)).astype(np.int32)}
         engine.train_batch(batch)
@@ -209,3 +210,57 @@ def test_offload_16bit_grads_wire_dtype():
     # fp16: the 16-bit-transfer gate must NOT engage (fp32 on the wire).
     run_one({"fp16": {"enabled": True, "initial_scale_power": 8}},
             "float32")
+
+
+def test_step_overlapped_matches_serial_step():
+    """The software-pipelined host phase (round 5 overlap: async D2H +
+    per-chunk worker-thread Adam + fused bf16 convert) must match the
+    serial step to fp32 ulp noise. Not bitwise: the kernel's SIMD body
+    uses FMA while its scalar tail doesn't, and chunking moves the
+    SIMD/tail boundaries — elements near a boundary differ in the last
+    ulp of one mul-add. The per-chunk bf16 convert IS exact vs the
+    one-shot kernel on the same masters (pure elementwise rounding)."""
+    rng = np.random.default_rng(7)
+    # Multiple leaves incl. one large enough to exceed a tiny chunk
+    # budget, so the plan produces several chunks AND a leaf-own chunk.
+    sizes = ((1024, 16), (4096,), (7,), (513, 3), (64, 64))
+    params = _rand_tree(rng, sizes=sizes)
+    serial = DeepSpeedCPUAdam(params, lr=0.01, betas=(0.9, 0.99),
+                              weight_decay=0.01)
+    overlap = DeepSpeedCPUAdam(params, lr=0.01, betas=(0.9, 0.99),
+                               weight_decay=0.01)
+    for i in range(4):
+        grads = _rand_tree(rng, sizes=sizes)
+        serial.step(grads, lr=0.01)
+        flat16 = overlap.step_overlapped(
+            grads, lr=0.01, bf16_out=True, chunk_bytes=32 * 1024)
+        assert len(overlap._chunks) >= 3, overlap._chunks
+        np.testing.assert_allclose(serial.master, overlap.master,
+                                   rtol=1e-5, atol=1e-7,
+                                   err_msg=f"step {i}")
+        np.testing.assert_allclose(serial.exp_avg, overlap.exp_avg,
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(serial.exp_avg_sq, overlap.exp_avg_sq,
+                                   rtol=1e-5, atol=1e-9)
+        # Per-chunk fused convert == one-shot kernel on the SAME buffer.
+        np.testing.assert_array_equal(
+            np.asarray(flat16).view(np.uint16),
+            np.asarray(overlap.params_bf16_flat()).view(np.uint16),
+            err_msg=f"bf16 step {i}")
+
+
+def test_step_overlapped_takes_jax_device_grads():
+    """step_overlapped's async-D2H path (copy_to_host_async) with real
+    jax arrays, including bf16 grads (the 16-bit offload wire)."""
+    rng = np.random.default_rng(8)
+    params = _rand_tree(rng, sizes=((33, 9), (257,)))
+    a = DeepSpeedCPUAdam(params, lr=0.05)
+    b = DeepSpeedCPUAdam(params, lr=0.05)
+    grads = _rand_tree(rng, sizes=((33, 9), (257,)))
+    jgrads16 = jax.tree_util.tree_map(
+        lambda g: jnp.asarray(g, jnp.bfloat16), grads)
+    host16 = jax.tree_util.tree_map(
+        lambda g: np.asarray(g).astype(np.float32), jgrads16)
+    a.step(host16)
+    b.step_overlapped(jgrads16, chunk_bytes=1024)
+    np.testing.assert_allclose(a.master, b.master, rtol=1e-5, atol=1e-7)

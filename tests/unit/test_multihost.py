@@ -14,7 +14,11 @@ import subprocess
 import sys
 import textwrap
 
+import numpy as np
 import pytest
+
+import jax
+import jax.numpy as jnp
 
 WORKER = textwrap.dedent("""
     import json, os, sys
@@ -127,3 +131,109 @@ def test_partial_env_missing_process_id_raises(monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     with pytest.raises(RuntimeError, match="DS_TPU_PROCESS_ID"):
         mesh.initialize_distributed()
+
+
+OFFLOAD_WORKER = textwrap.dedent("""
+    import json, os, sys
+    os.environ.pop("JAX_PLATFORMS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, %(repo)r)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (GPT2LMHead, gpt2_tiny,
+                                           init_gpt2_params,
+                                           make_gpt2_loss_fn)
+    from deepspeed_tpu.parallel import initialize_distributed
+    initialize_distributed()
+
+    model = GPT2LMHead(gpt2_tiny())
+    params = init_gpt2_params(model, jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": 8,
+                "zero_optimization": {"stage": 2, "cpu_offload": True},
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "steps_per_print": 1000},
+        loss_fn=make_gpt2_loss_fn(model), params=params)
+    assert engine._offload_dp, "2-process offload must take the DP path"
+    pid = jax.process_index()
+    rng = np.random.default_rng(0)
+    full = rng.integers(0, 255, (8, 32)).astype(np.int32)
+    batch = {"input_ids": full[pid * 4:(pid + 1) * 4]}
+    losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    # After sync, BOTH processes must hold identical full fp32 masters
+    # (each trained only its own range) — the checkpoint-completeness
+    # contract of _offload_sync_host_state.
+    engine._offload_sync_host_state()
+    digest = float(np.abs(engine.cpu_optimizer.master).sum())
+    m_digest = float(np.abs(engine.cpu_optimizer.exp_avg).sum())
+    print("RESULT " + json.dumps({"pid": pid, "losses": losses,
+                                  "digest": digest, "m": m_digest}))
+""")
+
+
+@pytest.mark.slow
+def test_two_process_offload_dp_matches_single_process(tmp_path):
+    """Offload×DP (round 5): two processes each update their shard of the
+    flat master buffer; the loss curve must match a single-process offload
+    engine fed the identical global batch, and the post-sync host state
+    must be identical across processes."""
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    script = tmp_path / "offload_worker.py"
+    script.write_text(OFFLOAD_WORKER % {"repo": repo})
+
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "DS_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "DS_TPU_NUM_PROCESSES": "2",
+            "DS_TPU_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = {}
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            for line in out.splitlines():
+                if line.startswith("RESULT "):
+                    rec = json.loads(line[len("RESULT "):])
+                    results[rec["pid"]] = rec
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    assert set(results) == {0, 1}
+    assert results[0]["losses"] == results[1]["losses"]
+    np.testing.assert_allclose(results[0]["digest"], results[1]["digest"],
+                               rtol=1e-7)
+    np.testing.assert_allclose(results[0]["m"], results[1]["m"], rtol=1e-7)
+
+    # Single-process oracle: same model, same GLOBAL batch, serial offload.
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (GPT2LMHead, gpt2_tiny,
+                                           init_gpt2_params,
+                                           make_gpt2_loss_fn)
+    model = GPT2LMHead(gpt2_tiny())
+    params = init_gpt2_params(model, jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config={"train_batch_size": 8,
+                "zero_optimization": {"stage": 2, "cpu_offload": True},
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "steps_per_print": 1000},
+        loss_fn=make_gpt2_loss_fn(model), params=params)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 255, (8, 32)).astype(np.int32)}
+    oracle = [float(engine.train_batch(batch)) for _ in range(3)]
+    # bf16 grads psum-reduce at fp32; 8-shard vs 2-shard order noise only
+    np.testing.assert_allclose(results[0]["losses"], oracle, rtol=1e-4)
