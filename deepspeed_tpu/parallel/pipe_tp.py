@@ -127,27 +127,42 @@ def layer_norm(x, scale, bias, eps=1e-5):
     return (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
 
 
-def _tp_block_params(rng, d_model, n_head, ffn):
-    """The shared param-leaf set of both TP blocks (names double as the
-    sharding contract — see module docstring)."""
+def tp_attention_params(rng, d_model, n_head):
+    """The attention half of the TP param-leaf contract (names double as
+    the sharding contract — see module docstring). Shared by the dense
+    TP blocks here and the MoE composition (`pipe_tp_moe.py`), so the
+    head-major packing and init scale live in exactly one place."""
     M, H = d_model, n_head
     D = M // H
-    ks = jax.random.split(rng, 4)
+    ks = jax.random.split(rng, 2)
     init = nn.initializers.normal(0.02)
     return {
         "ln1_scale": jnp.ones((M,), jnp.float32),
         "ln1_bias": jnp.zeros((M,), jnp.float32),
-        "ln2_scale": jnp.ones((M,), jnp.float32),
-        "ln2_bias": jnp.zeros((M,), jnp.float32),
         "mp_qkv": init(ks[0], (3 * H * D, M), jnp.float32),
         "mp_qkv_b": jnp.zeros((3 * H * D,), jnp.float32),
         "mp_proj": init(ks[1], (H * D, M), jnp.float32),
         "proj_b": jnp.zeros((M,), jnp.float32),
-        "mp_fc": init(ks[2], (ffn, M), jnp.float32),
-        "mp_fc_b": jnp.zeros((ffn,), jnp.float32),
-        "mp_fc_out": init(ks[3], (ffn, M), jnp.float32),
-        "fc_out_b": jnp.zeros((M,), jnp.float32),
     }
+
+
+def _tp_block_params(rng, d_model, n_head, ffn):
+    """The shared param-leaf set of both dense TP blocks: attention half
+    plus the column/row-parallel MLP."""
+    M = d_model
+    ka, km = jax.random.split(rng)
+    ks = jax.random.split(km, 2)
+    init = nn.initializers.normal(0.02)
+    p = tp_attention_params(ka, d_model, n_head)
+    p.update({
+        "ln2_scale": jnp.ones((M,), jnp.float32),
+        "ln2_bias": jnp.zeros((M,), jnp.float32),
+        "mp_fc": init(ks[0], (ffn, M), jnp.float32),
+        "mp_fc_b": jnp.zeros((ffn,), jnp.float32),
+        "mp_fc_out": init(ks[1], (ffn, M), jnp.float32),
+        "fc_out_b": jnp.zeros((M,), jnp.float32),
+    })
+    return p
 
 
 # ---------------------------------------------------------------------------

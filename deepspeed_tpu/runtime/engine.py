@@ -631,6 +631,15 @@ class DeepSpeedEngine:
         sample = jax.eval_shape(self.opt_init_fn, self.params)
         from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdamState
         if isinstance(sample, OnebitAdamState):
+            if sample.worker_error.ndim == 4:
+                # pipeline x model x 1-bit (three-way buffer split):
+                # [stages, model, data_world, padded_local]. Latent until
+                # data > stages: the 2-D default spec below sharded dim 0
+                # over "data", which only divided by accident at data=2.
+                err = NamedSharding(
+                    self.mesh, PartitionSpec("pipe", "model", "data", None))
+                return OnebitAdamState(m=opt, v=opt, step=rep,
+                                       worker_error=err, server_error=err)
             if sample.worker_error.ndim == 3:
                 # pipeline x 1-bit: [stages, data_world, padded_local]
                 err = NamedSharding(self.mesh,
