@@ -125,11 +125,16 @@ def fold_in_seed(seed, data):
     return _fmix32(h)
 
 
-def _dropout_multiplier_full(B, H, T, S, rate, seed):
+def _dropout_multiplier_full(B, H, T, S, rate, seed, head_offset=0,
+                             num_heads=None):
     """The [B, H, T, S] dropout multiplier the kernels generate tile-wise,
     materialized whole (dense reference / tests). Head coordinate is the
-    folded bh = b*H + h index, matching the kernels' grid dim 0."""
-    bh = (jnp.arange(B)[:, None] * H
+    GLOBAL folded b*Hg + head_offset + h index — with the defaults
+    (offset 0, Hg = H) that is the plain bh = b*H + h of the kernels'
+    grid dim 0; under tensor parallelism the local heads are a slice and
+    the globalized coordinate keeps the mask invariant to the sharding."""
+    Hg = H if num_heads is None else num_heads
+    bh = (jnp.arange(B)[:, None] * Hg + head_offset
           + jnp.arange(H)[None, :])                        # [B, H]
     return dropout_multiplier(
         seed, bh[:, :, None, None],
@@ -139,12 +144,15 @@ def _dropout_multiplier_full(B, H, T, S, rate, seed):
 
 def dense_attention(q, k, v, causal=True, sm_scale=None,
                     key_padding_mask=None, key_bias=None,
-                    dropout_rate=0.0, dropout_seed=None):
+                    dropout_rate=0.0, dropout_seed=None,
+                    dropout_head_offset=0, dropout_num_heads=None):
     """Plain attention; q,k,v: [B, T, H, D] → [B, T, H, D].
     ``key_padding_mask`` [B, S] bool (True = attend) or ``key_bias``
     [B, S] additive fp32. ``dropout_rate``/``dropout_seed``: attention-prob
     dropout with the shared counter-based mask (post-softmax, matching
-    every other implementation bit-for-bit)."""
+    every other implementation bit-for-bit). ``dropout_head_offset`` /
+    ``dropout_num_heads``: GLOBAL head coordinates when the local heads
+    are a tensor-parallel shard (see :func:`flash_attention`)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     bias = _to_key_bias(key_padding_mask, key_bias)
@@ -159,7 +167,9 @@ def dense_attention(q, k, v, causal=True, sm_scale=None,
     if dropout_rate > 0.0:
         B, T, H, _ = q.shape
         probs = probs * _dropout_multiplier_full(
-            B, H, T, k.shape[1], dropout_rate, dropout_seed)
+            B, H, T, k.shape[1], dropout_rate, dropout_seed,
+            head_offset=dropout_head_offset,
+            num_heads=dropout_num_heads)
     return jnp.einsum("bhts,bshd->bthd", probs.astype(q.dtype), v)
 
 
@@ -168,12 +178,15 @@ def dense_attention(q, k, v, causal=True, sm_scale=None,
 # ---------------------------------------------------------------------------
 
 def _blockwise_attention(q, k, v, causal, sm_scale, block_k=256,
-                         key_bias=None, dropout_rate=0.0, dropout_seed=None):
+                         key_bias=None, dropout_rate=0.0, dropout_seed=None,
+                         dropout_head_offset=0, dropout_num_heads=None):
     """Online-softmax attention; memory O(T * block_k) per head.
     ``key_bias`` [B, S] additive fp32 (resolved by the caller).
     Dropout uses the shared counter-based mask — bitwise-identical to the
     Pallas kernels' — applied to the normalized probs (the l normalizer
-    sums the undropped probs, as softmax-then-dropout requires)."""
+    sums the undropped probs, as softmax-then-dropout requires); head
+    coordinates are globalized via ``dropout_head_offset`` /
+    ``dropout_num_heads`` under tensor parallelism."""
     B, T, H, D = q.shape
     S = k.shape[1]
     if key_bias is None:
@@ -195,7 +208,9 @@ def _blockwise_attention(q, k, v, causal, sm_scale, block_k=256,
     mb = jnp.moveaxis(kpm.reshape(B, n_blocks, block_k), 1, 0)
 
     q_pos = jnp.arange(T)
-    bh_idx = jnp.arange(B)[:, None] * H + jnp.arange(H)[None, :]  # [B, H]
+    Hg = H if dropout_num_heads is None else dropout_num_heads
+    bh_idx = (jnp.arange(B)[:, None] * Hg + dropout_head_offset
+              + jnp.arange(H)[None, :])                           # [B, H]
 
     def body(carry, inputs):
         acc, m, l = carry
@@ -259,19 +274,26 @@ def _from_bh(x, B, H):
 # that stream dwarfs the q/k/v traffic itself.
 def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
                 interpret=False, key_bias=None,
-                dropout_rate=0.0, dropout_seed=None):
+                dropout_rate=0.0, dropout_seed=None,
+                dropout_head_offset=None, dropout_num_heads=None):
     """Returns (out [B,T,H,D], lse [B*H,T,1]) — lse is the softmax row
     logsumexp residual consumed by the backward kernels.
     ``key_bias`` [B, S] additive fp32 rides as a [B, S, 1] array indexed
     per batch (bh // H). ``dropout_rate`` (static) / ``dropout_seed``
     (int32 scalar, SMEM): in-kernel attention-prob dropout — applied to
     the accumulated probs while ``l`` keeps summing the undropped probs
-    (softmax normalizes before dropout zeroes)."""
+    (softmax normalizes before dropout zeroes).
+    ``dropout_head_offset`` (traced int32, rides in SMEM beside the
+    seed) / ``dropout_num_heads`` (static): mask coordinates use the
+    GLOBAL head index off + bh%H (+ b*Hg) so a tensor-parallel head
+    shard reproduces the replicated run's mask bitwise; the defaults
+    reduce to the plain folded bh."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, T, H, D = q.shape
     S = k.shape[1]
+    Hg = H if dropout_num_heads is None else int(dropout_num_heads)
     block_q = min(block_q, T)
     block_k = min(block_k, S)
     assert T % block_q == 0 and S % block_k == 0, (
@@ -331,8 +353,11 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
             m_ref[:, 0] = m_new
             pd = p
             if dropping:
+                # Global head coordinate: bh%H local head + SMEM offset
+                # (+ batch stride Hg). Defaults make this exactly bh.
+                g_head = bh + (bh // H) * (Hg - H) + seed_ref[1]
                 pd = p * dropout_multiplier(
-                    seed_ref[0], bh, q_pos, k_pos, dropout_rate)
+                    seed_ref[0], g_head, q_pos, k_pos, dropout_rate)
             vb = v_ref[0].astype(jnp.float32)              # [bk, D]
             acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
                 pd, vb, (((1,), (0,)), ((), ())),
@@ -359,7 +384,10 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
         args.append(kpm)
     if dropping:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.append(jnp.asarray(dropout_seed, jnp.int32).reshape(1))
+        off = 0 if dropout_head_offset is None else dropout_head_offset
+        args.append(jnp.stack(
+            [jnp.asarray(dropout_seed, jnp.int32).reshape(()),
+             jnp.asarray(off, jnp.int32).reshape(())]))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -384,7 +412,8 @@ def _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
 
 def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
                 interpret=False, key_bias=None,
-                dropout_rate=0.0, dropout_seed=None):
+                dropout_rate=0.0, dropout_seed=None,
+                dropout_head_offset=None, dropout_num_heads=None):
     """FlashAttention-2 backward. Two kernels:
 
     - dQ: grid (BH, n_q, n_k), accumulates dq over KV tiles in VMEM.
@@ -412,11 +441,16 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
 
     in_dtype = q.dtype
     H = q.shape[2]
+    Hg = H if dropout_num_heads is None else int(dropout_num_heads)
     masked = key_bias is not None
     dropping = dropout_rate > 0.0
     kpm = key_bias.astype(jnp.float32)[..., None] if masked else None
-    seed_arr = (jnp.asarray(dropout_seed, jnp.int32).reshape(1)
-                if dropping else None)
+    seed_arr = None
+    if dropping:
+        off = 0 if dropout_head_offset is None else dropout_head_offset
+        seed_arr = jnp.stack(
+            [jnp.asarray(dropout_seed, jnp.int32).reshape(()),
+             jnp.asarray(off, jnp.int32).reshape(())])
     qh, kh, vh = _to_bh(q), _to_bh(k), _to_bh(v)
     oh, gh = _to_bh(out), _to_bh(g)
     delta = jnp.sum(gh.astype(jnp.float32) * oh.astype(jnp.float32),
@@ -444,9 +478,12 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
 
     def drop_tile(seed_ref, bh, qi, ki):
         # NB: bh is bound at kernel top — pl.program_id inside a pl.when
-        # body breaks the interpret-mode lowering.
+        # body breaks the interpret-mode lowering. Head coordinate is
+        # globalized (TP head shard: off + bh%H, batch stride Hg) —
+        # identical to the forward's, so the regenerated mask matches.
         q_pos, k_pos = positions(qi, ki)
-        return dropout_multiplier(seed_ref[0], bh, q_pos, k_pos,
+        g_head = bh + (bh // H) * (Hg - H) + seed_ref[1]
+        return dropout_multiplier(seed_ref[0], g_head, q_pos, k_pos,
                                   dropout_rate)
 
     def dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
@@ -639,38 +676,48 @@ def _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash_pallas(q, k, v, key_bias, dropout_seed, causal, sm_scale,
-                  block_q, block_k, dropout_rate, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
+def _flash_pallas(q, k, v, key_bias, dropout_seed, dropout_head_offset,
+                  causal, sm_scale, block_q, block_k, dropout_rate,
+                  dropout_num_heads, interpret=False):
     out, _ = _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
                          interpret, key_bias=key_bias,
                          dropout_rate=dropout_rate,
-                         dropout_seed=dropout_seed)
+                         dropout_seed=dropout_seed,
+                         dropout_head_offset=dropout_head_offset,
+                         dropout_num_heads=dropout_num_heads)
     return out
 
 
-def _flash_pallas_fwd(q, k, v, key_bias, dropout_seed, causal, sm_scale,
-                      block_q, block_k, dropout_rate, interpret):
+def _flash_pallas_fwd(q, k, v, key_bias, dropout_seed, dropout_head_offset,
+                      causal, sm_scale, block_q, block_k, dropout_rate,
+                      dropout_num_heads, interpret):
     out, lse = _pallas_fwd(q, k, v, causal, sm_scale, block_q, block_k,
                            interpret, key_bias=key_bias,
                            dropout_rate=dropout_rate,
-                           dropout_seed=dropout_seed)
-    return out, (q, k, v, key_bias, dropout_seed, out, lse)
+                           dropout_seed=dropout_seed,
+                           dropout_head_offset=dropout_head_offset,
+                           dropout_num_heads=dropout_num_heads)
+    return out, (q, k, v, key_bias, dropout_seed, dropout_head_offset,
+                 out, lse)
 
 
 def _flash_pallas_bwd(causal, sm_scale, block_q, block_k, dropout_rate,
-                      interpret, res, g):
-    q, k, v, key_bias, dropout_seed, out, lse = res
+                      dropout_num_heads, interpret, res, g):
+    (q, k, v, key_bias, dropout_seed, dropout_head_offset,
+     out, lse) = res
     dq, dk, dv, dbias = _pallas_bwd(q, k, v, out, lse, g, causal, sm_scale,
                                     block_q, block_k, interpret,
                                     key_bias=key_bias,
                                     dropout_rate=dropout_rate,
-                                    dropout_seed=dropout_seed)
+                                    dropout_seed=dropout_seed,
+                                    dropout_head_offset=dropout_head_offset,
+                                    dropout_num_heads=dropout_num_heads)
     dkb = None if key_bias is None else dbias.astype(key_bias.dtype)
-    # int32 seed: cotangent type is float0
-    dseed = (None if dropout_seed is None
-             else np.zeros(jnp.shape(dropout_seed), jax.dtypes.float0))
-    return dq, dk, dv, dkb, dseed
+    # int32 seed/offset: cotangent type is float0
+    f0 = lambda x: (None if x is None
+                    else np.zeros(jnp.shape(x), jax.dtypes.float0))
+    return dq, dk, dv, dkb, f0(dropout_seed), f0(dropout_head_offset)
 
 
 _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
@@ -679,7 +726,8 @@ _flash_pallas.defvjp(_flash_pallas_fwd, _flash_pallas_bwd)
 def flash_attention(q, k, v, causal=True, sm_scale=None,
                     block_q=512, block_k=512, implementation="auto",
                     key_padding_mask=None, key_bias=None,
-                    dropout_rate=0.0, dropout_seed=None):
+                    dropout_rate=0.0, dropout_seed=None,
+                    dropout_head_offset=0, dropout_num_heads=None):
     """Memory-efficient attention; q,k,v: [B, T, H, D] → [B, T, H, D].
 
     ``implementation``: "auto" (pallas on TPU, xla elsewhere), "pallas"
@@ -697,6 +745,14 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
     — the in-kernel-dropout capability of the reference's fused
     transformer (`csrc/transformer/dropout_kernels.cu`), with the same
     mask bits on every implementation.
+
+    ``dropout_head_offset`` (traced int32 ok) / ``dropout_num_heads``
+    (static int): when the local heads are a tensor-parallel SHARD of a
+    larger attention (Megatron head partition), pass this rank's first
+    global head and the global head count — the mask then hashes global
+    coordinates, so the sharded run reproduces the replicated run's
+    dropout bitwise (round 5; previously TP blocks had to fall back to
+    dense attention under dropout).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -707,20 +763,29 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
             raise ValueError(f"dropout_rate {dropout_rate} not in [0, 1)")
         if dropout_seed is None:
             raise ValueError("dropout_rate > 0 requires dropout_seed")
+        if dropout_num_heads is not None:
+            import numbers
+            if not isinstance(dropout_num_heads, numbers.Integral):
+                raise TypeError("dropout_num_heads must be a static int")
+            dropout_num_heads = int(dropout_num_heads)
+            if dropout_num_heads < q.shape[2]:
+                raise ValueError(
+                    f"dropout_num_heads {dropout_num_heads} < local heads "
+                    f"{q.shape[2]}")
         dropout_seed = jnp.asarray(dropout_seed, jnp.int32)
     bias = _to_key_bias(key_padding_mask, key_bias)
     on_tpu = jax.devices()[0].platform == "tpu"
     if implementation == "auto":
         implementation = "pallas" if on_tpu else "xla"
+    drop_kw = dict(dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+                   dropout_head_offset=dropout_head_offset,
+                   dropout_num_heads=dropout_num_heads)
     if implementation == "dense":
         return dense_attention(q, k, v, causal, sm_scale, key_bias=bias,
-                               dropout_rate=dropout_rate,
-                               dropout_seed=dropout_seed)
+                               **drop_kw)
     if implementation == "xla":
         return _blockwise_attention(q, k, v, causal, sm_scale,
-                                    key_bias=bias,
-                                    dropout_rate=dropout_rate,
-                                    dropout_seed=dropout_seed)
+                                    key_bias=bias, **drop_kw)
     if implementation == "pallas":
         T = q.shape[1]
         bq = min(block_q, T)
@@ -728,9 +793,9 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
         # Fall back when shapes don't tile cleanly.
         if T % bq != 0 or k.shape[1] % bk != 0:
             return _blockwise_attention(q, k, v, causal, sm_scale,
-                                        key_bias=bias,
-                                        dropout_rate=dropout_rate,
-                                        dropout_seed=dropout_seed)
-        return _flash_pallas(q, k, v, bias, dropout_seed, causal, sm_scale,
-                             bq, bk, float(dropout_rate), not on_tpu)
+                                        key_bias=bias, **drop_kw)
+        return _flash_pallas(q, k, v, bias, dropout_seed,
+                             dropout_head_offset, causal, sm_scale,
+                             bq, bk, float(dropout_rate),
+                             dropout_num_heads, not on_tpu)
     raise ValueError(f"unknown implementation {implementation!r}")

@@ -94,30 +94,25 @@ def local_attention(q, k, v, causal, use_flash=False, dropout_rate=0.0,
     head coordinates (``head_offset`` = this rank's first head,
     ``n_heads_global`` = total heads), so the mask is invariant to the
     model-axis sharding — a sharded run reproduces the replicated run
-    bitwise. With dropout active the dense path runs (the flash kernel's
-    mask coordinates are shard-local)."""
+    bitwise. Since round 5 the flash kernels take the global coordinates
+    directly (``dropout_head_offset``/``dropout_num_heads``), so
+    ``use_flash`` keeps the fused O(T)-memory path under dropout too."""
     B, T, h_local, D = q.shape
-    if use_flash and dropout_rate == 0.0:
-        y = flash_attention(q, k, v, causal=causal)
+    if use_flash:
+        y = flash_attention(
+            q, k, v, causal=causal, dropout_rate=dropout_rate,
+            dropout_seed=dropout_seed, dropout_head_offset=head_offset,
+            dropout_num_heads=n_heads_global)
     else:
-        scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
-        s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
-        s = s * scale
-        if causal:
-            mask = jnp.tril(jnp.ones((T, T), bool))
-            s = jnp.where(mask[None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        if dropout_rate > 0.0:
-            from deepspeed_tpu.ops.pallas.flash_attention import (
-                dropout_multiplier)
-            Hg = n_heads_global if n_heads_global is not None else h_local
-            bh = (jnp.arange(B)[:, None] * Hg
-                  + head_offset + jnp.arange(h_local)[None, :])   # [B, hl]
-            p = p * dropout_multiplier(
-                dropout_seed, bh[:, :, None, None],
-                jnp.arange(T)[None, None, :, None],
-                jnp.arange(T)[None, None, None, :], dropout_rate)
-        y = jnp.einsum("bhts,bshd->bthd", p.astype(q.dtype), v)
+        # Same globalized dropout coordinates, reference math — one
+        # implementation of the global-bh formula, not two.
+        from deepspeed_tpu.ops.pallas.flash_attention import (
+            dense_attention)
+        y = dense_attention(q, k, v, causal=causal,
+                            dropout_rate=dropout_rate,
+                            dropout_seed=dropout_seed,
+                            dropout_head_offset=head_offset,
+                            dropout_num_heads=n_heads_global)
     return y.reshape(B, T, h_local * D)
 
 
@@ -212,12 +207,6 @@ class TPBlockLayer:
         microbatch + stage only)."""
         if rng is None or self.dropout == 0.0:
             return 0.0, None, 0, lambda t, sub: t
-        if self.use_flash:
-            from deepspeed_tpu.utils.logging import log_dist
-            log_dist("TP block dropout > 0 runs the DENSE attention path "
-                     "(O(T^2) scores): the flash kernel's dropout "
-                     "coordinates are shard-local. Expect higher memory "
-                     "at long sequence lengths.", ranks=[0])
         from deepspeed_tpu.ops.pallas.flash_attention import (
             dropout_seed_from_rng)
         if axis_is_manual("data"):

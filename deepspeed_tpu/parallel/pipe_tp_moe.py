@@ -31,7 +31,6 @@ dim first over ``expert``; everything else replicated.
 """
 
 import jax
-import jax.numpy as jnp
 
 from deepspeed_tpu.moe.expert_pipe import ExpertParallelFFNLayer
 from deepspeed_tpu.moe.layer import MoEConfig

@@ -296,3 +296,55 @@ def test_gpt2_flash_trains_with_dropout():
     assert np.isfinite(float(loss))
     for leaf in jax.tree_util.tree_leaves(grads):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+@pytest.mark.parametrize("impl", ["dense", "xla", "pallas"])
+def test_dropout_head_offset_matches_global_slice(impl):
+    """Tensor-parallel head shards: running each half of the heads with
+    (dropout_head_offset, dropout_num_heads) must reproduce the
+    replicated full-head run's dropout EXACTLY — the mask hashes global
+    coordinates, so the sharding is invisible (round 5; this is what
+    lets TP blocks keep the fused attention path under dropout)."""
+    q, k, v = qkv(T=64, H=4)
+    seed = jnp.int32(7)
+    kw = dict(causal=True, implementation=impl, block_q=32, block_k=32,
+              dropout_rate=0.3, dropout_seed=seed)
+    full = flash_attention(q, k, v, **kw)
+    parts = [flash_attention(q[:, :, lo:lo + 2], k[:, :, lo:lo + 2],
+                             v[:, :, lo:lo + 2], dropout_head_offset=lo,
+                             dropout_num_heads=4, **kw)
+             for lo in (0, 2)]
+    np.testing.assert_array_equal(
+        np.asarray(jnp.concatenate(parts, axis=2)), np.asarray(full))
+
+
+def test_dropout_head_offset_gradients_match_global_slice():
+    """Same invariance through the backward (the bwd kernels regenerate
+    the mask from the same globalized coordinates)."""
+    q, k, v = qkv(T=64, H=4)
+    seed = jnp.int32(11)
+
+    def loss_full(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=True, implementation="pallas", block_q=32,
+            block_k=32, dropout_rate=0.3, dropout_seed=seed) ** 2)
+
+    def loss_shard(lo):
+        def f(q, k, v):
+            return jnp.sum(flash_attention(
+                q[:, :, lo:lo + 2], k[:, :, lo:lo + 2], v[:, :, lo:lo + 2],
+                causal=True, implementation="pallas", block_q=32,
+                block_k=32, dropout_rate=0.3, dropout_seed=seed,
+                dropout_head_offset=lo, dropout_num_heads=4) ** 2)
+        return f
+
+    _, g_full = jax.value_and_grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for lo in (0, 2):
+        _, g_sh = jax.value_and_grad(loss_shard(lo),
+                                     argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_sh, g_full):
+            # the shard's grad is the full grad restricted to its heads
+            np.testing.assert_allclose(
+                np.asarray(a)[:, :, lo:lo + 2],
+                np.asarray(b)[:, :, lo:lo + 2], rtol=1e-5, atol=1e-5)
+            assert np.all(np.asarray(a)[:, :, :lo] == 0)

@@ -140,3 +140,40 @@ def test_tp_pipeline_dropout_invariant_to_sharding():
     # deterministic-block one
     c_det = run(1, 4, block_cls=TPBlockLayer)
     assert max(abs(a - b) for a, b in zip(c_rep, c_det)) > 1e-4
+
+
+class _FlashDropBlock(TPBlockLayer):
+    """Dropout + flash attention together — the round-5 capability (the
+    kernels take global head coordinates, so TP no longer forces the
+    dense O(T^2) path under dropout)."""
+
+    def __init__(self, d_model, n_head):
+        super().__init__(d_model, n_head, dropout=0.25, use_flash=True)
+
+
+@pytest.mark.slow
+def test_tp_pipeline_flash_dropout_invariant_to_sharding():
+    """Same sharding-invariance contract as the dense-dropout test, but
+    riding the fused attention path: the flash kernels hash GLOBAL head
+    coordinates (dropout_head_offset/dropout_num_heads), so model=2 must
+    reproduce the model=1 curve."""
+    import deepspeed_tpu
+
+    def run(model_size, n_devices):
+        mesh = build_mesh({"pipe": 2, "model": model_size, "data": 2},
+                          devices=jax.devices()[:n_devices])
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config={"train_batch_size": ROWS,
+                    "gradient_accumulation_steps": MICRO,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                    "steps_per_print": 1000},
+            model=_module(block_cls=_FlashDropBlock), mesh=mesh, seed=0)
+        rng = np.random.default_rng(1)
+        batch = {"ids": rng.integers(0, 32, (ROWS, SEQ)).astype(np.int32),
+                 "labels": rng.integers(0, 32,
+                                        (ROWS, SEQ)).astype(np.int32)}
+        return [float(engine.train_batch(batch)) for _ in range(6)]
+
+    c_rep = run(1, 4)
+    c_tp = run(2, 8)
+    np.testing.assert_allclose(c_tp, c_rep, rtol=3e-4)
