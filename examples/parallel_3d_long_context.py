@@ -1,5 +1,6 @@
-"""The round-4 composition showcase: 3D (dp x pp x tp) and long-context
-(pp x sp) training through the one public entry point.
+"""The composition showcase: 3D (dp x pp x tp), long-context (pp x sp),
+and the round-5 four-axis dp x pp x tp x ep block (TP attention + MoE
+FFN), all through the one public entry point.
 
 Runs on the virtual CPU mesh out of the box:
 
@@ -58,6 +59,25 @@ def main():
         mesh=build_mesh({"pipe": 2, "seq": 2, "data": 2},
                         devices=jax.devices()[:8]))
     train(engine_sp, batch, args.steps, "SP  (pipe2 x seq2 x data2)")
+
+    # ---- 3. four axes: data x pipe x tensor x expert (round 5) -------
+    # TP attention + expert-parallel MoE FFN in ONE pipeline block; the
+    # data axis collapses to 1 on an 8-device mesh but remains a real
+    # axis of the compiled program (size it up on larger slices).
+    import functools
+    from deepspeed_tpu.moe.layer import MoEConfig
+    from deepspeed_tpu.parallel.pipe_tp_moe import TPMoEBlockLayer
+    moe_block = functools.partial(
+        TPMoEBlockLayer,
+        moe=MoEConfig(num_experts=4, top_k=2, capacity_factor=2.0))
+    engine4d, _, _, _ = deepspeed_tpu.initialize(
+        config=config,
+        model=tp_pipeline_module(vocab, d_model, n_head, seq,
+                                 block_cls=moe_block),
+        mesh=build_mesh({"data": 1, "pipe": 2, "model": 2, "expert": 2},
+                        devices=jax.devices()[:8]))
+    train(engine4d, batch, args.steps,
+          "4D  (pipe2 x model2 x expert2, MoE FFN)")
 
 
 if __name__ == "__main__":

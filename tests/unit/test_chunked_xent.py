@@ -127,7 +127,7 @@ def test_chunked_xent_with_zero3_matches_dense_curve():
     1-ulp (≈4e-3) flip on isolated elements, which Adam then amplifies
     into small curve drift. So: ZeRO-3 must be loss-transparent (sharded
     == unsharded curve, tight), and chunked-vs-dense must sit at 2e-4
-    (~5x the observed 3.9e-5, ~8x tighter than the pre-fix bound)."""
+    (~5x the observed 3.9e-5, 10x tighter than the pre-fix 2e-3 bound)."""
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHead,
                                            init_gpt2_params,
