@@ -137,7 +137,7 @@ class DeepSpeedCPUAdam:
                 ctypes.c_int64(n))
 
     def step_overlapped(self, grads, lr=None, beta1=None, bf16_out=False,
-                        chunk_bytes=1 << 26):
+                        chunk_bytes=1 << 26, on_chunk=None):
         """One Adam step with the host phase software-pipelined.
 
         The reference's ZeRO-Offload is an overlap design (stage2.py:793
@@ -150,6 +150,13 @@ class DeepSpeedCPUAdam:
         fp32→bf16 convert) on chunk k. ctypes releases the GIL, so copy
         and compute genuinely overlap. Chunk ranges are disjoint across
         master/grad/moment/bf16 buffers — no locking needed.
+
+        ``on_chunk(leaf_lo, leaf_hi)`` (optional) runs on the CALLING
+        thread as each chunk's update (and convert) completes, in chunk
+        order, while the worker continues later chunks — the engine uses
+        it to start each chunk's param H2D upload during the remaining
+        Adam compute (the copy-back overlap of the reference's
+        cpu_adam.cpp side stream).
 
         Returns the params pytree (fp32 views), or with ``bf16_out`` the
         flat bf16 master copy ready for one device upload.
@@ -182,8 +189,10 @@ class DeepSpeedCPUAdam:
                     g_leaves[k], np.float32).reshape(-1)
             futs.append(self._pool.submit(
                 self._update_range, step, eff_lr, eff_b1, off, n, bf16_out))
-        for f in futs:
-            f.result()             # propagate worker failures
+        for (li, lj, off, n), f in zip(self._chunks, futs):
+            f.result()             # propagate worker failures (in order)
+            if on_chunk is not None:
+                on_chunk(li, lj)
         if bf16_out:
             import ml_dtypes
             return self._bf16_buf.view(ml_dtypes.bfloat16)

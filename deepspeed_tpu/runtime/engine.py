@@ -339,6 +339,8 @@ class DeepSpeedEngine:
                 bias_correction=opt_params.get("bias_correction", True),
                 adamw_mode=opt_params.get("adam_w_mode",
                                           self.optimizer_name == "adamw"))
+            self._offload_chunk_bytes = int(
+                self._config.zero_config.offload_chunk_mb) << 20
             if self._offload_dp:
                 D = self.mesh.shape["data"]
                 self._off_D = D
@@ -819,15 +821,16 @@ class DeepSpeedEngine:
         # with donation suffices.
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
-    def _upload_offload_params(self, flat_bf16=None):
-        """Device copy of the host fp32 masters at compute dtype. Under
-        bf16 the conversion runs in the fused C++ kernel on one flat buffer
-        (the reference's fused fp16 copy-back, csrc/adam/cpu_adam.cpp);
-        ``flat_bf16`` passes a buffer that ``step_overlapped`` already
-        converted chunk-by-chunk under the copy/compute overlap."""
+    def _upload_offload_params(self):
+        """Device copy of the host fp32 masters at compute dtype (init /
+        checkpoint-load path; the per-step bf16 upload is chunked inside
+        ``_train_batch_offload``'s ``on_chunk`` copy-back instead). Under
+        bf16 the conversion runs in the fused C++ kernel on one flat
+        buffer (the reference's fused fp16 copy-back,
+        csrc/adam/cpu_adam.cpp)."""
         opt = self.cpu_optimizer
         if self.compute_dtype == jnp.bfloat16:
-            flat = opt.params_bf16_flat() if flat_bf16 is None else flat_bf16
+            flat = opt.params_bf16_flat()
             leaves = [flat[off:off + size].reshape(shape)
                       for off, size, shape in zip(opt.offsets, opt.sizes,
                                                   opt.shapes)]
@@ -927,12 +930,40 @@ class DeepSpeedEngine:
             self.params, self.device_state, placed, step_rng, lr_in)
         if not bool(metrics["overflow"]):   # blocks until device step done
             t0 = time.perf_counter()
+            opt = self.cpu_optimizer
             bf16 = self.compute_dtype == jnp.bfloat16
-            out = self.cpu_optimizer.step_overlapped(
-                grads, lr=float(metrics["lr"]),
-                beta1=float(metrics["beta1"]), bf16_out=bf16)
-            self.params = self._upload_offload_params(
-                flat_bf16=out if bf16 else None)
+            lr, b1 = float(metrics["lr"]), float(metrics["beta1"])
+            if bf16:
+                # Chunked copy-back: each chunk's leaves start their H2D
+                # upload (device_put is async) as soon as its Adam +
+                # bf16 convert lands, overlapping the remaining chunks'
+                # host compute. Safe to upload views of the shared bf16
+                # buffer: it is next rewritten only after the following
+                # device step has consumed these params.
+                import ml_dtypes
+                shard_leaves = jax.tree_util.tree_leaves(
+                    self._shardings["param"])
+                uploaded = [None] * len(opt.sizes)
+
+                def upload_chunk(li, lj):
+                    flat = opt._bf16_buf.view(ml_dtypes.bfloat16)
+                    for i in range(li, lj):
+                        o, sz = opt.offsets[i], opt.sizes[i]
+                        uploaded[i] = jax.device_put(
+                            flat[o:o + sz].reshape(opt.shapes[i]),
+                            shard_leaves[i])
+
+                opt.step_overlapped(
+                    grads, lr=lr, beta1=b1, bf16_out=True,
+                    chunk_bytes=self._offload_chunk_bytes,
+                    on_chunk=upload_chunk)
+                self.params = jax.tree_util.tree_unflatten(
+                    opt.treedef, uploaded)
+            else:
+                opt.step_overlapped(
+                    grads, lr=lr, beta1=b1,
+                    chunk_bytes=self._offload_chunk_bytes)
+                self.params = self._upload_offload_params()
             self.last_host_phase_s = time.perf_counter() - t0
         return metrics
 

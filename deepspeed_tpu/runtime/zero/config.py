@@ -20,6 +20,8 @@ from deepspeed_tpu.runtime.zero.constants import (
     ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT,
     ZERO_OPTIMIZATION_OFFLOAD_16BIT_GRADS,
     ZERO_OPTIMIZATION_OFFLOAD_16BIT_GRADS_DEFAULT,
+    ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB,
+    ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB_DEFAULT,
     ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,
     ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT,
     ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS,
@@ -48,6 +50,7 @@ class DeepSpeedZeroConfig:
         self.load_from_fp32_weights = None
         self.cpu_offload = None
         self.offload_16bit_grads = None
+        self.offload_chunk_mb = None
         self.elastic_checkpoint = None
 
         if ZERO_OPTIMIZATION in param_dict:
@@ -110,6 +113,10 @@ class DeepSpeedZeroConfig:
             zero_config_dict,
             ZERO_OPTIMIZATION_OFFLOAD_16BIT_GRADS,
             ZERO_OPTIMIZATION_OFFLOAD_16BIT_GRADS_DEFAULT)
+        self.offload_chunk_mb = get_scalar_param(
+            zero_config_dict,
+            ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB,
+            ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB_DEFAULT)
         self.elastic_checkpoint = get_scalar_param(
             zero_config_dict,
             ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,

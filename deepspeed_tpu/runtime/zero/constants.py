@@ -67,6 +67,14 @@ ZERO_OPTIMIZATION_OFFLOAD_16BIT_GRADS_DEFAULT = False
 ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT = True
 
+# Chunk size (MB of fp32 elements) of the offload host-phase pipeline:
+# D2H of chunk k+1 overlaps the C++ Adam + bf16 convert (+ the chunked
+# param H2D upload) of chunk k. Smaller chunks overlap at finer grain
+# but pay more per-call overhead; the reference's analogous knob buckets
+# its async grad copies (stage2.py allreduce/allgather bucket sizes).
+ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB = "offload_chunk_mb"
+ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB_DEFAULT = 64
+
 ZERO_OPTIMIZATION_DEFAULT = {
     ZERO_OPTIMIZATION_STAGE: ZERO_OPTIMIZATION_STAGE_DEFAULT,
     ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS:
@@ -85,4 +93,6 @@ ZERO_OPTIMIZATION_DEFAULT = {
         ZERO_OPTIMIZATION_OFFLOAD_16BIT_GRADS_DEFAULT,
     ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT:
         ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT,
+    ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB:
+        ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB_DEFAULT,
 }

@@ -96,6 +96,24 @@ def test_zero_with_bf16():
     assert cfg.bf16_enabled and not cfg.fp16_enabled
 
 
+def test_zero_offload_chunk_mb_key():
+    """offload_chunk_mb (round 5): parsed with its default, overridable —
+    sizes the offload host-phase pipeline's D2H/Adam/upload chunks."""
+    cfg = make_config({
+        "train_batch_size": 8,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "cpu_offload": True},
+    }, world_size=1)
+    assert cfg.zero_config.offload_chunk_mb == 64
+    cfg2 = make_config({
+        "train_batch_size": 8,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "offload_chunk_mb": 16},
+    }, world_size=1)
+    assert cfg2.zero_config.offload_chunk_mb == 16
+
+
 def test_zero_legacy_bool_form():
     cfg = make_config({
         "train_batch_size": 8,

@@ -264,3 +264,20 @@ def test_step_overlapped_takes_jax_device_grads():
     a.step(host16)
     b.step_overlapped(jgrads16, chunk_bytes=1024)
     np.testing.assert_allclose(a.master, b.master, rtol=1e-5, atol=1e-7)
+
+
+def test_step_overlapped_on_chunk_callback_order():
+    """on_chunk fires once per chunk, in order, covering every leaf —
+    the contract the engine's chunked H2D copy-back relies on."""
+    rng = np.random.default_rng(9)
+    sizes = ((300,), (200,), (5, 5), (1000,))
+    params = _rand_tree(rng, sizes=sizes)
+    opt = DeepSpeedCPUAdam(params, lr=0.01)
+    seen = []
+    opt.step_overlapped(_rand_tree(rng, sizes=sizes), bf16_out=True,
+                        chunk_bytes=2048, on_chunk=lambda a, b:
+                        seen.append((a, b)))
+    assert len(seen) == len(opt._chunks) >= 2
+    assert seen[0][0] == 0 and seen[-1][1] == len(sizes)
+    for (a, b), (c, d) in zip(seen, seen[1:]):
+        assert b == c, seen   # contiguous, ordered, no gaps
