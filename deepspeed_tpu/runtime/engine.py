@@ -339,8 +339,15 @@ class DeepSpeedEngine:
                 bias_correction=opt_params.get("bias_correction", True),
                 adamw_mode=opt_params.get("adam_w_mode",
                                           self.optimizer_name == "adamw"))
-            self._offload_chunk_bytes = int(
-                self._config.zero_config.offload_chunk_mb) << 20
+            chunk_mb = self._config.zero_config.offload_chunk_mb
+            if not chunk_mb or float(chunk_mb) <= 0:
+                raise ValueError(
+                    f"offload_chunk_mb must be a positive number of MB, "
+                    f"got {chunk_mb!r}")
+            # Fractional MB allowed; floor at 64 KB so a tiny value can't
+            # degenerate into one pool submission per element.
+            self._offload_chunk_bytes = max(
+                64 << 10, int(float(chunk_mb) * (1 << 20)))
             if self._offload_dp:
                 D = self.mesh.shape["data"]
                 self._off_D = D
@@ -974,61 +981,100 @@ class DeepSpeedEngine:
         device via the XLA all-gather in the assemble jit. Host work and
         wire bytes are 1/D per process — DP over processes IS the
         parallelism (the reference parallelizes its CPU Adam the same
-        way: each rank steps its own partition)."""
+        way: each rank steps its own partition).
+
+        Within the rank the phase is pipelined PER DATA-AXIS ROW, same
+        worker pattern as the single-process path: row r+1's grad bytes
+        land (blocking only on that row's async D2H) while the worker
+        runs Adam + convert on row r, and each row's updated params
+        start their H2D the moment its future resolves."""
         flat_shard, self.device_state, metrics = self._compiled_train_step(
             self.params, self.device_state, placed, step_rng, lr_in)
         if bool(metrics["overflow"]):
             return metrics
         t0 = time.perf_counter()
         opt = self.cpu_optimizer
-        chunk, total = self._off_chunk, opt.total
-        shards = list(flat_shard.addressable_shards)
-        for s in shards:
-            start = getattr(s.data, "copy_to_host_async", None)
+        D, chunk = self._off_D, self._off_chunk
+        sharding, ranges = self._local_row_ranges()
+        shards = {s.index[0].start or 0: s.data
+                  for s in flat_shard.addressable_shards}
+        for data in shards.values():
+            start = getattr(data, "copy_to_host_async", None)
             if start is not None:
                 start()
-        rows = []
-        for s in shards:
-            r = s.index[0].start or 0
-            rows.append(r)
-            lo = r * chunk
-            n = max(0, min(chunk, total - lo))
-            if n:
-                opt._grad_buf[lo:lo + n] = np.asarray(
-                    s.data, np.float32).reshape(-1)[:n]
-        rows = sorted(set(rows))
+        rows = [r for r, *_ in ranges]
         assert rows == list(range(rows[0], rows[-1] + 1)), (
             f"non-contiguous local grad rows {rows}: the flat-shard "
             "partition assumes process-major device order on the data "
             "axis")
-        lo = rows[0] * chunk
-        hi = min((rows[-1] + 1) * chunk, total)
+        assert set(rows) == set(shards), (rows, sorted(shards))
         bf16 = self.compute_dtype == jnp.bfloat16
         if bf16 and opt._bf16_buf is None:
-            opt._bf16_buf = np.empty(total, np.uint16)
+            opt._bf16_buf = np.empty(opt.total, np.uint16)
+        if opt._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            opt._pool = ThreadPoolExecutor(max_workers=1)
         opt._step += 1
-        if hi > lo:
-            opt._update_range(opt._step, float(metrics["lr"]),
-                              float(metrics["beta1"]), lo, hi - lo, bf16)
-        self.params = self._offload_assemble_params()
+        lr, b1 = float(metrics["lr"]), float(metrics["beta1"])
+        futs = []
+        for r, lo, n, _ in ranges:
+            if n:
+                opt._grad_buf[lo:lo + n] = np.asarray(
+                    shards[r], np.float32).reshape(-1)[:n]
+            futs.append(opt._pool.submit(
+                opt._update_range, opt._step, lr, b1, lo, n, bf16)
+                if n else None)
+        if bf16:
+            import ml_dtypes
+            src, np_dtype = opt._bf16_buf.view(ml_dtypes.bfloat16), \
+                ml_dtypes.bfloat16
+        else:
+            src, np_dtype = opt.master, np.dtype(self.compute_dtype)
+        arrays = []
+        for (r, lo, n, d), f in zip(ranges, futs):
+            if f is not None:
+                f.result()
+            if n == chunk and src.dtype == np_dtype:
+                row = src[lo:lo + chunk].reshape(1, chunk)
+            else:
+                row = np.zeros((1, chunk), np_dtype)
+                if n:
+                    row[0, :n] = src[lo:lo + n]
+            arrays.append(jax.device_put(row, d))
+        garr = jax.make_array_from_single_device_arrays(
+            (D, chunk), sharding, arrays)
+        self.params = self._offload_assemble_jit()(garr)
         self.last_host_phase_s = time.perf_counter() - t0
         return metrics
 
-    def _scatter_local_rows(self, src, np_dtype):
-        """Global [D, chunk] array over the data axis, each addressable
-        device's row filled from this process's flat host buffer ``src``
-        (zero-padded past ``total``). The one place the host-range ↔
-        data-axis-row mapping lives — used by both the param reassembly
-        and the checkpoint gather."""
+    def _local_row_ranges(self):
+        """The host-range ↔ data-axis-row mapping for offload×DP — THE
+        one place it lives (per-step reassembly and the checkpoint
+        gather both iterate it): ``(sharding, [(row, lo, n, device)])``
+        for this process's addressable rows of the global [D, chunk]
+        flat layout, ``n`` clipped at ``total`` (the last row carries
+        padding)."""
         opt = self.cpu_optimizer
         D, chunk, total = self._off_D, self._off_chunk, opt.total
         sharding = NamedSharding(self.mesh, PartitionSpec("data"))
         imap = sharding.devices_indices_map((D, chunk))
-        arrays = []
+        rows = []
         for d in sharding.addressable_devices:
             r = imap[d][0].start or 0
             lo = r * chunk
-            n = max(0, min(chunk, total - lo))
+            rows.append((r, lo, max(0, min(chunk, total - lo)), d))
+        rows.sort()
+        return sharding, rows
+
+    def _scatter_local_rows(self, src, np_dtype):
+        """Global [D, chunk] array over the data axis, each addressable
+        device's row filled from this process's flat host buffer ``src``
+        (zero-padded past ``total``) — the checkpoint-gather half of the
+        mapping in :meth:`_local_row_ranges`."""
+        D, chunk = self._off_D, self._off_chunk
+        sharding, rows = self._local_row_ranges()
+        arrays = []
+        for _, lo, n, d in rows:
             row = np.zeros((1, chunk), np_dtype)
             if n:
                 row[0, :n] = src[lo:lo + n]
@@ -1036,22 +1082,13 @@ class DeepSpeedEngine:
         return jax.make_array_from_single_device_arrays(
             (D, chunk), sharding, arrays)
 
-    def _offload_assemble_params(self):
-        """Build the global [D, chunk] compute-dtype param array from this
-        process's freshly-updated master range and run the assemble jit —
-        XLA inserts the all-gather from the data-sharded input to the
-        engine's param shardings."""
-        opt = self.cpu_optimizer
-        total = opt.total
-        if self.compute_dtype == jnp.bfloat16:
-            import ml_dtypes
-            src = opt._bf16_buf.view(ml_dtypes.bfloat16)
-            np_dtype = ml_dtypes.bfloat16
-        else:
-            src = opt.master
-            np_dtype = np.dtype(self.compute_dtype)
-        garr = self._scatter_local_rows(src, np_dtype)
+    def _offload_assemble_jit(self):
+        """Cached jit mapping the global data-sharded [D, chunk] flat
+        param array to the engine's param pytree/shardings — XLA inserts
+        the all-gather riding ICI."""
         if getattr(self, "_offload_assemble_fn", None) is None:
+            opt = self.cpu_optimizer
+            total = opt.total
             offsets, sizes, shapes = opt.offsets, opt.sizes, opt.shapes
             treedef = opt.treedef
 
@@ -1063,7 +1100,7 @@ class DeepSpeedEngine:
 
             self._offload_assemble_fn = jax.jit(
                 assemble, out_shardings=self._shardings["param"])
-        return self._offload_assemble_fn(garr)
+        return self._offload_assemble_fn
 
     def _offload_sync_host_state(self):
         """Make every process's full host master/moment buffers current
