@@ -114,9 +114,28 @@ class PipelineEngine(DeepSpeedEngine):
                 f"structure:\n  expected {expected}\n  got      {got}")
             self.pipeline_parts.params = model_parameters
         # reference semantics: interval 0 disables rematerialization
+        auto_axes = tuple(getattr(model, "auto_axes", ()) or ())
+        if auto_axes:
+            # The vag-level capability works and is parity-tested
+            # (test_pipe_auto.py), but composing it with the engine's
+            # compiled train step deadlocks XLA's in-process CPU
+            # collective rendezvous when body params are PLACED sharded
+            # over the auto axis (devices split 4/4 across the fwd/bwd
+            # ppermute rendezvous; repro in the test file's docstring).
+            # Real-TPU behavior is untested (different collective
+            # runtime) — gate rather than abort the process.
+            raise NotImplementedError(
+                f"PipelineModule(auto_axes={auto_axes!r}) through the "
+                "engine is experimental and currently disabled: the "
+                "in-process CPU runtime deadlocks on the pipeline's "
+                "ppermutes when params are placed sharded over an auto "
+                "axis. Use make_pipeline_value_and_grad_fn(...) directly "
+                "(works, see tests/unit/test_pipe_auto.py) or the "
+                "manual-collective TP blocks (parallel/pipe_tp.py)")
         loss_fn = make_pipeline_loss_fn(
             self.pipeline_parts, mesh, self.micro_batches,
-            remat=model.activation_checkpoint_interval > 0)
+            remat=model.activation_checkpoint_interval > 0,
+            auto_axes=auto_axes)
         # Training runs the hand-scheduled 1F1B (loss, grads) program —
         # O(num_stages) activation memory independent of micro_batches;
         # the GPipe loss above remains the eval/forward-only path.
@@ -124,13 +143,14 @@ class PipelineEngine(DeepSpeedEngine):
             jnp.float16 if probe.fp16_enabled else None)
         loss_fn.direct_value_and_grad = make_pipeline_value_and_grad_fn(
             self.pipeline_parts, mesh, self.micro_batches,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, auto_axes=auto_axes)
         # 1-bit Adam composition: same 1F1B program, but gradients come
         # back data-LOCAL (stacked data axis) for the compressed
         # collective to average (engine._make_pipeline_onebit_train_step).
         loss_fn.direct_value_and_grad_local = make_pipeline_value_and_grad_fn(
             self.pipeline_parts, mesh, self.micro_batches,
-            compute_dtype=compute_dtype, data_local=True)
+            compute_dtype=compute_dtype, data_local=True,
+            auto_axes=auto_axes)
 
         super().__init__(args=args,
                          model=model,

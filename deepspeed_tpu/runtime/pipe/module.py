@@ -84,7 +84,8 @@ class PipelineModule:
                  base_seed: int = 1234,
                  partition_method: str = "parameters",
                  activation_checkpoint_interval: int = 0,
-                 example_input: Any = None):
+                 example_input: Any = None,
+                 auto_axes=()):
         self.specs = [
             spec if isinstance(spec, LayerSpec) else LayerSpec(spec)
             if callable(spec) else spec
@@ -97,6 +98,12 @@ class PipelineModule:
         self.base_seed = base_seed
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
+        # Mesh axes the compiled pipeline leaves in GSPMD (auto) mode —
+        # typically ("model",) so layers built from plain flax modules
+        # with nn.with_partitioning metadata do Megatron TP inside the
+        # 1F1B without hand-written collectives (round 5;
+        # `parallel/pipe_auto.py`). pipe/data/seq must stay manual.
+        self.auto_axes = tuple(auto_axes)
         # Microbatch-shaped pytree for parameter shape inference (JAX builds
         # params from shapes; torch modules carry their own — this is the
         # one addition to the reference signature).

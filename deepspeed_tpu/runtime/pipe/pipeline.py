@@ -124,6 +124,7 @@ class PipelineParts:
     params: Dict[str, Any]              # {prologue, body, epilogue, tied}
     param_specs: Dict[str, Any]         # PartitionSpec pytree, same structure
     loss_fn: Callable                   # loss_fn(output, micro_batch)
+    auto_axes: tuple = ()               # GSPMD-mode mesh axes (module's)
 
     def prologue_apply(self, params, micro, rng=None):
         """tokens/micro-batch → first activation (first stage only)."""
@@ -189,17 +190,26 @@ def _is_mp_leaf(path, a, local=False):
             and a.ndim >= min_ndim)
 
 
-def body_param_specs(body_params):
+def body_param_specs(body_params, auto_axes=()):
     """Per-leaf PartitionSpecs for the stacked body [S, L/S, ...]: stage
     dim over ``pipe``; expert banks additionally put their bank dim (the
-    first post-stack dim) over ``expert``."""
+    first post-stack dim) over ``expert``.
+
+    ``auto_axes``: mesh axes left in GSPMD (auto) mode by a
+    partial-manual ``shard_map`` — their mentions are dropped (shard_map
+    in/out specs may only name manual axes; the auto-axis sharding lives
+    at the jit level and inside via sharding constraints)."""
 
     def spec(path, a):
         if _is_expert_leaf(path, a):
-            return P("pipe", None, "expert", *([None] * (a.ndim - 3)))
-        if _is_mp_leaf(path, a):
-            return P("pipe", None, "model", *([None] * (a.ndim - 3)))
-        return P("pipe", *([None] * (a.ndim - 1)))
+            s = P("pipe", None, "expert", *([None] * (a.ndim - 3)))
+        elif _is_mp_leaf(path, a):
+            s = P("pipe", None, "model", *([None] * (a.ndim - 3)))
+        else:
+            s = P("pipe", *([None] * (a.ndim - 1)))
+        if auto_axes:
+            s = P(*(None if ax in auto_axes else ax for ax in s))
+        return s
 
     return jax.tree_util.tree_map_with_path(spec, body_params)
 
@@ -277,11 +287,31 @@ def build_pipeline_parts(module, num_stages: int, rng,
     def spec_of(section):
         return jax.tree_util.tree_map(lambda _: P(), params[section])
 
+    # Body PLACEMENT specs: the name-based contract (mp_*/expert_*), or —
+    # when the layer carries GSPMD partition metadata (the flax adapter,
+    # `parallel/pipe_auto.py`) AND the module opted into auto axes — the
+    # layer's own per-leaf specs with the [stage, layers/stage] stacking
+    # dims prepended. Placement specs may name auto axes; the shard_map
+    # in/out specs (built per call in `_call_pipeline`) are what must
+    # stay manual-only. Without auto_axes the adapter metadata is
+    # deliberately IGNORED for placement: sharding body params over an
+    # axis the all-manual shard_map treats as replicated would at best
+    # resharde every step and at worst hit the CPU runtime's collective
+    # rendezvous deadlock the engine gate documents.
+    auto_axes = tuple(getattr(module, "auto_axes", ()) or ())
+    body_place_specs = body_param_specs(params["body"])
+    spec_fn = getattr(body_layer, "param_partition_specs", None)
+    if spec_fn is not None and auto_axes:
+        layer_specs = spec_fn(body_params[0])
+        body_place_specs = jax.tree_util.tree_map(
+            lambda sp: P("pipe", None, *tuple(sp)), layer_specs,
+            is_leaf=lambda x: isinstance(x, P))
+
     param_specs = {
         "prologue": spec_of("prologue"),
         "epilogue": spec_of("epilogue"),
         "tied": spec_of("tied"),
-        "body": body_param_specs(params["body"]),
+        "body": body_place_specs,
     }
 
     loss_fn = module.loss_fn
@@ -297,7 +327,8 @@ def build_pipeline_parts(module, num_stages: int, rng,
                          epilogue_layers=epilogue_layers,
                          params=params,
                          param_specs=param_specs,
-                         loss_fn=loss_fn)
+                         loss_fn=loss_fn,
+                         auto_axes=auto_axes)
 
 
 def sequential_loss_fn(parts: PipelineParts, params, micro_batches, rng=None):
@@ -334,19 +365,23 @@ def sequential_loss_fn(parts: PipelineParts, params, micro_batches, rng=None):
 # the compiled pipeline loss
 # ---------------------------------------------------------------------------
 def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
-                          remat: bool = True):
+                          remat: bool = True, auto_axes=None):
     """Build ``loss_fn(params, batch, rng)`` executing the GPipe rotation.
 
     ``batch``: pytree of ``[rows, ...]`` arrays, rows divisible by
     ``num_micro``; rows are data-sharded, microbatches run through the
     ``pipe`` axis wavefront. Differentiable end-to-end: ``jax.grad`` of this
     function performs the full backward pipeline (cooldown included).
+
+    ``auto_axes``: GSPMD-mode mesh axes (see ``_call_pipeline``);
+    defaults to the module's, recorded on ``parts``.
     """
+    auto_axes = _resolve_auto_axes(parts, mesh, auto_axes)
     S = parts.num_stages
     M = num_micro
     T = M + S - 1
     axis_tail = tuple(a for a in mesh.axis_names
-                      if a not in ("pipe", "data"))
+                      if a not in ("pipe", "data") and a not in auto_axes)
 
     def device_fn(body_local, rest, batch_local, rng, use_rng):
         # body_local arrives as [1, L/S, ...] — this stage's shard.
@@ -461,18 +496,54 @@ def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
 
     def pipeline_loss(params, batch, rng):
         return _call_pipeline(mesh, M, device_fn, params, batch, rng,
-                              out_specs=lambda body_specs, rest_specs: P())
+                              out_specs=lambda body_specs, rest_specs: P(),
+                              auto_axes=auto_axes)
 
     return pipeline_loss
 
 
+def _resolve_auto_axes(parts, mesh, auto_axes):
+    """One source of truth for the GSPMD-mode axes: the module's
+    (recorded on ``parts`` by ``build_pipeline_parts``, where the
+    placement specs were derived from it). An explicit argument must
+    agree — placement and shard_map manualness disagreeing is exactly
+    the silent-resharding / rendezvous-deadlock class this prevents."""
+    resolved = parts.auto_axes if auto_axes is None else tuple(auto_axes)
+    if tuple(resolved) != tuple(parts.auto_axes):
+        raise ValueError(
+            f"auto_axes {resolved} disagrees with the module's "
+            f"{parts.auto_axes} that built these parts (the body placement "
+            "specs were derived from the latter)")
+    unknown = set(resolved) - set(mesh.axis_names)
+    if unknown:
+        raise ValueError(
+            f"auto_axes {sorted(unknown)} are not mesh axes "
+            f"{tuple(mesh.axis_names)} — a typo here would silently "
+            "disable tensor parallelism")
+    bad = set(resolved) & {"pipe", "data", "seq"}
+    if bad:
+        raise ValueError(
+            f"auto_axes {sorted(bad)} must stay manual: the 1F1B schedule "
+            "ppermutes over pipe, batches shard over data, and the "
+            "sequence-parallel loss psums over seq")
+    return resolved
+
+
 def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
-                   out_specs=None):
+                   out_specs=None, auto_axes=()):
     """Shared shard_map wrapper for the pipeline programs: microbatch the
     batch rows, split off the replicated param groups, build the in/out
     specs, and invoke ``device_fn`` over the mesh. ``out_specs`` is a
     callable of (body_specs, rest_specs) so callers returning grads can
-    reuse the input layouts."""
+    reuse the input layouts.
+
+    ``auto_axes``: mesh axes the shard_map leaves in GSPMD (auto) mode —
+    arrays stay global along them inside ``device_fn`` and the user's
+    sharding constraints / param shardings drive the partitioning
+    (user-composable tensor parallelism: any flax model's GSPMD
+    annotations work inside the pipeline; see `parallel/pipe_auto.py`).
+    The pipe/data axes must stay manual (ppermute schedule, batch
+    sharding)."""
     batch_sharding = NamedSharding(mesh, P(None, "data"))
 
     def to_micro(a):
@@ -489,16 +560,19 @@ def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
     use_rng = rng is not None
     key = rng if use_rng else jnp.zeros((2,), jnp.uint32)
 
-    body_specs = body_param_specs(params["body"])
+    manual = tuple(a for a in mesh.axis_names if a not in auto_axes)
+    body_specs = body_param_specs(params["body"], auto_axes)
     rest_specs = jax.tree_util.tree_map(lambda _: P(), rest)
     batch_specs = jax.tree_util.tree_map(
         lambda _: P(None, "data"), batch_m)
 
     def manual_device_fn(*args, **kwargs):
-        # Declare every mesh axis manual while the device body traces:
+        # Declare the MANUAL mesh axes while the device body traces:
         # layers with explicit collectives (TP blocks, expert-parallel
-        # FFN) switch them on via parallel.collectives.axis_is_manual.
-        with manual_axes(mesh.axis_names):
+        # FFN) switch them on via parallel.collectives.axis_is_manual;
+        # auto axes stay GSPMD-driven (axis_is_manual False → manual
+        # collectives no-op, constraints rule).
+        with manual_axes(manual):
             return device_fn(*args, **kwargs)
 
     fn = jax.shard_map(
@@ -507,6 +581,7 @@ def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
         in_specs=(body_specs, rest_specs, batch_specs, P()) +
         tuple(P() for _ in extra),
         out_specs=out_specs(body_specs, rest_specs),
+        axis_names=set(manual),
         check_vma=False)
     return fn(params["body"], rest, batch_m, key, *extra)
 
@@ -516,7 +591,7 @@ def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
 # ---------------------------------------------------------------------------
 def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
                                     num_micro: int, compute_dtype=None,
-                                    data_local=False):
+                                    data_local=False, auto_axes=None):
     """Build ``vag(params, batch, rng, scale) -> (loss, grads)`` running a
     hand-scheduled 1F1B pipeline (the reference's ``TrainSchedule``
     interleave, `runtime/pipe/schedule.py:189-241`, executed rather than
@@ -545,13 +620,21 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
     a compressed collective to average instead (the analog of the
     reference disabling engine allreduce for OnebitAdam,
     onebit_adam.py:372).
+
+    ``auto_axes`` (round 5, user-composable TP): mesh axes left in GSPMD
+    mode — no manual collectives reference them (their reductions are
+    XLA's job); typically ``("model",)`` so any flax model's
+    ``nn.with_partitioning`` / sharding-constraint annotations do Megatron
+    TP inside the 1F1B without hand-written collectives. Defaults to the
+    module's, recorded on ``parts``.
     """
+    auto_axes = _resolve_auto_axes(parts, mesh, auto_axes)
     S = parts.num_stages
     M = num_micro
     T = M + 2 * S - 2
     K = 2 * S - 1
     axis_tail = tuple(a for a in mesh.axis_names
-                      if a not in ("pipe", "data"))
+                      if a not in ("pipe", "data") and a not in auto_axes)
     f32 = jnp.float32
 
     def cast(tree):
@@ -805,7 +888,7 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
         loss, gb, gr = _call_pipeline(
             mesh, M, device_fn, params, batch, rng,
             extra=(jnp.asarray(scale, jnp.float32),),
-            out_specs=_out_specs)
+            out_specs=_out_specs, auto_axes=auto_axes)
         grads = {"prologue": gr["prologue"], "body": gb,
                  "epilogue": gr["epilogue"], "tied": gr["tied"]}
         return loss, grads
