@@ -166,12 +166,28 @@ def main():
     parser.add_argument("--study", default="all",
                         choices=["all", "speed", "block", "maxseq"])
     args = parser.parse_args()
-    import jax
-    assert jax.devices()[0].platform == "tpu", \
-        "long-context bench needs the real chip"
 
     def emit(row):
         print(json.dumps(row), flush=True)
+
+    # Probe in a killable subprocess FIRST (bench.py's pattern): a wedged
+    # tunnel makes an in-process jax.devices() block forever — observed
+    # live: this script sat silent on it until the capture watchdog's
+    # 600 s stall kill. A probe bounds that to ~4 min and leaves a
+    # parseable error row instead of a kill marker.
+    from bench import probe_platform
+    hb("probing backend (subprocess, 240s cap)")
+    platform = probe_platform()
+    if platform != "tpu":
+        emit({"study": args.study, "error":
+              f"long-context bench needs the real chip; probe says "
+              f"{platform!r}"})
+        return 1
+    # The probe just confirmed 'tpu'; a re-assert here would itself be
+    # an unbounded in-process first-touch (the residual TOCTOU window —
+    # tunnel wedging between the probe child and the first device call —
+    # is inherent to every later jax call and bounded by the watchdog).
+    import jax
 
     if args.study in ("all", "speed"):
         study_speed(jax, emit)
@@ -182,4 +198,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
