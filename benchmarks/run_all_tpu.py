@@ -306,11 +306,13 @@ def main():
     for i, (group, tag, cmd, kw) in enumerate(plan):
         if not run(tag, cmd, tcp_watch=tcp_watch, **kw):
             failed.add(group)
+            if args.force:
+                continue    # plumbing mode ignores liveness — skip the probe
             # Same 120 s liveness threshold as the startup gate and the
             # probe loop — a shorter probe here would abort a rare live
             # window just because the tunnel answered slowly once.
             alive, detail = tpu_probe()
-            if not alive and not args.force:
+            if not alive:
                 rest = {g for g, *_ in plan[i + 1:]}
                 failed |= rest
                 log(f"tunnel gone mid-capture ({detail}); aborting pass, "

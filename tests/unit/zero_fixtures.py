@@ -70,5 +70,12 @@ def lowered_train_step(stage, accum=1, compiler_options=None):
     lowered = engine._compiled_train_step.lower(
         engine.params, engine.opt_state, engine.device_state, batch,
         jax.random.PRNGKey(1), jnp.asarray(1e-3, jnp.float32))
-    return lowered.compile(compiler_options) if compiler_options \
-        else lowered.compile()
+    if compiler_options:
+        # Dump options only take effect if XLA actually COMPILES: the
+        # warm-up step above (and same-HLO engines from earlier tests)
+        # can otherwise satisfy the compile from an executable cache and
+        # produce no dump (observed once under full-suite cache
+        # pressure). Clear between the warm-up and the dump compile.
+        jax.clear_caches()
+        return lowered.compile(compiler_options)
+    return lowered.compile()
