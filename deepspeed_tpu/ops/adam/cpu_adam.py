@@ -73,7 +73,10 @@ class DeepSpeedCPUAdam:
     def __del__(self):
         try:
             if self._pool is not None:
-                self._pool.shutdown(wait=False)
+                # Wait for in-flight _update_range work: the worker thread
+                # calls ds_adam_step on this opt_id, so destroying the C++
+                # optimizer under it is a use-after-free.
+                self._pool.shutdown(wait=True)
             self.lib.ds_destroy_adam(self.opt_id)
         except Exception:
             pass

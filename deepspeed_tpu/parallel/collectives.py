@@ -52,6 +52,31 @@ def axis_is_manual(axis_name):
     return axis_name in _MANUAL_AXES
 
 
+def scatter_to_chunk_servers(tree, axis_name):
+    """Chunk-server scatter: every leaf is a ``[world, ...]`` stack of
+    per-destination rows; rank r receives every rank's row r.
+
+    One ``all_to_all`` per leaf — the reduce-scatter half of the 2-phase
+    chunk-server topology shared by the 1-bit path
+    (`runtime/comm/compressed.py`, the reference's igather to chunk
+    servers at custom_collectives.py:23) and the int8 quantized path
+    (`runtime/comm/quantized.py`). Must run inside ``shard_map``."""
+    return jax.tree_util.tree_map(
+        lambda v: lax.all_to_all(v, axis_name, split_axis=0, concat_axis=0),
+        tree)
+
+
+def gather_from_chunk_servers(tree, axis_name):
+    """Chunk-server gather: every rank contributes its served (reduced)
+    chunk; all ranks receive the ``[world, ...]`` stack.
+
+    One ``all_gather`` per leaf — the second phase of the chunk-server
+    topology (the reference's final allgather, onebit_adam.py:200-228).
+    Must run inside ``shard_map``."""
+    return jax.tree_util.tree_map(
+        lambda v: lax.all_gather(v, axis_name), tree)
+
+
 def psum_grad(x, axis_name):
     """Identity in forward; ``psum`` of the cotangent over ``axis_name`` in
     backward. Makes grads of tensors consumed by axis-partitioned compute

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.utils.compat import shard_map
+
 from deepspeed_tpu.ops.pallas.flash_attention import (
     DEFAULT_MASK_VALUE,
     dropout_multiplier,
@@ -168,7 +170,7 @@ def ulysses_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
 
 def _seq_sharded_call(local_fn, mesh, q, k, v, seq_axis, data_axis):
     specs = P(data_axis, seq_axis, None, None)
-    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(specs, specs, specs),
+    fn = shard_map(local_fn, mesh=mesh, in_specs=(specs, specs, specs),
                        out_specs=specs, check_vma=False)
     return fn(q, k, v)
 

@@ -27,6 +27,10 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.parallel.collectives import (
+    gather_from_chunk_servers, scatter_to_chunk_servers)
+from deepspeed_tpu.utils.compat import axis_size
+
 __all__ = ["pack_signs", "unpack_signs", "compressed_allreduce",
            "error_feedback_sizes"]
 
@@ -98,7 +102,7 @@ def compressed_allreduce(x, worker_error, server_error, axis_name,
     the doubly-compressed average — identical on every rank, like the
     reference's final allgather (onebit_adam.py:200-228).
     """
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     padded_n = x.shape[-1]
     chunk = padded_n // world
@@ -113,11 +117,12 @@ def compressed_allreduce(x, worker_error, server_error, axis_name,
     packed, scale, new_worker_error = _compress(corrected, n_valid)
 
     # Phase 2 — exchange: rank r receives every rank's packed chunk r
-    # (the reference's igather to chunk servers, custom_collectives.py:23).
+    # (the reference's igather to chunk servers, custom_collectives.py:23;
+    # the same chunk-server scatter the int8 path in `comm/quantized.py`
+    # rides, factored into `parallel/collectives.py`).
     packed = packed.reshape(world, chunk // 8)
-    recv = jax.lax.all_to_all(packed, axis_name, split_axis=0,
-                              concat_axis=0)                 # [world, chunk/8]
-    scales = jax.lax.all_gather(scale, axis_name)            # [world]
+    recv = scatter_to_chunk_servers(packed, axis_name)       # [world, chunk/8]
+    scales = gather_from_chunk_servers(scale, axis_name)     # [world]
 
     # Phase 3 — server reduce + second compression (reference 160-199).
     decoded = unpack_signs(recv) * scales[:, None]           # [world, chunk]
@@ -134,8 +139,8 @@ def compressed_allreduce(x, worker_error, server_error, axis_name,
     new_server_error = jnp.where(valid, chunk_avg - s_scale * s_sgn, 0.0)
 
     # Phase 4 — allgather the served chunks (reference 200-228).
-    all_packed = jax.lax.all_gather(pack_signs(s_signs), axis_name)
-    all_scales = jax.lax.all_gather(s_scale, axis_name)      # [world]
+    all_packed, all_scales = gather_from_chunk_servers(
+        (pack_signs(s_signs), s_scale), axis_name)           # [world, ...]
     avg = (unpack_signs(all_packed) *
            all_scales[:, None]).reshape(padded_n)
     avg = jnp.where(jnp.arange(padded_n) < n_valid, avg, 0.0)

@@ -1,0 +1,214 @@
+"""Int8 chunk-scaled quantized all-reduce with backward-overlap bucketing.
+
+The dense-DP / ZeRO-1/2 gradient sync ships fp32 on the wire; this module
+replaces it with the EQuARX-style (arXiv:2506.17615) quantized exchange:
+
+- the flat gradient is cut into fixed ``chunk_size`` pieces, each encoded
+  as int8 against its own absmax scale (``scale = absmax / 127``);
+- phase 1 is a reduce-scatter *in int8*: rank r receives every rank's
+  quantized copy of shard r (the chunk-server ``all_to_all`` shared with
+  the 1-bit path, `parallel/collectives.py:scatter_to_chunk_servers`);
+- the server accumulates its shard in fp32 (one dequant + mean — the
+  "local fp32 accumulate" that keeps the reduction exact no matter the
+  world size), optionally re-applying a server error-feedback residual;
+- phase 2 re-quantizes the reduced shard and all-gathers it in int8
+  (`gather_from_chunk_servers`).
+
+Wire cost per device on a ring of N (send-bytes basis, n fp32 elements,
+chunk c): the int8 all_to_all moves (N-1)/N·(n + 4n/c) and the int8
+all_gather the same again — about 1.75·n(1 + 4/c) bytes vs 7·n for the
+fp32 ring all-reduce, a ~3.97x reduction at c = 512
+(`tests/unit/test_quantized_comm_volume.py` pins this from compiled HLO).
+
+Error feedback is optional: gradient averaging runs every step, so unlike
+1-bit Adam the quantization noise is zero-mean and unbiased per chunk;
+EF tightens the long-run bias at the cost of one n-sized residual per
+rank plus one shard-sized server residual (carried by the caller as
+explicit state, like `comm/compressed.py`).
+
+The bucketing layer (:func:`bucket_plan` / :func:`quantized_allreduce_tree`)
+groups the grad pytree into fixed-byte buckets, each synced by an
+independent collective chain, so XLA's latency-hiding scheduler can
+overlap the quantize+reduce of bucket k with the backward (or the
+dequant/update) of bucket k+1 — the reference's allreduce bucketing
+(engine.py:1082 ``allreduce_bucket``) expressed as graph structure.
+
+All collective entry points must run inside ``shard_map`` with
+``axis_name`` bound; quantize/dequantize are pure and testable anywhere.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel.collectives import (
+    gather_from_chunk_servers, scatter_to_chunk_servers)
+from deepspeed_tpu.utils.compat import axis_size
+
+__all__ = [
+    "quantize_chunks", "dequantize_chunks", "quantized_allreduce",
+    "quantized_allreduce_sizes", "bucket_plan", "init_residuals",
+    "quantized_allreduce_tree",
+]
+
+
+def quantize_chunks(x, chunk_size):
+    """Encode flat ``x`` (length divisible by ``chunk_size``) as
+    ``(q, scales)``: int8 values against per-chunk absmax scales.
+
+    ``q`` is ``[n_chunks, chunk_size]`` int8 in [-127, 127]; ``scales`` is
+    ``[n_chunks]`` fp32 with ``scale = absmax / 127`` (all-zero chunks get
+    scale 0, decoding back to exact zeros)."""
+    chunks = x.reshape(-1, chunk_size).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(chunks), axis=1)
+    scale = absmax / 127.0
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    q = jnp.clip(jnp.round(chunks / safe[:, None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_chunks(q, scales, dtype=jnp.float32):
+    """Inverse of :func:`quantize_chunks` (up to rounding): flat array."""
+    vals = q.astype(dtype) * scales[:, None].astype(dtype)
+    return vals.reshape(-1)
+
+
+def quantized_allreduce_sizes(n, world, chunk_size):
+    """(padded_n, shard) for an n-element buffer: ``padded_n`` is the
+    smallest multiple of ``world * chunk_size`` >= n, so every rank serves
+    a whole number of chunks (padding decodes to exact zeros)."""
+    align = world * chunk_size
+    padded = ((n + align - 1) // align) * align
+    return padded, padded // world
+
+
+def quantized_allreduce(x, axis_name, chunk_size=512,
+                        worker_residual=None, server_residual=None):
+    """Int8 chunk-scaled *averaging* all-reduce of flat ``x`` over
+    ``axis_name``. Must run inside ``shard_map``; ``x.shape[-1]`` must be
+    a multiple of ``world * chunk_size`` (:func:`quantized_allreduce_sizes`).
+
+    ``worker_residual`` ([n], per rank) and ``server_residual``
+    ([n/world], for the shard this rank serves) enable error feedback when
+    both are given: the residuals are added before each quantization and
+    the new quantization errors returned for the caller to carry.
+
+    Returns ``(avg, new_worker_residual, new_server_residual)`` — the
+    residuals are ``None`` when error feedback is off."""
+    world = axis_size(axis_name)
+    n = x.shape[-1]
+    shard = n // world
+    assert shard * world == n and shard % chunk_size == 0, (
+        f"buffer of {n} not aligned for world {world} x chunk "
+        f"{chunk_size}; use quantized_allreduce_sizes()")
+    ef = worker_residual is not None
+
+    # Worker quantization (+ optional error feedback).
+    corrected = x + worker_residual if ef else x
+    q, scales = quantize_chunks(corrected, chunk_size)
+    new_worker = corrected - dequantize_chunks(q, scales) if ef else None
+
+    # Reduce-scatter in int8: rank r collects every rank's shard r.
+    cps = shard // chunk_size  # chunks per shard
+    recv_q, recv_s = scatter_to_chunk_servers(
+        (q.reshape(world, cps, chunk_size), scales.reshape(world, cps)),
+        axis_name)
+
+    # Local fp32 accumulate of the served shard.
+    shard_avg = (recv_q.astype(jnp.float32) *
+                 recv_s[:, :, None]).mean(axis=0).reshape(shard)
+    if ef:
+        shard_avg = shard_avg + server_residual
+
+    # Re-quantize + all-gather in int8.
+    q2, s2 = quantize_chunks(shard_avg, chunk_size)
+    new_server = shard_avg - dequantize_chunks(q2, s2) if ef else None
+    all_q, all_s = gather_from_chunk_servers((q2, s2), axis_name)
+    avg = dequantize_chunks(all_q.reshape(-1, chunk_size),
+                            all_s.reshape(-1))
+    return avg, new_worker, new_server
+
+
+def bucket_plan(leaves, world, bucket_bytes, chunk_size):
+    """Group flat leaf sizes into fixed-byte buckets.
+
+    ``leaves`` is a list of (flattened) element counts in pytree order.
+    Greedy in-order packing: a bucket closes once it holds >=
+    ``bucket_bytes`` worth of fp32 elements, so consecutive backward-order
+    leaves share a collective while the pytree order (and therefore the
+    caller's concat/split bookkeeping) stays trivial.
+
+    Returns a list of buckets, each ``(leaf_slice, n, padded_n)`` where
+    ``leaf_slice`` indexes the member leaves, ``n`` their total elements,
+    and ``padded_n`` the aligned buffer size from
+    :func:`quantized_allreduce_sizes`."""
+    per_bucket = max(int(bucket_bytes) // 4, 1)
+    buckets = []
+    start, total = 0, 0
+    for i, size in enumerate(leaves):
+        total += int(size)
+        if total >= per_bucket:
+            padded, _ = quantized_allreduce_sizes(total, world, chunk_size)
+            buckets.append((slice(start, i + 1), total, padded))
+            start, total = i + 1, 0
+    if total > 0 or not buckets:
+        total = max(total, 1)
+        padded, _ = quantized_allreduce_sizes(total, world, chunk_size)
+        buckets.append((slice(start, len(leaves)), total, padded))
+    return buckets
+
+
+def init_residuals(grads, world, bucket_bytes, chunk_size):
+    """Zero error-feedback state for :func:`quantized_allreduce_tree` over
+    a gradient pytree: per bucket, a ``[world, padded_n]`` worker residual
+    stack (row r lives on rank r) and a ``[world, padded_n/world]`` server
+    stack (row r is the shard rank r serves)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    plan = bucket_plan([l.size for l in leaves], world, bucket_bytes,
+                       chunk_size)
+    return {
+        "worker": [jnp.zeros((world, padded), jnp.float32)
+                   for _, _, padded in plan],
+        "server": [jnp.zeros((world, padded // world), jnp.float32)
+                   for _, _, padded in plan],
+    }
+
+
+def quantized_allreduce_tree(grads, axis_name, chunk_size=512,
+                             bucket_bytes=4 * 1024 * 1024, residuals=None):
+    """Bucketed int8 averaging all-reduce of a gradient pytree.
+
+    Flattens the tree, packs leaves into ~``bucket_bytes`` buckets
+    (:func:`bucket_plan`), and runs one :func:`quantized_allreduce` per
+    bucket — independent collective chains XLA can overlap with
+    neighbouring compute. ``residuals`` is the (shard_map-local) state
+    from :func:`init_residuals` rows, i.e. per-bucket ``worker`` [padded]
+    and ``server`` [padded/world] vectors, or ``None`` for no EF.
+
+    Returns ``(avg_tree, new_residuals)``."""
+    world = axis_size(axis_name)
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    plan = bucket_plan([l.size for l in leaves], world, bucket_bytes,
+                       chunk_size)
+
+    out_leaves = [None] * len(leaves)
+    new_res = {"worker": [], "server": []} if residuals is not None else None
+    for b, (sl, n, padded) in enumerate(plan):
+        members = leaves[sl]
+        flat = jnp.concatenate(
+            [m.reshape(-1).astype(jnp.float32) for m in members]) \
+            if len(members) > 1 else members[0].reshape(-1).astype(jnp.float32)
+        if padded > n:
+            flat = jnp.pad(flat, (0, padded - n))
+        we = residuals["worker"][b] if residuals is not None else None
+        se = residuals["server"][b] if residuals is not None else None
+        avg, we2, se2 = quantized_allreduce(
+            flat, axis_name, chunk_size=chunk_size,
+            worker_residual=we, server_residual=se)
+        if new_res is not None:
+            new_res["worker"].append(we2)
+            new_res["server"].append(se2)
+        off = 0
+        for j, m in zip(range(sl.start, sl.stop), members):
+            out_leaves[j] = avg[off:off + m.size].reshape(m.shape)
+            off += m.size
+    return jax.tree_util.tree_unflatten(treedef, out_leaves), new_res

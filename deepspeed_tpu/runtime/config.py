@@ -246,6 +246,32 @@ def get_mesh_config(param_dict):
     return param_dict.get(MESH, MESH_DEFAULT)
 
 
+class CommQuantizationConfig:
+    """Typed view of the ``comm_quantization`` block: the int8
+    chunk-scaled gradient all-reduce (`runtime/comm/quantized.py`)."""
+
+    def __init__(self, param_dict):
+        sub = param_dict.get(COMM_QUANTIZATION, {}) or {}
+        self.enabled = get_scalar_param(sub, COMM_QUANTIZATION_ENABLED,
+                                        COMM_QUANTIZATION_ENABLED_DEFAULT)
+        self.bits = get_scalar_param(sub, COMM_QUANTIZATION_BITS,
+                                     COMM_QUANTIZATION_BITS_DEFAULT)
+        self.chunk_size = get_scalar_param(
+            sub, COMM_QUANTIZATION_CHUNK_SIZE,
+            COMM_QUANTIZATION_CHUNK_SIZE_DEFAULT)
+        self.bucket_mb = get_scalar_param(sub, COMM_QUANTIZATION_BUCKET_MB,
+                                          COMM_QUANTIZATION_BUCKET_MB_DEFAULT)
+        self.error_feedback = get_scalar_param(
+            sub, COMM_QUANTIZATION_ERROR_FEEDBACK,
+            COMM_QUANTIZATION_ERROR_FEEDBACK_DEFAULT)
+
+    def __repr__(self):
+        return (f"CommQuantizationConfig(enabled={self.enabled}, "
+                f"bits={self.bits}, chunk_size={self.chunk_size}, "
+                f"bucket_mb={self.bucket_mb}, "
+                f"error_feedback={self.error_feedback})")
+
+
 class DeepSpeedConfig:
     def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
         if param_dict is None:
@@ -372,6 +398,7 @@ class DeepSpeedConfig:
         self.sparse_attention = get_sparse_attention(param_dict)
         self.pipeline = get_pipeline_config(param_dict)
         self.mesh_shape = get_mesh_config(param_dict)
+        self.comm_quantization = CommQuantizationConfig(param_dict)
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size
@@ -438,6 +465,30 @@ class DeepSpeedConfig:
             "DeepSpeedConfig: gradient_accumulation_steps is not defined"
         if self.fp16_enabled and self.bf16_enabled:
             raise ValueError("fp16 and bf16 cannot both be enabled")
+        if self.comm_quantization.enabled:
+            cq = self.comm_quantization
+            assert cq.bits == 8, (
+                f"comm_quantization: only 8-bit quantization is "
+                f"implemented, got bits={cq.bits}")
+            assert cq.chunk_size > 0 and cq.chunk_size % 2 == 0, (
+                f"comm_quantization: chunk_size must be a positive even "
+                f"int, got {cq.chunk_size}")
+            assert cq.bucket_mb > 0, (
+                f"comm_quantization: bucket_mb must be positive, "
+                f"got {cq.bucket_mb}")
+            assert self.zero_optimization_stage <= 2, (
+                "comm_quantization covers the dense-DP / ZeRO-1/2 gradient "
+                "sync; ZeRO-3 shards params per-use and has no full-grad "
+                "all-reduce to quantize")
+            assert self.optimizer_name != ONEBIT_ADAM_OPTIMIZER, (
+                "comm_quantization and OneBitAdam both replace the "
+                "gradient all-reduce — enable one comm compressor only")
+            assert not self.sparse_gradients_enabled, (
+                "comm_quantization is incompatible with sparse_gradients "
+                "(the CSR path runs its own per-leaf exchange)")
+            assert self.zero_config.cpu_offload is not True, (
+                "comm_quantization requires the in-jit update path; "
+                "ZeRO-Offload steps the optimizer on host")
 
     def _do_warning_check(self):
         fp16_enabled = self.fp16_enabled

@@ -195,3 +195,19 @@ MESH_DEFAULT = None
 # 1-bit Adam comm compression (reference: runtime/fp16/onebit_adam.py)
 ONEBIT_ADAM_FREEZE_STEP = "freeze_step"
 ONEBIT_ADAM_FREEZE_STEP_DEFAULT = 100000
+
+# Int8 quantized gradient all-reduce (EQuARX-style; runtime/comm/quantized.py).
+# Chunk-wise absmax-scaled int8 reduce-scatter + all-gather for the dense-DP /
+# ZeRO-1/2 gradient sync, with optional error-feedback residuals and
+# fixed-byte bucketing for backward overlap.
+COMM_QUANTIZATION = "comm_quantization"
+COMM_QUANTIZATION_ENABLED = "enabled"
+COMM_QUANTIZATION_ENABLED_DEFAULT = False
+COMM_QUANTIZATION_BITS = "bits"
+COMM_QUANTIZATION_BITS_DEFAULT = 8
+COMM_QUANTIZATION_CHUNK_SIZE = "chunk_size"
+COMM_QUANTIZATION_CHUNK_SIZE_DEFAULT = 512
+COMM_QUANTIZATION_BUCKET_MB = "bucket_mb"
+COMM_QUANTIZATION_BUCKET_MB_DEFAULT = 4
+COMM_QUANTIZATION_ERROR_FEEDBACK = "error_feedback"
+COMM_QUANTIZATION_ERROR_FEEDBACK_DEFAULT = False

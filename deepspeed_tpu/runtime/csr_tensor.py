@@ -26,6 +26,8 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.utils.compat import axis_size
+
 __all__ = ["CSRTensor", "csr_allreduce", "embedding_grad_csr",
            "dense_to_csr"]
 
@@ -100,7 +102,7 @@ def csr_allreduce(csr: CSRTensor, axis_name="data", average=True):
     exactly like the reference's allgathered result; ``to_dense`` resolves
     duplicates.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     all_idx = jax.lax.all_gather(csr.indices, axis_name)    # [world, k]
     all_val = jax.lax.all_gather(csr.values, axis_name)     # [world, k, d]
     values = all_val.reshape(world * csr.indices.shape[0], -1)

@@ -47,6 +47,7 @@ from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
 from deepspeed_tpu.ops.adam.fused_adam import adam_update, init_adam_state
 from deepspeed_tpu.ops.lamb.fused_lamb import init_lamb_state, lamb_update
 from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.utils.compat import shard_map
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
@@ -412,6 +413,11 @@ class DeepSpeedEngine:
         self._grad_buffer = None
         self._pending_batch = None
         self._last_metrics = {}
+        # Error-feedback residual state for the int8 quantized all-reduce
+        # (`runtime/comm/quantized.py`); populated lazily by
+        # `_make_quantized_train_step` when comm_quantization.error_feedback
+        # is on. Ephemeral comm state — intentionally not checkpointed.
+        self._qcomm_residuals = None
 
         if self._config.dump_state:
             self._config.print("DeepSpeedEngine configuration")
@@ -753,6 +759,8 @@ class DeepSpeedEngine:
             return self._make_onebit_train_step()
         if self.sparse_gradients_enabled():
             return self._make_sparse_grad_train_step()
+        if self._config.comm_quantization.enabled:
+            return self._make_quantized_train_step()
         accum = self._engine_accum_steps()
         compute_dtype = self.compute_dtype
         fp16 = self._config.fp16_enabled
@@ -827,6 +835,180 @@ class DeepSpeedEngine:
         # outputs are pinned by the constrain_tree calls above, so plain jit
         # with donation suffices.
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def _make_quantized_train_step(self):
+        """Compiled step with the int8 chunk-scaled gradient all-reduce
+        (`runtime/comm/quantized.py`) in place of the fp32 GSPMD mean.
+
+        Hybrid structure: gradient compute + quantized exchange run inside
+        ``shard_map`` over the ``data`` axis (each rank sees local grads,
+        exactly like the 1-bit path), but the epilogue and optimizer
+        update run OUTSIDE, in GSPMD — so the ZeRO-1/2 sharded master
+        update (and its param-refresh all-gather) composes unchanged, and
+        the wire carries int8 grads + fp32 param refresh only."""
+        from deepspeed_tpu.runtime.comm.quantized import (
+            init_residuals, quantized_allreduce_tree)
+
+        cq = self._config.comm_quantization
+        for ax, size in self.mesh.shape.items():
+            assert ax == "data" or size == 1, (
+                f"comm_quantization supports pure data parallelism; mesh "
+                f"axis {ax!r} has size {size}")
+        assert getattr(self.loss_fn, "direct_value_and_grad", None) is None \
+            and getattr(self.loss_fn, "direct_value_and_grad_local",
+                        None) is None, (
+            "comm_quantization needs jax.grad-able loss_fn (the pipeline's "
+            "direct value-and-grad runs its own data-plane reduction)")
+
+        accum = self._engine_accum_steps()
+        compute_dtype = self.compute_dtype
+        fp16 = self._config.fp16_enabled
+        clip = float(self._config.gradient_clipping or 0.0)
+        lr_fn = self._lr_fn
+        mom_fn = self._mom_fn
+        opt_update = self._opt_update
+        loss_fn = self.loss_fn
+        scale_args = self._scale_args()
+        dynamic = self.dynamic_loss_scale
+        static_scale = self.static_loss_scale
+        chunk_size = int(cq.chunk_size)
+        bucket_bytes = int(cq.bucket_mb) * 1024 * 1024
+        ef = bool(cq.error_feedback)
+        world = self.dp_world_size
+        grad_shardings = self._shardings["grad"] if \
+            self.zero_optimization_stage() >= 2 else None
+        param_shardings = self._shardings["param"]
+        opt_shardings = self._shardings["opt"]
+        grad_constrain = (lambda g: constrain_tree(g, grad_shardings)) \
+            if grad_shardings is not None else None
+        accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum)
+        pld_fn = self._pld_theta_fn()
+
+        if ef and self._qcomm_residuals is None:
+            res = init_residuals(self.params, world, bucket_bytes,
+                                 chunk_size)
+            row = NamedSharding(self.mesh, PartitionSpec("data", None))
+            self._qcomm_residuals = jax.device_put(res, jax.tree_util.
+                                                   tree_map(lambda _: row,
+                                                            res))
+        n_buckets = len(self._qcomm_residuals["worker"]) if ef else 0
+
+        def sync_local(params, dstate, batch, rng, residuals):
+            """shard_map body: local grads → unscale → overflow vote →
+            bucketed int8 exchange. Returns replicated (loss, grads,
+            overflow) + this rank's new residual rows."""
+            scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
+                else jnp.asarray(static_scale, jnp.float32)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            loss_kw = {"pld_theta": pld_fn(dstate.global_step)} \
+                if pld_fn is not None else None
+            loss_sum, grads = accumulate(params, batch, rng, scale, loss_kw)
+
+            # Unscale BEFORE the exchange (the GSPMD path unscales after
+            # its allreduce): absmax quantization scales must be computed
+            # on finite values, and EF residuals must not depend on the
+            # running loss scale.
+            denom = scale * accum
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / denom, grads)
+            if fp16:
+                # Overflow is voted on LOCAL grads pre-quantization — an
+                # inf/nan absmax poisons the int8 encoding (inf/inf = nan),
+                # so overflowed steps ship zeros and are skipped anyway.
+                overflow = jax.lax.pmax(
+                    check_overflow(grads).astype(jnp.int32), "data") > 0
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(overflow, 0.0, g), grads)
+            else:
+                overflow = jnp.asarray(False)
+
+            r = None
+            if ef:
+                r = {"worker": [w[0] for w in residuals["worker"]],
+                     "server": [s[0] for s in residuals["server"]]}
+            avg, new_r = quantized_allreduce_tree(
+                grads, "data", chunk_size=chunk_size,
+                bucket_bytes=bucket_bytes, residuals=r)
+            loss_sum = jax.lax.pmean(loss_sum, "data")
+            res_out = None
+            if ef:
+                res_out = {"worker": [w[None] for w in new_r["worker"]],
+                           "server": [s[None] for s in new_r["server"]]}
+            return loss_sum, avg, overflow, res_out
+
+        P = PartitionSpec
+        rep = P()
+        param_specs = jax.tree_util.tree_map(lambda _: rep, self.params)
+        dstate_specs = jax.tree_util.tree_map(lambda _: rep,
+                                              self.device_state)
+        grad_specs = param_specs
+        res_specs = {"worker": [P("data", None)] * n_buckets,
+                     "server": [P("data", None)] * n_buckets} if ef else rep
+        res_out_specs = res_specs if ef else rep
+        synced = shard_map(
+            sync_local, mesh=self.mesh,
+            in_specs=(param_specs, dstate_specs, P(None, "data"), rep,
+                      res_specs),
+            out_specs=(rep, grad_specs, rep, res_out_specs),
+            check_vma=False)
+
+        def train_step(params, opt_state, dstate, batch, rng, lr_in,
+                       residuals):
+            loss_sum, grads, voted, new_res = synced(params, dstate, batch,
+                                                     rng, residuals)
+            # GSPMD epilogue on the replicated, already-averaged gradient:
+            # scale/accum are 1 (the shard_map body unscaled), the vote ORs
+            # in the pre-quantization cross-rank overflow.
+            grads, overflow, grad_norm, applied_norm = grad_epilogue(
+                grads, jnp.asarray(1.0, jnp.float32), 1, fp16, clip,
+                constrain=grad_constrain, vote=lambda o: o | voted)
+
+            lr = lr_fn(dstate.global_step) if lr_fn is not None else lr_in
+            beta1 = mom_fn(dstate.global_step)
+            new_params, new_opt = opt_update(params, grads, opt_state, lr,
+                                             beta1)
+
+            def select(old, new):
+                return jax.tree_util.tree_map(
+                    lambda o, n: jnp.where(overflow, o, n), old, new)
+            params_out = constrain_tree(select(params, new_params),
+                                        param_shardings)
+            opt_out = type(opt_state)(
+                m=constrain_tree(select(opt_state.m, new_opt.m),
+                                 opt_shardings),
+                v=constrain_tree(select(opt_state.v, new_opt.v),
+                                 opt_shardings),
+                step=jnp.where(overflow, opt_state.step, new_opt.step))
+
+            dstate_out = loss_scale_epilogue(dstate, overflow, fp16,
+                                             dynamic, scale_args)
+            scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
+                else jnp.asarray(static_scale, jnp.float32)
+            metrics = step_metrics(loss_sum, accum, grad_norm, applied_norm,
+                                   lr, scale, overflow)
+            return params_out, opt_out, dstate_out, metrics, new_res
+
+        if not ef:
+            # Signature-compatible with the dense step: residuals pinned
+            # to None so jit sees the same 6 logical inputs.
+            def train_step_no_res(params, opt_state, dstate, batch, rng,
+                                  lr_in):
+                out = train_step(params, opt_state, dstate, batch, rng,
+                                 lr_in, None)
+                return out[0], out[1], out[2], out[3]
+            return jax.jit(train_step_no_res, donate_argnums=(0, 1, 2))
+
+        inner = jax.jit(train_step, donate_argnums=(0, 1, 2, 6))
+        engine = self
+
+        def compiled(params, opt_state, dstate, batch, rng, lr_in):
+            params, opt_state, dstate, metrics, engine._qcomm_residuals = \
+                inner(params, opt_state, dstate, batch, rng, lr_in,
+                      engine._qcomm_residuals)
+            return params, opt_state, dstate, metrics
+
+        compiled.inner = inner
+        return compiled
 
     def _upload_offload_params(self):
         """Device copy of the host fp32 masters at compute dtype (init /
@@ -1017,30 +1199,44 @@ class DeepSpeedEngine:
         opt._step += 1
         lr, b1 = float(metrics["lr"]), float(metrics["beta1"])
         futs = []
-        for r, lo, n, _ in ranges:
-            if n:
-                opt._grad_buf[lo:lo + n] = np.asarray(
-                    shards[r], np.float32).reshape(-1)[:n]
-            futs.append(opt._pool.submit(
-                opt._update_range, opt._step, lr, b1, lo, n, bf16)
-                if n else None)
-        if bf16:
-            import ml_dtypes
-            src, np_dtype = opt._bf16_buf.view(ml_dtypes.bfloat16), \
-                ml_dtypes.bfloat16
-        else:
-            src, np_dtype = opt.master, np.dtype(self.compute_dtype)
-        arrays = []
-        for (r, lo, n, d), f in zip(ranges, futs):
-            if f is not None:
-                f.result()
-            if n == chunk and src.dtype == np_dtype:
-                row = src[lo:lo + chunk].reshape(1, chunk)
-            else:
-                row = np.zeros((1, chunk), np_dtype)
+        try:
+            for r, lo, n, _ in ranges:
                 if n:
-                    row[0, :n] = src[lo:lo + n]
-            arrays.append(jax.device_put(row, d))
+                    opt._grad_buf[lo:lo + n] = np.asarray(
+                        shards[r], np.float32).reshape(-1)[:n]
+                futs.append(opt._pool.submit(
+                    opt._update_range, opt._step, lr, b1, lo, n, bf16)
+                    if n else None)
+            if bf16:
+                import ml_dtypes
+                src, np_dtype = opt._bf16_buf.view(ml_dtypes.bfloat16), \
+                    ml_dtypes.bfloat16
+            else:
+                src, np_dtype = opt.master, np.dtype(self.compute_dtype)
+            arrays = []
+            for (r, lo, n, d), f in zip(ranges, futs):
+                if f is not None:
+                    f.result()
+                if n == chunk and src.dtype == np_dtype:
+                    row = src[lo:lo + chunk].reshape(1, chunk)
+                else:
+                    row = np.zeros((1, chunk), np_dtype)
+                    if n:
+                        row[0, :n] = src[lo:lo + n]
+                arrays.append(jax.device_put(row, d))
+        finally:
+            # On any failure above, no submitted Adam range may still be
+            # running (or queued) once we unwind: the worker mutates the
+            # shared master/moment buffers, and the next train_batch —
+            # or interpreter teardown — would race it. Cancel what never
+            # started, drain what did; secondary errors must not mask
+            # the original exception.
+            for f in futs:
+                if f is not None and not f.cancel():
+                    try:
+                        f.result()
+                    except Exception:
+                        pass
         garr = jax.make_array_from_single_device_arrays(
             (D, chunk), sharding, arrays)
         self.params = self._offload_assemble_jit()(garr)
@@ -1110,8 +1306,14 @@ class DeepSpeedEngine:
         precision-lossless."""
         opt = self.cpu_optimizer
         total = opt.total
-        rep = NamedSharding(self.mesh, PartitionSpec())
-        gather = jax.jit(lambda x: x, out_shardings=rep)
+        if getattr(self, "_offload_gather_fn", None) is None:
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            # Cached like _offload_assemble_jit: all three buffers (and
+            # every later checkpoint) share one [D, chunk] program, so
+            # rebuilding the jit per call just forces retrace+recompile.
+            self._offload_gather_fn = jax.jit(lambda x: x,
+                                              out_shardings=rep)
+        gather = self._offload_gather_fn
         for buf in (opt.master, opt.exp_avg, opt.exp_avg_sq):
             garr = self._scatter_local_rows(buf, np.float32)
             buf[:] = np.asarray(gather(garr)).reshape(-1)[:total]
@@ -1298,7 +1500,7 @@ class DeepSpeedEngine:
                                           "loss_scale", "overflow",
                                           "sparse_grad_dropped",
                                           "sparse_grad_dense_fallbacks")}
-        mapped = jax.shard_map(
+        mapped = shard_map(
             step_local, mesh=self.mesh,
             in_specs=(param_specs, opt_specs, dstate_specs, P(None, "data"),
                       rep, rep),
@@ -1390,7 +1592,7 @@ class DeepSpeedEngine:
         metrics_specs = {k: rep for k in ("loss", "grad_norm",
                                           "applied_grad_norm", "lr",
                                           "loss_scale", "overflow")}
-        mapped = jax.shard_map(
+        mapped = shard_map(
             step_local, mesh=self.mesh,
             in_specs=(param_specs, opt_specs, dstate_specs, P(None, "data"),
                       rep, rep),
@@ -1569,7 +1771,7 @@ class DeepSpeedEngine:
             return (restore_body(new_p), restore_body(new_m),
                     restore_body(new_v), we_out, se_out, new_step)
 
-        mapped_upd = jax.shard_map(
+        mapped_upd = shard_map(
             upd, mesh=mesh,
             in_specs=(param_specs, grad_specs, param_specs, param_specs,
                       err_spec, err_spec, P(), P(), P(), P()),
@@ -2046,7 +2248,11 @@ class DeepSpeedEngine:
         # CURRENT mesh/shardings) — restoring with the saved shardings
         # trips orbax's "unsafe when restoring on a different topology"
         # path, which is exactly the elastic/restage case we support.
-        item_meta = ckptr.metadata(state_path).item_metadata
+        # Newer orbax wraps the metadata pytree in .item_metadata; 0.7.x
+        # returns the ArrayMetadata pytree directly. Same structure either
+        # way — it only feeds the tree_map below.
+        meta = ckptr.metadata(state_path)
+        item_meta = getattr(meta, "item_metadata", meta)
         restore_args = jax.tree_util.tree_map(
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), item_meta)
         restored = ckptr.restore(state_path, restore_args=restore_args)

@@ -45,6 +45,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.parallel.collectives import manual_axes
+from deepspeed_tpu.utils.compat import axis_size, shard_map
 from deepspeed_tpu.runtime.pipe.module import LayerSpec, TiedLayerSpec
 
 
@@ -575,7 +576,7 @@ def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
         with manual_axes(manual):
             return device_fn(*args, **kwargs)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(manual_device_fn, use_rng=use_rng),
         mesh=mesh,
         in_specs=(body_specs, rest_specs, batch_specs, P()) +
@@ -824,7 +825,7 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
         else:
             # scalar-mean losses cannot express seq-sharded token counts;
             # sequence-parallel modules must return (loss_sum, weight)
-            n_data = lax.axis_size("data")
+            n_data = axis_size("data")
             loss = lax.pmean(lax.psum(num_sum, "pipe") / M, "data")
             gscale = 1.0 / (M * n_data)
         # body grads stay pipe-sharded; rest grads sum across the stages
@@ -832,7 +833,7 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
         if data_local:
             # Scale so the MEAN over data ranks equals the true gradient:
             # mean_r(n_data * g_r * gscale) = sum_r g_r * gscale.
-            n_data = lax.axis_size("data")
+            n_data = axis_size("data")
             gb_acc = jax.tree_util.tree_map(
                 lambda a: a * (gscale * n_data), gb_acc)
             gr_acc = jax.tree_util.tree_map(

@@ -27,6 +27,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.utils.compat import shard_map
+
 
 def zero_partition_spec(shape, base_spec, mesh, axis="data"):
     """Augment ``base_spec`` by sharding one more dimension over ``axis``.
@@ -125,7 +127,7 @@ def _gather_cast_leaf(mesh, spec, dtype, axis):
         return jax.lax.all_gather(xs.astype(dtype), axis, axis=dim,
                                   tiled=True)
 
-    fwd_impl = jax.shard_map(inner, mesh=mesh, in_specs=(spec,),
+    fwd_impl = shard_map(inner, mesh=mesh, in_specs=(spec,),
                              out_specs=out_spec, check_vma=False)
 
     @jax.custom_vjp

@@ -1,0 +1,85 @@
+"""JAX version-compat surface for the handful of APIs that moved.
+
+The repo targets the modern spellings (``jax.shard_map`` with
+``check_vma``, ``jax.set_mesh``); older jaxlibs (<= 0.4.x) only ship
+``jax.experimental.shard_map.shard_map`` with ``check_rep`` and the
+``with mesh:`` resource-env context. Every call site imports from here
+so the version split lives in exactly one file.
+"""
+
+import contextlib
+
+import jax
+
+__all__ = ["shard_map", "set_mesh", "axis_size", "get_abstract_mesh"]
+
+
+if hasattr(jax.sharding, "get_abstract_mesh"):
+
+    def get_abstract_mesh():
+        mesh = jax.sharding.get_abstract_mesh()
+        return None if mesh is None or mesh.empty else mesh
+
+else:
+
+    def get_abstract_mesh():
+        # Legacy: the ``with mesh:`` resource env holds a physical mesh.
+        from jax._src import mesh as mesh_lib
+        mesh = mesh_lib.thread_resources.env.physical_mesh
+        return None if mesh.empty else mesh
+
+
+if hasattr(jax.lax, "axis_size"):
+
+    def axis_size(axis_name):
+        return jax.lax.axis_size(axis_name)
+
+else:
+
+    def axis_size(axis_name):
+        # pre-0.5: the bound axis frame carries the static size (returns a
+        # plain int under shard_map tracing, same as jax.lax.axis_size)
+        frame = jax.core.axis_frame(axis_name)
+        return frame if isinstance(frame, int) else frame.size
+
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                  axis_names=None):
+        if axis_names is None:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=check_vma)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma,
+                             axis_names=set(axis_names))
+
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                  axis_names=None):
+        # pre-0.5 spellings: replication checking is ``check_rep``, and
+        # the manual-axis subset is expressed inversely — ``auto`` is the
+        # set of mesh axes left to GSPMD (modern ``axis_names`` lists the
+        # manually-mapped ones).
+        auto = frozenset() if axis_names is None else \
+            frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma,
+                          auto=auto)
+
+
+if hasattr(jax, "set_mesh"):
+
+    def set_mesh(mesh):
+        return jax.set_mesh(mesh)
+
+else:
+
+    @contextlib.contextmanager
+    def set_mesh(mesh):
+        # Legacy resource-env context: ``with mesh:`` gives
+        # with_sharding_constraint(PartitionSpec) the same axis names.
+        with mesh:
+            yield mesh

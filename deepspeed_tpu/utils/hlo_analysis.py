@@ -46,7 +46,7 @@ _OP_RE = re.compile(
 
 
 def _element_bytes(shape_text, skip_scalars=False):
-    """Byte size of each array element appearing in a (tuple) shape.
+    """(dtype, bytes) of each array element appearing in a (tuple) shape.
     ``skip_scalars`` drops zero-rank elements (async-start context/scratch
     scalars like ``u32[]``, which are bookkeeping, not payload)."""
     sizes = []
@@ -59,15 +59,15 @@ def _element_bytes(shape_text, skip_scalars=False):
         if dims:
             for d in dims.split(","):
                 n *= int(d)
-        sizes.append(n * _DTYPE_BYTES[dtype])
+        sizes.append((dtype, n * _DTYPE_BYTES[dtype]))
     return sizes
 
 
 def _shape_bytes(shape_text):
-    return sum(_element_bytes(shape_text))
+    return sum(b for _, b in _element_bytes(shape_text))
 
 
-def collective_bytes(hlo_text):
+def collective_bytes(hlo_text, by_dtype=False):
     """Sum output bytes of every collective op in an HLO dump.
 
     Returns ``{op_name: bytes, ..., "total": bytes}``. Async pairs are
@@ -78,6 +78,11 @@ def collective_bytes(hlo_text):
     size, so "output bytes" is the per-device payload in both directions
     of a symmetric exchange — a consistent basis for *ratios* between two
     programs, which is what the tests pin.
+
+    With ``by_dtype=True`` every per-op entry is a ``{dtype: bytes}``
+    dict instead ("total" stays a plain sum) — how the quantized-allreduce
+    proof separates the int8 gradient exchange from same-op fp32 traffic
+    (scale vectors, the ZeRO-1 param-refresh gather) sharing the program.
     """
     counts = {}
     for m in _OP_RE.finditer(hlo_text):
@@ -96,12 +101,19 @@ def collective_bytes(hlo_text):
         # included, and the second half is the results.
         if m.group("suffix") == "-start" and shape.startswith("("):
             elems = _element_bytes(shape, skip_scalars=True)
-            b = sum(elems[len(elems) // 2:])
+            elems = elems[len(elems) // 2:]
         else:
-            b = _shape_bytes(shape)
-        counts[op] = counts.get(op, 0) + b
-    counts["total"] = sum(counts.values())
-    return counts
+            elems = _element_bytes(shape)
+        per_op = counts.setdefault(op, {})
+        for dtype, b in elems:
+            per_op[dtype] = per_op.get(dtype, 0) + b
+    if by_dtype:
+        out = {op: dict(d) for op, d in counts.items()}
+        out["total"] = sum(b for d in counts.values() for b in d.values())
+        return out
+    flat = {op: sum(d.values()) for op, d in counts.items()}
+    flat["total"] = sum(flat.values())
+    return flat
 
 
 # Per-device ring-algorithm send bytes as a multiple of the op's OUTPUT
@@ -122,7 +134,7 @@ _RING_SEND_FACTORS = {
 assert set(_RING_SEND_FACTORS) == set(_COLLECTIVES)
 
 
-def ring_send_bytes(hlo_text, n_devices):
+def ring_send_bytes(hlo_text, n_devices, by_dtype=False):
     """Per-device bytes each device *sends* under ring algorithms.
 
     Converts ``collective_bytes``'s output-bytes basis into the send-volume
@@ -132,12 +144,20 @@ def ring_send_bytes(hlo_text, n_devices):
     Approximation: every collective is assumed to span ``n_devices`` (true
     for the single-axis ZeRO tests this backs; subgroup collectives would
     need per-op replica-group parsing).
+
+    ``by_dtype=True`` keys each op's sends by element dtype, mirroring
+    ``collective_bytes(by_dtype=True)``.
     """
-    out = collective_bytes(hlo_text)
+    out = collective_bytes(hlo_text, by_dtype=True)
     sends = {}
-    for op, b in out.items():
+    for op, d in out.items():
         if op == "total":
             continue
-        sends[op] = int(b * _RING_SEND_FACTORS[op](n_devices))
-    sends["total"] = sum(sends.values())
-    return sends
+        factor = _RING_SEND_FACTORS[op](n_devices)
+        sends[op] = {dt: int(b * factor) for dt, b in d.items()}
+    if by_dtype:
+        sends["total"] = sum(b for d in sends.values() for b in d.values())
+        return sends
+    flat = {op: sum(d.values()) for op, d in sends.items()}
+    flat["total"] = sum(flat.values())
+    return flat

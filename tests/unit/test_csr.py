@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deepspeed_tpu.utils.compat import shard_map
+
 from deepspeed_tpu.runtime.csr_tensor import (
     CSRTensor, csr_allreduce, dense_to_csr, embedding_grad_csr)
 
@@ -79,7 +81,7 @@ def test_csr_allreduce_matches_dense_mean():
         out = csr_allreduce(csr, "data", average=True)
         return out.to_dense()[None]
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P("data", None), P("data", None, None)),
         out_specs=P("data", None, None),

@@ -11,6 +11,8 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deepspeed_tpu.utils.compat import shard_map
+
 from deepspeed_tpu.runtime.comm.compressed import (
     compressed_allreduce, error_feedback_sizes, pack_signs, unpack_signs)
 from deepspeed_tpu.runtime.fp16.onebit_adam import (
@@ -47,7 +49,7 @@ def _run_compressed(x, we, se, world, n_valid):
         # stack per-rank copies of the (replicated) avg for identity checks
         return avg[None], we_new[None], se_new
 
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P("data", None), P("data", None), P("data")),
         out_specs=(P("data", None), P("data", None), P("data")),
@@ -145,7 +147,7 @@ def test_onebit_warmup_matches_dense_adam():
     state_specs = OnebitAdamState(
         m={"w": rep}, v={"w": rep}, step=rep,
         worker_error=P("data", None), server_error=P("data"))
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         shard_fn, mesh=mesh,
         in_specs=({"w": rep}, state_specs, P("data", None)),
         out_specs=({"w": rep}, state_specs),
@@ -187,7 +189,7 @@ def test_onebit_compression_stage_converges():
     state_specs = OnebitAdamState(
         m={"w": rep}, v={"w": rep}, step=rep,
         worker_error=P("data", None), server_error=P("data"))
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         shard_fn, mesh=mesh,
         in_specs=({"w": rep}, state_specs, P("data", None)),
         out_specs=({"w": rep}, state_specs),
@@ -298,9 +300,9 @@ def test_compressed_allreduce_moves_4x_fewer_bytes_than_dense():
         return jax.lax.pmean(x, "data")
 
     specs = (P("data", None), P("data", None), P("data"))
-    onebit = jax.shard_map(onebit_fn, mesh=mesh, in_specs=specs,
+    onebit = shard_map(onebit_fn, mesh=mesh, in_specs=specs,
                            out_specs=specs, check_vma=False)
-    dense = jax.shard_map(dense_fn, mesh=mesh, in_specs=P("data", None),
+    dense = shard_map(dense_fn, mesh=mesh, in_specs=P("data", None),
                           out_specs=P("data", None), check_vma=False)
 
     x = jnp.zeros((world, n), jnp.float32)

@@ -1,0 +1,95 @@
+"""End-to-end loss parity: the int8 quantized gradient sync must
+reproduce the fp32 engine's training trajectory.
+
+Two 24-step comparisons on the 8-device CPU mesh, identical seeds and
+data: dense DP (quantized vs fp32 all-reduce) and ZeRO-2 with gradient
+accumulation 2 plus error feedback (the full composition: quantized sync
+inside shard_map, sharded Adam states and GSPMD param refresh outside).
+
+Per-chunk int8 against an absmax scale keeps the relative gradient error
+around 4e-3; after the lr-scaled update the loss trajectories coincide to
+~1e-4 (measured), so the 5e-3 pin below has ~25x slack while still
+catching any real regression (a broken scale, a dropped bucket, residual
+state leaking across configs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+
+HIDDEN = 128
+NLAYERS = 4
+STEPS = 24
+
+
+def _init_params(rng):
+    keys = jax.random.split(rng, NLAYERS)
+    return {
+        f"linear_{i}": {
+            "kernel": jax.random.normal(
+                k, (HIDDEN, HIDDEN), jnp.float32) * 0.05,
+            "bias": jnp.zeros((HIDDEN,), jnp.float32),
+        }
+        for i, k in enumerate(keys)
+    }
+
+
+def _loss_fn(params, batch, rng=None):
+    x = batch["x"]
+    for i in range(NLAYERS):
+        layer = params[f"linear_{i}"]
+        x = x @ layer["kernel"] + layer["bias"]
+        if i < NLAYERS - 1:
+            x = jax.nn.relu(x)
+    return jnp.mean(jnp.square(x - batch["y"]))
+
+
+def _batches(accum, steps):
+    rng = np.random.default_rng(0)
+    bs = 16 * accum
+    w = rng.normal(size=(HIDDEN, HIDDEN)).astype(np.float32) * 0.1
+    for _ in range(steps):
+        x = rng.normal(size=(bs, HIDDEN)).astype(np.float32)
+        yield {"x": x, "y": x @ w}
+
+
+def _run(quantized, stage=0, accum=1, ef=False):
+    cfg = {"train_batch_size": 16 * accum,
+           "train_micro_batch_size_per_gpu": 2,
+           "gradient_accumulation_steps": accum,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "mesh_shape": {"data": 8}}
+    if stage:
+        cfg["zero_optimization"] = {"stage": stage}
+        cfg["bf16"] = {"enabled": True}
+    if quantized:
+        cfg["comm_quantization"] = {"enabled": True, "chunk_size": 64,
+                                    "bucket_mb": 1, "error_feedback": ef}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        params=_init_params(jax.random.PRNGKey(0)), loss_fn=_loss_fn,
+        config=cfg)
+    losses = [float(engine.train_batch(b))
+              for b in _batches(accum, STEPS)]
+    return np.array(losses), engine
+
+
+def test_dense_dp_parity():
+    base, _ = _run(quantized=False)
+    quant, engine = _run(quantized=True)
+    assert np.isfinite(quant).all()
+    np.testing.assert_allclose(quant, base, rtol=5e-3, atol=5e-3)
+    # EF off: no residual state is ever materialised.
+    assert engine._qcomm_residuals is None
+
+
+def test_zero2_accum_error_feedback_parity():
+    base, _ = _run(quantized=False, stage=2, accum=2)
+    quant, engine = _run(quantized=True, stage=2, accum=2, ef=True)
+    assert np.isfinite(quant).all()
+    np.testing.assert_allclose(quant, base, rtol=5e-3, atol=5e-3)
+    # EF on: per-bucket worker/server residual stacks are live state.
+    res = engine._qcomm_residuals
+    assert res is not None and res["worker"] and res["server"]
+    assert all(w.shape[0] == 8 for w in res["worker"])
