@@ -464,6 +464,71 @@ def run_once(jax, cfg_fn, batch_size, seq_len, steps, remat, on_tpu):
     return tokens_per_sec, tflops
 
 
+def run_once_resilience(jax, ckpt_dir):
+    """Resilience subsystem cost: per-step overhead of the health guards
+    (in-jit NaN/Inf grad detector forced on for bf16 + the host-side
+    loss-spike monitor) against an unguarded engine, and the wall time of
+    one preemption-safe checkpoint save + restore at GPT-2 125M."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2LMHead, gpt2_125m, init_gpt2_params, make_gpt2_loss_fn)
+
+    batch_size = int(os.environ.get("BENCH_BS", "4"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "512"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    cfg = gpt2_125m(n_positions=seq_len, use_flash_attention=True)
+    model = GPT2LMHead(cfg)
+    hb(f"resilience: gpt2 125M init (bs{batch_size}, seq{seq_len})")
+    params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
+    # Host copy so both engines start from identical, non-donatable state.
+    params = jax.tree_util.tree_map(np.asarray, params)
+    loss_fn = make_gpt2_loss_fn(model)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(batch_size, seq_len)).astype(np.int32)}
+
+    def build(resilience):
+        config = {
+            "train_batch_size": batch_size,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9,
+        }
+        if resilience:
+            config["resilience"] = resilience
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=config, loss_fn=loss_fn, params=params)
+        return engine
+
+    hb("resilience: baseline engine (guards off)")
+    base = build(None)
+    base_dt = time_engine_steps(base, batch, steps)
+
+    hb("resilience: guarded engine")
+    guarded = build({
+        "guards": {"nan_grads": {"action": "skip_step"},
+                   "loss_spike": {"action": "warn"}},
+        # sync saves: the row measures full durable-save wall time, not
+        # how fast the submit returns
+        "checkpoint": {"async_save": False}})
+    guard_dt = time_engine_steps(guarded, batch, steps)
+
+    hb("resilience: checkpoint save + restore")
+    t0 = time.perf_counter()
+    guarded.save_checkpoint(ckpt_dir)
+    save_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    path, _ = guarded.load_checkpoint(ckpt_dir)
+    restore_s = time.perf_counter() - t0
+    assert path is not None
+
+    base_ms = base_dt / steps * 1e3
+    guard_ms = guard_dt / steps * 1e3
+    overhead_pct = (guard_ms - base_ms) / base_ms * 100.0
+    return overhead_pct, base_ms, guard_ms, save_s, restore_s
+
+
 def main():
     try:
         jax, devices = init_backend_with_retry()
@@ -635,6 +700,43 @@ def main():
                   "unit": "tokens/sec/chip", "vs_baseline": 0.0,
                   "error": f"{type(e).__name__}: {e}",
                   "traceback": traceback.format_exc(limit=5)})
+        return
+    if bench_model == "resilience":
+        # Resilience PR row: what the safety net costs — health-guard
+        # overhead per train step plus preemption-safe checkpoint
+        # save/restore wall time at GPT-2 125M.
+        if not on_tpu:
+            emit({"metric": "resilience guard overhead per step",
+                  "value": 0, "unit": "%", "vs_baseline": 0.0,
+                  "error": f"requires a TPU; backend is {platform!r}"})
+            return
+        import shutil
+        import tempfile
+        ckpt_dir = tempfile.mkdtemp(prefix="bench_resilience_")
+        try:
+            overhead_pct, base_ms, guard_ms, save_s, restore_s = \
+                run_once_resilience(jax, ckpt_dir)
+            out = {"metric": "resilience guard overhead per step "
+                             "(GPT-2 125M, bf16, NaN guard + loss-spike "
+                             "monitor)",
+                   "value": round(overhead_pct, 2), "unit": "%",
+                   # no reference counterpart for this row; the guard
+                   # overhead itself is the headline number
+                   "vs_baseline": 0.0,
+                   "step_ms_base": round(base_ms, 2),
+                   "step_ms_guarded": round(guard_ms, 2),
+                   "ckpt_save_wall_s": round(save_s, 3),
+                   "ckpt_restore_wall_s": round(restore_s, 3),
+                   "live": True}
+            save_tpu_result(out)
+            emit(out)
+        except Exception as e:
+            emit({"metric": "resilience guard overhead per step",
+                  "value": 0, "unit": "%", "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {e}",
+                  "traceback": traceback.format_exc(limit=5)})
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
         return
     if bench_model == "bert_large" and not on_tpu:
         emit({"metric": "BERT-Large MLM samples/sec/chip", "value": 0,

@@ -69,6 +69,14 @@ class DeepSpeedCPUAdam:
         self._chunks = None
         self._chunk_bytes = None
         self._bf16_buf = None
+        # Resilience: bounded resubmission of failed range updates (the
+        # engine sets these from its `resilience` config block). Retries
+        # apply only to failures raised BEFORE the C++ kernel touches the
+        # buffers (`host_state_clean` errors) — a mid-kernel failure may
+        # have half-applied the moment update, so it surfaces as a typed
+        # HostAdamError instead of being silently re-run.
+        self.host_adam_retries = 0
+        self.host_adam_timeout_s = None
 
     def __del__(self):
         try:
@@ -139,6 +147,53 @@ class DeepSpeedCPUAdam:
                     ctypes.POINTER(ctypes.c_uint16)),
                 ctypes.c_int64(n))
 
+    def _guarded_update_range(self, step, lr, beta1, off, n, to_bf16):
+        """Worker entry for submitted range updates: the fault-injection
+        probe fires before the kernel, so an injected failure is always
+        pre-mutation (exactly resubmittable)."""
+        from deepspeed_tpu.runtime.resilience import fault_injection
+        fault_injection.maybe_fail_host_adam()
+        return self._update_range(step, lr, beta1, off, n, to_bf16)
+
+    def submit_update_range(self, step, lr, beta1, off, n, to_bf16):
+        """Submit one guarded range update to the worker; pair each future
+        with :meth:`drain_update` (same args) to collect it."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(max_workers=1)
+        return self._pool.submit(self._guarded_update_range, step, lr,
+                                 beta1, off, n, to_bf16)
+
+    def drain_update(self, fut, step, lr, beta1, off, n, to_bf16):
+        """Wait for a submitted range update, resubmitting pre-mutation
+        failures up to ``host_adam_retries`` times with backoff.
+
+        Resubmitted ranges queue behind already-submitted chunks on the
+        1-thread worker — safe, since ranges are disjoint. Exhausted
+        retries and mid-kernel failures raise a typed ``HostAdamError``.
+        """
+        from deepspeed_tpu.runtime.resilience.retry import (
+            HostAdamError, future_result_with_retry)
+        what = f"host-Adam range [{off}, {off + n})"
+        try:
+            return fut.result(timeout=self.host_adam_timeout_s)
+        except Exception as e:
+            if not getattr(e, "host_state_clean", False):
+                raise HostAdamError(
+                    f"{what} failed mid-update ({type(e).__name__}: {e}); "
+                    "host master/moment buffers may be partially updated — "
+                    "restore from the last checkpoint") from e
+            if self.host_adam_retries <= 0:
+                raise HostAdamError(
+                    f"{what} failed before touching host state "
+                    f"({type(e).__name__}: {e}) and retries are disabled "
+                    "(host_adam_retries=0)") from e
+            return future_result_with_retry(
+                lambda: self.submit_update_range(step, lr, beta1, off, n,
+                                                 to_bf16),
+                what=what, attempts=self.host_adam_retries,
+                timeout_s=self.host_adam_timeout_s)
+
     def step_overlapped(self, grads, lr=None, beta1=None, bf16_out=False,
                         chunk_bytes=1 << 26, on_chunk=None):
         """One Adam step with the host phase software-pipelined.
@@ -190,10 +245,11 @@ class DeepSpeedCPUAdam:
                 o, s = self.offsets[k], self.sizes[k]
                 self._grad_buf[o:o + s] = np.asarray(
                     g_leaves[k], np.float32).reshape(-1)
-            futs.append(self._pool.submit(
-                self._update_range, step, eff_lr, eff_b1, off, n, bf16_out))
+            futs.append(self.submit_update_range(
+                step, eff_lr, eff_b1, off, n, bf16_out))
         for (li, lj, off, n), f in zip(self._chunks, futs):
-            f.result()             # propagate worker failures (in order)
+            # propagate worker failures (in order), retrying clean ones
+            self.drain_update(f, step, eff_lr, eff_b1, off, n, bf16_out)
             if on_chunk is not None:
                 on_chunk(li, lj)
         if bf16_out:

@@ -272,6 +272,103 @@ class CommQuantizationConfig:
                 f"error_feedback={self.error_feedback})")
 
 
+class ResilienceConfig:
+    """Typed view of the ``resilience`` block: preemption-safe
+    checkpointing + auto-resume + step health guards + fault injection
+    (`runtime/resilience/`). See docs/resilience.md."""
+
+    def __init__(self, param_dict):
+        sub = param_dict.get(RESILIENCE, {}) or {}
+        self.auto_resume = get_scalar_param(sub, RESILIENCE_AUTO_RESUME,
+                                            RESILIENCE_AUTO_RESUME_DEFAULT)
+        self.save_dir = get_scalar_param(sub, RESILIENCE_SAVE_DIR,
+                                         RESILIENCE_SAVE_DIR_DEFAULT)
+        self.save_interval_steps = get_scalar_param(
+            sub, RESILIENCE_SAVE_INTERVAL_STEPS,
+            RESILIENCE_SAVE_INTERVAL_STEPS_DEFAULT)
+
+        ckpt = sub.get(RESILIENCE_CHECKPOINT, {}) or {}
+        self.async_save = get_scalar_param(
+            ckpt, RESILIENCE_CKPT_ASYNC_SAVE,
+            RESILIENCE_CKPT_ASYNC_SAVE_DEFAULT)
+        self.keep_last_n = get_scalar_param(
+            ckpt, RESILIENCE_CKPT_KEEP_LAST_N,
+            RESILIENCE_CKPT_KEEP_LAST_N_DEFAULT)
+        self.io_retries = get_scalar_param(
+            ckpt, RESILIENCE_CKPT_IO_RETRIES,
+            RESILIENCE_CKPT_IO_RETRIES_DEFAULT)
+        self.io_retry_base_s = get_scalar_param(
+            ckpt, RESILIENCE_CKPT_IO_RETRY_BASE_S,
+            RESILIENCE_CKPT_IO_RETRY_BASE_S_DEFAULT)
+        self.io_timeout_s = get_scalar_param(
+            ckpt, RESILIENCE_CKPT_IO_TIMEOUT_S,
+            RESILIENCE_CKPT_IO_TIMEOUT_S_DEFAULT)
+
+        guards = sub.get(RESILIENCE_GUARDS, {}) or {}
+        nan = guards.get(RESILIENCE_GUARD_NAN, {}) or {}
+        self.nan_guard_action = get_scalar_param(
+            nan, RESILIENCE_GUARD_ACTION,
+            RESILIENCE_GUARD_NAN_ACTION_DEFAULT)
+        spike = guards.get(RESILIENCE_GUARD_LOSS_SPIKE, {}) or {}
+        self.loss_spike_action = get_scalar_param(
+            spike, RESILIENCE_GUARD_ACTION,
+            RESILIENCE_GUARD_LOSS_SPIKE_ACTION_DEFAULT)
+        self.loss_spike_window = get_scalar_param(
+            spike, RESILIENCE_GUARD_LOSS_SPIKE_WINDOW,
+            RESILIENCE_GUARD_LOSS_SPIKE_WINDOW_DEFAULT)
+        self.loss_spike_factor = get_scalar_param(
+            spike, RESILIENCE_GUARD_LOSS_SPIKE_FACTOR,
+            RESILIENCE_GUARD_LOSS_SPIKE_FACTOR_DEFAULT)
+        self.loss_spike_min_history = get_scalar_param(
+            spike, RESILIENCE_GUARD_LOSS_SPIKE_MIN_HISTORY,
+            RESILIENCE_GUARD_LOSS_SPIKE_MIN_HISTORY_DEFAULT)
+        collapse = guards.get(RESILIENCE_GUARD_SCALE_COLLAPSE, {}) or {}
+        self.scale_collapse_action = get_scalar_param(
+            collapse, RESILIENCE_GUARD_ACTION,
+            RESILIENCE_GUARD_SCALE_COLLAPSE_ACTION_DEFAULT)
+        self.scale_collapse_patience = get_scalar_param(
+            collapse, RESILIENCE_GUARD_SCALE_COLLAPSE_PATIENCE,
+            RESILIENCE_GUARD_SCALE_COLLAPSE_PATIENCE_DEFAULT)
+
+        preempt = sub.get(RESILIENCE_PREEMPTION, {}) or {}
+        self.save_on_sigterm = get_scalar_param(
+            preempt, RESILIENCE_PREEMPTION_SAVE_ON_SIGTERM,
+            RESILIENCE_PREEMPTION_SAVE_ON_SIGTERM_DEFAULT)
+
+        fi = sub.get(RESILIENCE_FAULT_INJECTION, {}) or {}
+        self.fault_injection = get_scalar_param(
+            fi, RESILIENCE_FAULT_INJECTION_ENABLED,
+            RESILIENCE_FAULT_INJECTION_ENABLED_DEFAULT)
+
+        self.host_adam_retries = get_scalar_param(
+            sub, RESILIENCE_HOST_ADAM_RETRIES,
+            RESILIENCE_HOST_ADAM_RETRIES_DEFAULT)
+
+    @property
+    def guards_enabled(self):
+        return any(a is not None for a in (self.nan_guard_action,
+                                           self.loss_spike_action,
+                                           self.scale_collapse_action))
+
+    @property
+    def enabled(self):
+        return bool(self.auto_resume or self.save_interval_steps or
+                    self.guards_enabled or self.save_on_sigterm or
+                    self.fault_injection or self.save_dir)
+
+    def __repr__(self):
+        return (f"ResilienceConfig(auto_resume={self.auto_resume}, "
+                f"save_dir={self.save_dir!r}, "
+                f"save_interval_steps={self.save_interval_steps}, "
+                f"async_save={self.async_save}, "
+                f"keep_last_n={self.keep_last_n}, "
+                f"guards=[nan={self.nan_guard_action}, "
+                f"loss_spike={self.loss_spike_action}, "
+                f"scale_collapse={self.scale_collapse_action}], "
+                f"save_on_sigterm={self.save_on_sigterm}, "
+                f"fault_injection={self.fault_injection})")
+
+
 class DeepSpeedConfig:
     def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
         if param_dict is None:
@@ -399,6 +496,7 @@ class DeepSpeedConfig:
         self.pipeline = get_pipeline_config(param_dict)
         self.mesh_shape = get_mesh_config(param_dict)
         self.comm_quantization = CommQuantizationConfig(param_dict)
+        self.resilience = ResilienceConfig(param_dict)
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size
@@ -489,6 +587,69 @@ class DeepSpeedConfig:
             assert self.zero_config.cpu_offload is not True, (
                 "comm_quantization requires the in-jit update path; "
                 "ZeRO-Offload steps the optimizer on host")
+        self._check_resilience()
+
+    def _check_resilience(self):
+        from deepspeed_tpu.runtime.resilience.guards import (
+            ACTION_ROLLBACK, ACTION_SKIP_STEP, VALID_ACTIONS)
+        rz = self.resilience
+        if rz.auto_resume and not rz.save_dir:
+            raise ValueError(
+                "resilience: auto_resume requires save_dir — there is "
+                "nowhere to discover checkpoints from")
+        if rz.save_interval_steps and not rz.save_dir:
+            raise ValueError(
+                "resilience: save_interval_steps requires save_dir")
+        if rz.save_interval_steps < 0:
+            raise ValueError(
+                f"resilience: save_interval_steps must be >= 0, "
+                f"got {rz.save_interval_steps}")
+        if rz.keep_last_n < 0:
+            raise ValueError(
+                f"resilience: checkpoint.keep_last_n must be >= 0 "
+                f"(0 keeps everything), got {rz.keep_last_n}")
+        if rz.io_retries < 1:
+            raise ValueError(
+                f"resilience: checkpoint.io_retries must be >= 1, "
+                f"got {rz.io_retries}")
+        guard_actions = {
+            "nan_grads": rz.nan_guard_action,
+            "loss_spike": rz.loss_spike_action,
+            "scale_collapse": rz.scale_collapse_action,
+        }
+        for guard, action in guard_actions.items():
+            if action is None:
+                continue
+            if action not in VALID_ACTIONS:
+                raise ValueError(
+                    f"resilience: guards.{guard}.action must be one of "
+                    f"{list(VALID_ACTIONS)} (or omitted to disable), "
+                    f"got {action!r}")
+            if action == ACTION_ROLLBACK and not rz.save_dir:
+                raise ValueError(
+                    f"resilience: guards.{guard}.action="
+                    f"'rollback_to_checkpoint' requires save_dir — there "
+                    "is no checkpoint to roll back to")
+            if action == ACTION_SKIP_STEP and guard != "nan_grads":
+                raise ValueError(
+                    f"resilience: guards.{guard} detects the problem only "
+                    "after the update has been applied, so 'skip_step' is "
+                    "impossible — use 'warn', 'rollback_to_checkpoint' or "
+                    "'abort'")
+        if rz.scale_collapse_action is not None and not self.fp16_enabled:
+            raise ValueError(
+                "resilience: guards.scale_collapse watches the dynamic "
+                "fp16 loss scale; it requires fp16 to be enabled")
+        if rz.loss_spike_action is not None and \
+                rz.loss_spike_min_history < 1:
+            raise ValueError(
+                f"resilience: guards.loss_spike.min_history must be >= 1, "
+                f"got {rz.loss_spike_min_history}")
+        if rz.scale_collapse_action is not None and \
+                rz.scale_collapse_patience < 1:
+            raise ValueError(
+                f"resilience: guards.scale_collapse.patience must be >= 1, "
+                f"got {rz.scale_collapse_patience}")
 
     def _do_warning_check(self):
         fp16_enabled = self.fp16_enabled

@@ -211,3 +211,53 @@ COMM_QUANTIZATION_BUCKET_MB = "bucket_mb"
 COMM_QUANTIZATION_BUCKET_MB_DEFAULT = 4
 COMM_QUANTIZATION_ERROR_FEEDBACK = "error_feedback"
 COMM_QUANTIZATION_ERROR_FEEDBACK_DEFAULT = False
+
+# Resilience subsystem (runtime/resilience/): preemption-safe checkpointing,
+# auto-resume, step health guards, fault injection. See docs/resilience.md.
+RESILIENCE = "resilience"
+RESILIENCE_AUTO_RESUME = "auto_resume"
+RESILIENCE_AUTO_RESUME_DEFAULT = False
+RESILIENCE_SAVE_DIR = "save_dir"
+RESILIENCE_SAVE_DIR_DEFAULT = None
+RESILIENCE_SAVE_INTERVAL_STEPS = "save_interval_steps"
+RESILIENCE_SAVE_INTERVAL_STEPS_DEFAULT = 0  # 0 = no periodic saves
+
+RESILIENCE_CHECKPOINT = "checkpoint"
+RESILIENCE_CKPT_ASYNC_SAVE = "async_save"
+RESILIENCE_CKPT_ASYNC_SAVE_DEFAULT = False
+RESILIENCE_CKPT_KEEP_LAST_N = "keep_last_n"
+RESILIENCE_CKPT_KEEP_LAST_N_DEFAULT = 0  # 0 = keep everything
+RESILIENCE_CKPT_IO_RETRIES = "io_retries"
+RESILIENCE_CKPT_IO_RETRIES_DEFAULT = 3
+RESILIENCE_CKPT_IO_RETRY_BASE_S = "io_retry_base_s"
+RESILIENCE_CKPT_IO_RETRY_BASE_S_DEFAULT = 0.05
+RESILIENCE_CKPT_IO_TIMEOUT_S = "io_timeout_s"
+RESILIENCE_CKPT_IO_TIMEOUT_S_DEFAULT = None  # None = no deadline
+
+RESILIENCE_GUARDS = "guards"
+RESILIENCE_GUARD_ACTION = "action"
+RESILIENCE_GUARD_NAN = "nan_grads"
+RESILIENCE_GUARD_NAN_ACTION_DEFAULT = None  # disabled
+RESILIENCE_GUARD_LOSS_SPIKE = "loss_spike"
+RESILIENCE_GUARD_LOSS_SPIKE_ACTION_DEFAULT = None  # disabled
+RESILIENCE_GUARD_LOSS_SPIKE_WINDOW = "window"
+RESILIENCE_GUARD_LOSS_SPIKE_WINDOW_DEFAULT = 20
+RESILIENCE_GUARD_LOSS_SPIKE_FACTOR = "factor"
+RESILIENCE_GUARD_LOSS_SPIKE_FACTOR_DEFAULT = 10.0
+RESILIENCE_GUARD_LOSS_SPIKE_MIN_HISTORY = "min_history"
+RESILIENCE_GUARD_LOSS_SPIKE_MIN_HISTORY_DEFAULT = 5
+RESILIENCE_GUARD_SCALE_COLLAPSE = "scale_collapse"
+RESILIENCE_GUARD_SCALE_COLLAPSE_ACTION_DEFAULT = None  # disabled
+RESILIENCE_GUARD_SCALE_COLLAPSE_PATIENCE = "patience"
+RESILIENCE_GUARD_SCALE_COLLAPSE_PATIENCE_DEFAULT = 10
+
+RESILIENCE_PREEMPTION = "preemption"
+RESILIENCE_PREEMPTION_SAVE_ON_SIGTERM = "save_on_sigterm"
+RESILIENCE_PREEMPTION_SAVE_ON_SIGTERM_DEFAULT = False
+
+RESILIENCE_FAULT_INJECTION = "fault_injection"
+RESILIENCE_FAULT_INJECTION_ENABLED = "enabled"
+RESILIENCE_FAULT_INJECTION_ENABLED_DEFAULT = False
+
+RESILIENCE_HOST_ADAM_RETRIES = "host_adam_retries"
+RESILIENCE_HOST_ADAM_RETRIES_DEFAULT = 2

@@ -73,6 +73,14 @@ def update_loss_scale(state: LossScaleState,
     )
 
 
+def scale_is_collapsed(state: LossScaleState, min_scale=1.0) -> bool:
+    """True when the dynamic scale is pinned at its floor — the signal the
+    resilience scale-collapse guard counts toward its patience window. A
+    scale that reached ``min_scale`` and keeps overflowing means every
+    step is being skipped; without intervention the run is dead."""
+    return float(jnp.asarray(state.cur_scale)) <= float(min_scale)
+
+
 class LossScalerBase:
     def __init__(self, cur_scale):
         self.cur_scale = cur_scale

@@ -1,0 +1,65 @@
+"""Resilience subsystem: preemption-safe checkpointing, auto-resume,
+step health guards, and deterministic fault injection.
+
+The seed reproduced DeepSpeed v0.3.2's *training* capabilities; this
+package adds its *operational* ones — the parts a preemptible TPU pod
+slice needs to survive long runs:
+
+- :mod:`checkpoint` — atomic (tmp-dir + rename) checkpoint writes with a
+  per-array checksum manifest, retention GC, load-time validation and
+  newest-valid fallback, retry-with-backoff around all I/O, optional
+  async saves.
+- :mod:`guards` — step health guards (NaN/Inf gradients, loss-spike
+  circuit breaker, loss-scale collapse) with configurable actions
+  (``warn | skip_step | rollback_to_checkpoint | abort``).
+- :mod:`preemption` — SIGTERM-driven save-and-exit between steps.
+- :mod:`fault_injection` — deterministic fault hooks (NaN grads,
+  mid-write I/O failures, simulated preemption, host-Adam worker
+  exceptions) for testing failure behavior.
+- :mod:`retry` — bounded retry-with-backoff used by checkpoint I/O and
+  the offload host-Adam futures.
+"""
+
+from deepspeed_tpu.runtime.resilience.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointIOError,
+    CheckpointManager,
+)
+from deepspeed_tpu.runtime.resilience.guards import (
+    ACTION_ABORT,
+    ACTION_ROLLBACK,
+    ACTION_SKIP_STEP,
+    ACTION_WARN,
+    GuardTrip,
+    HealthGuardAbort,
+    StepHealthMonitor,
+)
+from deepspeed_tpu.runtime.resilience.preemption import (
+    PreemptedError,
+    PreemptionHandler,
+)
+from deepspeed_tpu.runtime.resilience.retry import (
+    HostAdamError,
+    RetryExhaustedError,
+    retry_with_backoff,
+    future_result_with_retry,
+)
+
+__all__ = [
+    "CheckpointCorruptError",
+    "CheckpointIOError",
+    "CheckpointManager",
+    "ACTION_ABORT",
+    "ACTION_ROLLBACK",
+    "ACTION_SKIP_STEP",
+    "ACTION_WARN",
+    "GuardTrip",
+    "HealthGuardAbort",
+    "StepHealthMonitor",
+    "PreemptedError",
+    "PreemptionHandler",
+    "HostAdamError",
+    "RetryExhaustedError",
+    "retry_with_backoff",
+    "future_result_with_retry",
+]

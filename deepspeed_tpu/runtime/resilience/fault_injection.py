@@ -1,0 +1,145 @@
+"""Deterministic fault injection for resilience testing.
+
+A process-global registry of *armed* faults that production code probes
+at well-defined seams. Every probe is a no-op unless a test armed the
+matching fault, and the engine only wires the gradient-fault hook into
+its compiled step when ``resilience.fault_injection.enabled`` is set in
+config — injection cannot perturb ordinary runs.
+
+Seams (all deterministic — armed for explicit steps or a fixed count):
+
+- ``nan_grads`` — :func:`grad_fault_value` returns NaN for armed steps;
+  the engine multiplies it into the gradients inside the compiled step.
+- ``io_failure`` — :func:`maybe_fail_io` raises ``InjectedIOError``
+  from inside checkpoint I/O, *after* partial data has been written and
+  *before* the atomic rename (the worst-case interrupt point).
+- ``preemption`` — :func:`preemption_due` tells the engine to deliver
+  SIGTERM to itself between steps, exercising the real signal path.
+- ``host_adam`` — :func:`maybe_fail_host_adam` raises
+  ``InjectedHostAdamError`` at future-submission time, before the C++
+  kernel touches the master buffers, so a retry is exact.
+
+Use :func:`clear_faults` (or the ``fault_registry`` pytest fixture in
+``tests/``) to disarm everything between tests.
+"""
+
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_faults = {}
+
+
+class InjectedIOError(OSError):
+    """Checkpoint I/O failure injected by the fault harness."""
+
+
+class InjectedHostAdamError(RuntimeError):
+    """Host-Adam worker failure injected by the fault harness.
+
+    Raised by the probe BEFORE the C++ kernel runs, so the master/moment
+    buffers are untouched and a resubmission is exact — which is what
+    ``host_state_clean`` asserts to the retry wrapper.
+    """
+
+    host_state_clean = True
+
+
+def clear_faults():
+    """Disarm all faults."""
+    with _lock:
+        _faults.clear()
+
+
+def active_faults():
+    """Names of currently armed faults (for assertions in tests)."""
+    with _lock:
+        return sorted(_faults)
+
+
+def _pop_if_exhausted(name, entry):
+    if entry.get("times") is not None and entry["times"] <= 0:
+        _faults.pop(name, None)
+
+
+# --------------------------------------------------------------------------
+# NaN gradients
+# --------------------------------------------------------------------------
+
+def inject_nan_grads(at_steps):
+    """Arm NaN gradients for the given engine global steps (0-based)."""
+    with _lock:
+        _faults["nan_grads"] = {"at_steps": set(int(s) for s in at_steps)}
+
+
+def grad_fault_value(step):
+    """Multiplier folded into grads at ``step``: NaN if armed, else 1.0."""
+    with _lock:
+        entry = _faults.get("nan_grads")
+        if entry is not None and int(step) in entry["at_steps"]:
+            return np.float32(np.nan)
+    return np.float32(1.0)
+
+
+# --------------------------------------------------------------------------
+# Checkpoint I/O failures
+# --------------------------------------------------------------------------
+
+def inject_io_failure(op="save", times=1):
+    """Arm ``times`` consecutive failures of checkpoint ``op`` ("save"/"load")."""
+    with _lock:
+        _faults[f"io_failure:{op}"] = {"times": int(times)}
+
+
+def maybe_fail_io(op):
+    """Probe called from inside checkpoint I/O; raises if armed."""
+    with _lock:
+        name = f"io_failure:{op}"
+        entry = _faults.get(name)
+        if entry is None:
+            return
+        entry["times"] -= 1
+        _pop_if_exhausted(name, entry)
+    raise InjectedIOError(f"injected checkpoint {op} failure")
+
+
+# --------------------------------------------------------------------------
+# Preemption
+# --------------------------------------------------------------------------
+
+def simulate_preemption(at_step):
+    """Arm a simulated preemption (SIGTERM) before engine step ``at_step``."""
+    with _lock:
+        _faults["preemption"] = {"at_step": int(at_step)}
+
+
+def preemption_due(step):
+    """True exactly once, when ``step`` reaches the armed preemption point."""
+    with _lock:
+        entry = _faults.get("preemption")
+        if entry is not None and int(step) >= entry["at_step"]:
+            _faults.pop("preemption", None)
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# Host-Adam worker failures
+# --------------------------------------------------------------------------
+
+def inject_host_adam_failure(times=1):
+    """Arm ``times`` consecutive host-Adam submission failures."""
+    with _lock:
+        _faults["host_adam"] = {"times": int(times)}
+
+
+def maybe_fail_host_adam():
+    """Probe called at host-Adam submission time; raises if armed."""
+    with _lock:
+        entry = _faults.get("host_adam")
+        if entry is None:
+            return
+        entry["times"] -= 1
+        _pop_if_exhausted("host_adam", entry)
+    raise InjectedHostAdamError("injected host-Adam worker failure")

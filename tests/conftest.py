@@ -23,3 +23,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_platform_name", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fault_registry():
+    """Armed-fault registry handle that is guaranteed clean before AND
+    after the test — injected faults must never leak across tests."""
+    from deepspeed_tpu.runtime.resilience import fault_injection
+    fault_injection.clear_faults()
+    yield fault_injection
+    fault_injection.clear_faults()
