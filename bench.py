@@ -529,6 +529,72 @@ def run_once_resilience(jax, ckpt_dir):
     return overhead_pct, base_ms, guard_ms, save_s, restore_s
 
 
+def run_once_elastic(jax, work_dir):
+    """Elasticity subsystem cost at GPT-2 125M: wall time of an offline
+    N→N/2 checkpoint reshard (bin/ds_tpu_reshard's code path) and the
+    resume-to-first-step latency of an elastic restore — engine boot at
+    the smaller world, reshard-on-load from the world-N checkpoint, and
+    the first optimizer step (includes recompilation)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2LMHead, gpt2_125m, init_gpt2_params, make_gpt2_loss_fn)
+    from deepspeed_tpu.parallel.mesh import build_mesh
+    from deepspeed_tpu.runtime.elastic import reshard_checkpoint
+
+    batch_size = int(os.environ.get("BENCH_BS", "4"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "512"))
+    devices = jax.devices()
+    src_world = len(devices)
+    tgt_world = max(1, src_world // 2)
+
+    cfg = gpt2_125m(n_positions=seq_len, use_flash_attention=True)
+    model = GPT2LMHead(cfg)
+    hb(f"elastic: gpt2 125M init (world {src_world} -> {tgt_world})")
+    params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
+    params = jax.tree_util.tree_map(np.asarray, params)
+    loss_fn = make_gpt2_loss_fn(model)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(batch_size, seq_len)).astype(np.int32)}
+
+    def build(world):
+        config = {
+            "train_batch_size": batch_size,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9,
+            "resilience": {"checkpoint": {"async_save": False}},
+            "elasticity": {"enabled": True,
+                           "target_global_batch": batch_size},
+        }
+        mesh = build_mesh({"data": world}, devices=devices[:world])
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=config, loss_fn=loss_fn, params=params, mesh=mesh)
+        return engine
+
+    hb(f"elastic: world-{src_world} source run + checkpoint")
+    src = build(src_world)
+    time_engine_steps(src, batch, 3, warmup=0)
+    src_dir = os.path.join(work_dir, "src")
+    src.save_checkpoint(src_dir)
+
+    hb("elastic: offline reshard")
+    dst_dir = os.path.join(work_dir, "dst")
+    t0 = time.perf_counter()
+    summary = reshard_checkpoint(src_dir, dst_dir, tgt_world)
+    reshard_s = time.perf_counter() - t0
+
+    hb(f"elastic: world-{tgt_world} resume-to-first-step")
+    t0 = time.perf_counter()
+    resumed = build(tgt_world)
+    path, _ = resumed.load_checkpoint(src_dir)
+    assert path is not None
+    resumed.train_batch(batch)
+    resume_s = time.perf_counter() - t0
+    return reshard_s, resume_s, summary["state_bytes"], src_world, tgt_world
+
+
 def main():
     try:
         jax, devices = init_backend_with_retry()
@@ -737,6 +803,39 @@ def main():
                   "traceback": traceback.format_exc(limit=5)})
         finally:
             shutil.rmtree(ckpt_dir, ignore_errors=True)
+        return
+    if bench_model == "elastic":
+        # Elasticity PR row: offline N->N/2 reshard wall time plus the
+        # resume-to-first-step latency of an elastic (reshard-on-load)
+        # restore at GPT-2 125M.
+        if not on_tpu:
+            emit({"metric": "elastic reshard wall time", "value": 0,
+                  "unit": "s", "vs_baseline": 0.0,
+                  "error": f"requires a TPU; backend is {platform!r}"})
+            return
+        import shutil
+        import tempfile
+        work_dir = tempfile.mkdtemp(prefix="bench_elastic_")
+        try:
+            reshard_s, resume_s, state_bytes, src_w, tgt_w = \
+                run_once_elastic(jax, work_dir)
+            out = {"metric": f"elastic reshard wall time (GPT-2 125M, "
+                             f"bf16+zero1, world {src_w}->{tgt_w})",
+                   "value": round(reshard_s, 3), "unit": "s",
+                   # no reference counterpart; wall times are the headline
+                   "vs_baseline": 0.0,
+                   "resume_to_first_step_s": round(resume_s, 3),
+                   "state_mb": round(state_bytes / 2 ** 20, 1),
+                   "live": True}
+            save_tpu_result(out)
+            emit(out)
+        except Exception as e:
+            emit({"metric": "elastic reshard wall time", "value": 0,
+                  "unit": "s", "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {e}",
+                  "traceback": traceback.format_exc(limit=5)})
+        finally:
+            shutil.rmtree(work_dir, ignore_errors=True)
         return
     if bench_model == "bert_large" and not on_tpu:
         emit({"metric": "BERT-Large MLM samples/sec/chip", "value": 0,

@@ -152,3 +152,10 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
+
+
+def mesh_shape_dict(mesh: Mesh) -> Dict[str, int]:
+    """{axis: size} in canonical axis order — the schema checkpoint
+    manifests record (`runtime/elastic/topology.py`), so a saved and a
+    live topology compare key-by-key."""
+    return {axis: int(mesh.shape[axis]) for axis in mesh.axis_names}

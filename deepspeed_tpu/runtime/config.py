@@ -369,6 +369,33 @@ class ResilienceConfig:
                 f"fault_injection={self.fault_injection})")
 
 
+class ElasticityConfig:
+    """Typed view of the ``elasticity`` block: topology-agnostic
+    checkpoint resume + elastic batch solving (`runtime/elastic/`).
+    See docs/elasticity.md."""
+
+    def __init__(self, param_dict):
+        sub = param_dict.get(ELASTICITY, {}) or {}
+        self.enabled = get_scalar_param(sub, ELASTICITY_ENABLED,
+                                        ELASTICITY_ENABLED_DEFAULT)
+        self.target_global_batch = get_scalar_param(
+            sub, ELASTICITY_TARGET_GLOBAL_BATCH,
+            ELASTICITY_TARGET_GLOBAL_BATCH_DEFAULT)
+        self.max_world_size = get_scalar_param(
+            sub, ELASTICITY_MAX_WORLD_SIZE,
+            ELASTICITY_MAX_WORLD_SIZE_DEFAULT)
+        self.strict = get_scalar_param(sub, ELASTICITY_STRICT,
+                                       ELASTICITY_STRICT_DEFAULT)
+        self.lr_scaling = get_scalar_param(sub, ELASTICITY_LR_SCALING,
+                                           ELASTICITY_LR_SCALING_DEFAULT)
+
+    def __repr__(self):
+        return (f"ElasticityConfig(enabled={self.enabled}, "
+                f"target_global_batch={self.target_global_batch}, "
+                f"max_world_size={self.max_world_size}, "
+                f"strict={self.strict}, lr_scaling={self.lr_scaling!r})")
+
+
 class DeepSpeedConfig:
     def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
         if param_dict is None:
@@ -497,6 +524,11 @@ class DeepSpeedConfig:
         self.mesh_shape = get_mesh_config(param_dict)
         self.comm_quantization = CommQuantizationConfig(param_dict)
         self.resilience = ResilienceConfig(param_dict)
+        self.elasticity = ElasticityConfig(param_dict)
+        # Set by the elastic batch solver when the target batch cannot
+        # factor exactly at this world size; the engine multiplies it
+        # into the lr schedule.
+        self.elastic_lr_scale = 1.0
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size
@@ -543,8 +575,54 @@ class DeepSpeedConfig:
                 "needs to be provided")
 
     def _configure_train_batch_size(self):
+        if self.elasticity.enabled:
+            self._solve_elastic_batch()
         self._set_batch_related_parameters()
         self._batch_assertion()
+
+    def _solve_elastic_batch(self):
+        """Re-derive micro x grad_accum for the current world size.
+
+        With elasticity on, the *target global batch* (the elasticity
+        block's, or train_batch_size) is the invariant — a pinned
+        micro/accum pair from a different world size is a preference,
+        not a constraint, so a resumed run at a new world keeps the
+        effective batch (and LR schedule cadence) instead of failing the
+        triple assertion or silently training at a different batch.
+        """
+        from deepspeed_tpu.runtime.elastic.batch import solve_elastic_batch
+        el = self.elasticity
+        target = el.target_global_batch or self.train_batch_size
+        if target is None and self.train_micro_batch_size_per_gpu:
+            # No global target anywhere: the user thinks per-device;
+            # nothing for the solver to preserve.
+            return
+        if target is None:
+            raise ValueError(
+                "elasticity: set target_global_batch (or train_batch_size)"
+                " — the solver needs a global batch to preserve")
+        plan = solve_elastic_batch(
+            target, self.world_size,
+            prefer_micro=self.train_micro_batch_size_per_gpu,
+            prefer_accum=self.gradient_accumulation_steps,
+            lr_scaling=el.lr_scaling, strict=el.strict)
+        if not plan.exact:
+            logger.warning(
+                "elasticity: target_global_batch %s does not divide by "
+                "world size %s; training at %s with lr scaled by %.6g "
+                "(%s rule)", target, self.world_size, plan.global_batch,
+                plan.lr_scale, el.lr_scaling)
+        if self.train_micro_batch_size_per_gpu is not None and \
+                plan.micro_batch != self.train_micro_batch_size_per_gpu:
+            logger.info(
+                "elasticity: re-factored batch for world size %s: "
+                "micro %s -> %s, accum %s -> %s", self.world_size,
+                self.train_micro_batch_size_per_gpu, plan.micro_batch,
+                self.gradient_accumulation_steps, plan.grad_accum)
+        self.train_batch_size = plan.global_batch
+        self.train_micro_batch_size_per_gpu = plan.micro_batch
+        self.gradient_accumulation_steps = plan.grad_accum
+        self.elastic_lr_scale = plan.lr_scale
 
     def _do_sanity_check(self):
         self._do_error_check()
@@ -588,6 +666,29 @@ class DeepSpeedConfig:
                 "comm_quantization requires the in-jit update path; "
                 "ZeRO-Offload steps the optimizer on host")
         self._check_resilience()
+        self._check_elasticity()
+
+    def _check_elasticity(self):
+        from deepspeed_tpu.runtime.elastic.batch import LR_SCALING_RULES
+        el = self.elasticity
+        if el.max_world_size and el.max_world_size < 0:
+            raise ValueError(
+                f"elasticity: max_world_size must be >= 0 (0 = unbounded),"
+                f" got {el.max_world_size}")
+        if not el.enabled:
+            return
+        if el.lr_scaling not in LR_SCALING_RULES:
+            raise ValueError(
+                f"elasticity: lr_scaling must be one of {LR_SCALING_RULES},"
+                f" got {el.lr_scaling!r}")
+        if el.target_global_batch is not None and el.target_global_batch <= 0:
+            raise ValueError(
+                f"elasticity: target_global_batch must be > 0, "
+                f"got {el.target_global_batch}")
+        if el.max_world_size and self.world_size > el.max_world_size:
+            raise ValueError(
+                f"elasticity: world size {self.world_size} exceeds "
+                f"max_world_size {el.max_world_size}")
 
     def _check_resilience(self):
         from deepspeed_tpu.runtime.resilience.guards import (

@@ -261,3 +261,19 @@ RESILIENCE_FAULT_INJECTION_ENABLED_DEFAULT = False
 
 RESILIENCE_HOST_ADAM_RETRIES = "host_adam_retries"
 RESILIENCE_HOST_ADAM_RETRIES_DEFAULT = 2
+
+# Elasticity (runtime/elastic/): topology-agnostic checkpoints,
+# reshard-on-resume across data-parallel world sizes, and the elastic
+# batch solver that re-derives micro x grad_accum to preserve the
+# effective batch. See docs/elasticity.md.
+ELASTICITY = "elasticity"
+ELASTICITY_ENABLED = "enabled"
+ELASTICITY_ENABLED_DEFAULT = False
+ELASTICITY_TARGET_GLOBAL_BATCH = "target_global_batch"
+ELASTICITY_TARGET_GLOBAL_BATCH_DEFAULT = None  # None = train_batch_size
+ELASTICITY_MAX_WORLD_SIZE = "max_world_size"
+ELASTICITY_MAX_WORLD_SIZE_DEFAULT = 0  # 0 = unbounded
+ELASTICITY_STRICT = "strict"
+ELASTICITY_STRICT_DEFAULT = False
+ELASTICITY_LR_SCALING = "lr_scaling"
+ELASTICITY_LR_SCALING_DEFAULT = "linear"  # linear | sqrt | none

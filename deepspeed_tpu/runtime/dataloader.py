@@ -25,6 +25,7 @@ class RepeatingLoader:
         self.loader = loader
         self.epoch = 0
         self.batches_served = 0
+        self.samples_served = 0
         self.data_iter = iter(self.loader)
 
     def __iter__(self):
@@ -40,25 +41,63 @@ class RepeatingLoader:
             self.data_iter = iter(self.loader)
             batch = next(self.data_iter)
         self.batches_served += 1
+        self.samples_served += _batch_rows(batch)
         return batch
 
     # -- resume (runtime/resilience auto-resume restores data position) --
     def state_dict(self):
-        return {"epoch": self.epoch, "batches_served": self.batches_served}
+        # samples_served is the global sample cursor: unlike the batch
+        # index it survives a world-size or micro-batch change on elastic
+        # resume (the same position counted in different-sized batches).
+        # batches_served stays for checkpoints read by older code.
+        return {"epoch": self.epoch,
+                "batches_served": self.batches_served,
+                "samples_served": self.samples_served}
 
     def load_state_dict(self, state):
         """Fast-forward to the saved position by replaying the stream from
         the start: batch order is a pure function of (seed, epoch), so
         redrawing reproduces the exact sequence — the resumed run sees
         bit-identical batches to an uninterrupted one. Replay cost is one
-        collate per skipped batch (no device transfer)."""
+        collate per skipped batch (no device transfer).
+
+        Position is the global *sample* cursor when the checkpoint has
+        one (so it lands correctly after an elastic batch re-factor);
+        pre-elastic checkpoints fall back to the batch index."""
         self.epoch = 0
         self.batches_served = 0
+        self.samples_served = 0
         if hasattr(self.loader, "set_epoch"):
             self.loader.set_epoch(0)
         self.data_iter = iter(self.loader)
-        for _ in range(int(state["batches_served"])):
+        target_samples = state.get("samples_served")
+        if target_samples is None:
+            for _ in range(int(state["batches_served"])):
+                next(self)
+            return
+        while self.samples_served < int(target_samples):
             next(self)
+        if self.samples_served != int(target_samples):
+            # New batch size does not divide the saved cursor: land on
+            # the next batch boundary (at most one batch of overlap is
+            # re-served, never silently skipped data).
+            import logging
+            logging.getLogger(__name__).warning(
+                "dataloader resume: saved sample cursor %s is not a "
+                "multiple of the current batch size; resuming at %s",
+                target_samples, self.samples_served)
+
+
+def _batch_rows(batch):
+    """Number of rows in a collated batch (leading dim of its first
+    array), for the global sample cursor."""
+    first = batch
+    while isinstance(first, dict):
+        first = next(iter(first.values()))
+    while isinstance(first, (tuple, list)):
+        first = first[0]
+    shape = np.shape(first)
+    return int(shape[0]) if shape else 1
 
 
 def _default_collate(samples):

@@ -1,0 +1,51 @@
+"""Elasticity: topology-agnostic checkpoints and reshard-on-resume.
+
+A checkpoint saved at data-parallel world size N is a deterministic
+relayout away from world size M — ZeRO here is GSPMD sharding
+declarations over the ``data`` axis (`runtime/zero/sharding.py`), so the
+logical arrays never depend on the world size. This package owns that
+relayout plus the batch/LR bookkeeping DeepSpeed's elasticity config
+standardized:
+
+- :mod:`topology` — topology capture/compare + the manifest's
+  PartitionSpec (de)serialization; typed
+  :class:`CheckpointTopologyError` / :class:`ElasticResumeError`.
+- :mod:`batch` — :func:`solve_elastic_batch`: re-derive
+  micro x grad_accum for a new world so the effective batch (and LR
+  schedule) is preserved, or scale LR by the configured rule.
+- :mod:`reshard` — streaming host->device placement for resume and the
+  offline checkpoint rewriter behind ``bin/ds_tpu_reshard``.
+
+Engine wiring rides the ``elasticity`` config block
+(`runtime/config.py`); see docs/elasticity.md.
+"""
+
+from deepspeed_tpu.runtime.elastic.errors import (
+    CheckpointTopologyError,
+    ElasticResumeError,
+)
+from deepspeed_tpu.runtime.elastic.batch import (
+    BatchPlan,
+    solve_elastic_batch,
+)
+from deepspeed_tpu.runtime.elastic.topology import (
+    TopologyCheck,
+    check_topology,
+    current_topology,
+)
+from deepspeed_tpu.runtime.elastic.reshard import (
+    reshard_checkpoint,
+    stream_device_put,
+)
+
+__all__ = [
+    "BatchPlan",
+    "CheckpointTopologyError",
+    "ElasticResumeError",
+    "TopologyCheck",
+    "check_topology",
+    "current_topology",
+    "reshard_checkpoint",
+    "solve_elastic_batch",
+    "stream_device_put",
+]

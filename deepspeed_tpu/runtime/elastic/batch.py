@@ -1,0 +1,100 @@
+"""Elastic batch solver: preserve the effective batch across world sizes.
+
+DeepSpeed's batch triple is ``train_batch = micro * grad_accum * world``
+(`runtime/config.py`). When the world size changes on resume, a pinned
+micro/accum pair generally no longer factors the same global batch —
+silently training at a different effective batch would shift the loss
+curve and desynchronize the LR schedule (which advances per optimizer
+step). :func:`solve_elastic_batch` re-derives ``micro x accum`` for the
+new world so the global batch (and therefore the optimizer-step count
+per epoch, i.e. the LR schedule) is preserved exactly whenever the
+target divides; when it cannot divide, it picks the nearest achievable
+global batch and reports the LR scale the configured rule prescribes
+(linear/sqrt, per the large-batch scaling literature) — or raises under
+``strict``.
+"""
+
+import math
+from typing import NamedTuple, Optional
+
+from deepspeed_tpu.runtime.elastic.errors import ElasticResumeError
+
+LR_SCALING_LINEAR = "linear"
+LR_SCALING_SQRT = "sqrt"
+LR_SCALING_NONE = "none"
+LR_SCALING_RULES = (LR_SCALING_LINEAR, LR_SCALING_SQRT, LR_SCALING_NONE)
+
+
+class BatchPlan(NamedTuple):
+    """One solved batch configuration for a given world size."""
+    micro_batch: int       # train_micro_batch_size_per_gpu
+    grad_accum: int        # gradient_accumulation_steps
+    global_batch: int      # micro * accum * world (the achieved batch)
+    world_size: int
+    exact: bool            # achieved == target
+    lr_scale: float        # 1.0 when exact; else per the scaling rule
+
+
+def solve_elastic_batch(target_global_batch,
+                        world_size,
+                        prefer_micro: Optional[int] = None,
+                        prefer_accum: Optional[int] = None,
+                        max_micro: Optional[int] = None,
+                        lr_scaling: str = LR_SCALING_LINEAR,
+                        strict: bool = False) -> BatchPlan:
+    """Factor ``target_global_batch`` as micro x accum x world_size.
+
+    Preference order for the per-rank factorization: keep the user's
+    micro batch if it still divides, else keep their accum steps, else
+    minimize accum (``accum=1``, bounded by ``max_micro`` when given).
+    ``strict`` turns an inexact target into :class:`ElasticResumeError`
+    instead of an LR-scaled approximation.
+    """
+    target = int(target_global_batch)
+    world = int(world_size)
+    if target <= 0:
+        raise ValueError(f"target_global_batch must be > 0, got {target}")
+    if world <= 0:
+        raise ValueError(f"world_size must be > 0, got {world}")
+    if lr_scaling not in LR_SCALING_RULES:
+        raise ValueError(f"lr_scaling must be one of {LR_SCALING_RULES}, "
+                         f"got {lr_scaling!r}")
+
+    q, r = divmod(target, world)
+    if r == 0:
+        achieved, per_rank, exact = target, q, True
+    else:
+        if strict:
+            raise ElasticResumeError(
+                f"elasticity.strict: target_global_batch {target} does "
+                f"not divide by world size {world} — no micro x accum "
+                "factoring preserves the effective batch exactly")
+        # Nearest achievable multiple of the world size (at least one
+        # sample per rank), integer round-half-up.
+        per_rank = max(1, q + (1 if 2 * r >= world else 0))
+        achieved, exact = per_rank * world, False
+
+    if prefer_micro and per_rank % int(prefer_micro) == 0:
+        micro = int(prefer_micro)
+    elif prefer_accum and per_rank % int(prefer_accum) == 0:
+        micro = per_rank // int(prefer_accum)
+    else:
+        micro = per_rank
+    if max_micro and micro > int(max_micro):
+        # Smallest accum that brings the micro batch under the cap while
+        # still dividing per_rank evenly.
+        micro = next((per_rank // a for a in range(1, per_rank + 1)
+                      if per_rank % a == 0 and
+                      per_rank // a <= int(max_micro)), 1)
+    accum = per_rank // micro
+
+    if exact or lr_scaling == LR_SCALING_NONE:
+        lr_scale = 1.0
+    elif lr_scaling == LR_SCALING_SQRT:
+        lr_scale = math.sqrt(achieved / target)
+    else:
+        lr_scale = achieved / target
+
+    return BatchPlan(micro_batch=micro, grad_accum=accum,
+                     global_batch=achieved, world_size=world,
+                     exact=exact, lr_scale=lr_scale)

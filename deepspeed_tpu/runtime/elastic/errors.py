@@ -1,0 +1,33 @@
+"""Typed errors for topology-aware checkpoint loads.
+
+Before this subsystem, loading a checkpoint saved under a different
+topology either worked by accident (single-process dp resize), went
+through the pipeline restage path, or died deep inside orbax/XLA with an
+opaque shape error. These exceptions make the outcome explicit: a
+checkpoint/engine topology mismatch is always reported as a
+:class:`CheckpointTopologyError`, and the subset of mismatches that no
+amount of resharding can bridge (tensor-parallel degree changed, offload
+flipped) as the :class:`ElasticResumeError` refinement.
+"""
+
+
+class CheckpointTopologyError(RuntimeError):
+    """The checkpoint was saved under a different topology than the
+    engine is running on, and ``elasticity.enabled`` is off.
+
+    Carries ``saved`` / ``current`` topology dicts (as recorded in the
+    checkpoint manifest / observed on the live mesh) so callers can log
+    or decide without re-parsing the message.
+    """
+
+    def __init__(self, message, saved=None, current=None):
+        super().__init__(message)
+        self.saved = saved
+        self.current = current
+
+
+class ElasticResumeError(CheckpointTopologyError):
+    """The topology change is one elasticity cannot bridge — e.g. the
+    tensor-parallel (``model``) degree changed, or ZeRO-Offload was
+    toggled (the optimizer-state tree has a different structure). Raised
+    whether or not elasticity is enabled."""

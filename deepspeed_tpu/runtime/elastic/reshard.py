@@ -1,0 +1,180 @@
+"""Resharder: reassemble logical arrays and re-partition to a new mesh.
+
+Two consumers:
+
+- **Engine resume** (`engine.load_checkpoint`): checkpoints restore as
+  full host-numpy logical arrays (`resilience/checkpoint.py` sidesteps
+  orbax's different-topology path on purpose); :func:`stream_device_put`
+  places them leaf-by-leaf on the *current* mesh's shardings, dropping
+  each host buffer as soon as its device copy exists so peak host
+  memory is bounded by one extra leaf, not a second full state tree.
+- **Offline CLI** (`bin/ds_tpu_reshard`): :func:`reshard_checkpoint`
+  rewrites a checkpoint saved for world size N into one addressed to
+  world size M without booting an engine — CRC-verified read, manifest
+  ``topology``/``arrays`` sections retargeted (the elastic axis kept on
+  the dims it occupied, dropped only where the new world size stops
+  dividing them), atomic tmp+rename write, and a garbage-collected tmp on
+  mid-write failure (the source checkpoint is never touched).
+"""
+
+import logging
+import os
+import shutil
+
+import numpy as np
+import jax
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.parallel.mesh import MESH_AXES
+from deepspeed_tpu.runtime.elastic.topology import (
+    spec_from_json,
+    spec_to_json,
+)
+from deepspeed_tpu.runtime.resilience.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointIOError,
+    CheckpointManager,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def stream_device_put(tree, shardings):
+    """Place a host pytree on device leaf-by-leaf, releasing each host
+    buffer once its device copy is live.
+
+    ``shardings`` is either a single Sharding (applied to every leaf) or
+    a pytree congruent with ``tree``. A whole-tree ``jax.device_put``
+    would keep every host leaf referenced until the full transfer is
+    built; here the host array drops out of the flattened list as soon
+    as its device leaf exists, so the only lingering host references are
+    the ones the *caller* still holds.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    single = isinstance(shardings, (jax.sharding.Sharding,
+                                    getattr(jax, "Device", ())))
+    shard_leaves = [shardings] * len(leaves) if single \
+        else treedef.flatten_up_to(shardings)
+    out = []
+    for i, sh in enumerate(shard_leaves):
+        out.append(jax.device_put(leaves[i], sh))
+        leaves[i] = None
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _retarget_arrays(arrays, target_world, axis="data"):
+    """Retarget each array's PartitionSpec for a new data-axis size.
+
+    The elastic axis stays on exactly the dims it occupied in the saved
+    spec, dropped only where the new world size no longer divides that
+    dim. Keeping the placement (rather than re-solving it) makes the
+    rewrite invertible — N→M→N reproduces the source manifest whenever
+    divisibility holds both ways, including through M=1 where a re-solve
+    would collapse the axis marker and lose it.
+    """
+    out = {}
+    for key, rec in (arrays or {}).items():
+        saved_spec = spec_from_json(rec.get("spec"))
+        shape = tuple(int(d) for d in rec.get("shape") or ())
+        entries = []
+        for dim, entry in enumerate(tuple(saved_spec)):
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if axis in names:
+                if dim < len(shape) and shape[dim] % target_world == 0:
+                    entries.append(entry)
+                    continue
+                logger.warning(
+                    "leaf %s dim %d (size %s) not divisible by target "
+                    "world %d: dropping %r from its spec (replicated)",
+                    key, dim, shape[dim] if dim < len(shape) else "?",
+                    target_world, axis)
+                kept = tuple(n for n in names if n != axis)
+                entries.append(kept if len(kept) > 1 else
+                               (kept[0] if kept else None))
+            else:
+                entries.append(entry)
+        out[key] = {**rec, "spec": spec_to_json(PartitionSpec(*entries))}
+    return out
+
+
+def reshard_checkpoint(src_dir, dst_dir, target_world, tag=None,
+                       io_retries=3, io_retry_base_s=0.05):
+    """Rewrite the checkpoint at ``src_dir`` for ``target_world`` data-
+    parallel ranks into ``dst_dir``; returns a summary dict.
+
+    The source is CRC-verified on read and never modified. The target is
+    written through the same atomic tmp+rename path as engine saves; on
+    I/O failure past the retry budget the partial tmp dir is removed and
+    :class:`CheckpointIOError` propagates — ``dst_dir`` never holds a
+    partial final checkpoint. Array bytes pass through untouched (the
+    logical arrays are world-size-independent); what changes is the
+    manifest's topology/arrays addressing and the meta's world size.
+    """
+    target_world = int(target_world)
+    if target_world < 1:
+        raise ValueError(f"target world size must be >= 1, "
+                         f"got {target_world}")
+    src_mgr = CheckpointManager(save_dir=src_dir, io_retries=io_retries,
+                                io_retry_base_s=io_retry_base_s,
+                                process_index=0, process_count=1)
+    resolved = src_mgr.resolve_tag(src_dir, tag)
+    if resolved is None:
+        raise CheckpointCorruptError(
+            src_dir, "no valid checkpoint to reshard")
+    src_path = src_mgr.ckpt_path(src_dir, resolved)
+    manifest = src_mgr.validate(src_path)
+    state, meta, _ = src_mgr.load(src_dir, resolved)
+
+    src_topo = dict(manifest.get("topology") or {})
+    src_mesh = dict(src_topo.get("mesh_shape") or
+                    {a: 1 for a in MESH_AXES})
+    src_world = int(src_mesh.get("data") or
+                    meta.get("dp_world_size") or 1)
+    hard = {a: int(src_mesh.get(a) or 1) for a in ("model", "seq", "expert")
+            if int(src_mesh.get(a) or 1) > 1}
+    if hard:
+        logger.warning(
+            "resharding a checkpoint with non-trivial %s axes: only the "
+            "data axis is retargeted", hard)
+
+    new_mesh = dict(src_mesh)
+    new_mesh["data"] = target_world
+    new_topo = dict(src_topo)
+    new_topo.update({"mesh_shape": new_mesh, "process_count": 1})
+    arrays = manifest.get("arrays")
+    if arrays:
+        arrays = _retarget_arrays(arrays, target_world)
+
+    new_meta = dict(meta)
+    new_meta["dp_world_size"] = target_world
+    new_meta["resharded_from"] = {"dp_world_size": src_world,
+                                  "path": src_path}
+
+    dst_mgr = CheckpointManager(save_dir=dst_dir, io_retries=io_retries,
+                                io_retry_base_s=io_retry_base_s,
+                                process_index=0, process_count=1)
+    extra = {"topology": new_topo}
+    if arrays is not None:
+        extra["arrays"] = arrays
+    try:
+        dst_path = dst_mgr.save(dst_dir, resolved, state, new_meta,
+                                extra_manifest=extra, fault_op="reshard")
+    except CheckpointIOError:
+        # The atomic-save contract leaves at most a tmp dir behind; GC it
+        # so the target directory holds no partial bytes at all.
+        shutil.rmtree(dst_mgr._tmp_path(dst_dir, resolved),
+                      ignore_errors=True)
+        raise
+    dst_mgr.validate(dst_path)
+
+    n_bytes = sum(int(np.asarray(leaf).nbytes)
+                  for leaf in jax.tree_util.tree_leaves(state))
+    return {
+        "tag": resolved,
+        "src_path": src_path,
+        "dst_path": dst_path,
+        "src_world": src_world,
+        "target_world": target_world,
+        "n_leaves": len(jax.tree_util.tree_leaves(state)),
+        "state_bytes": n_bytes,
+    }

@@ -1,0 +1,177 @@
+"""Checkpoint topology capture + compatibility policy.
+
+A checkpoint manifest (format v2, `resilience/checkpoint.py`) carries a
+``topology`` section recording the mesh shape, process count, ZeRO stage
+and offload flag at save time, plus an ``arrays`` section with each
+leaf's logical shape, dtype and PartitionSpec. That makes any checkpoint
+self-describing: :func:`check_topology` compares the saved topology with
+the live engine's and classifies the load instead of letting a mismatch
+surface as an opaque orbax/shape error.
+
+Classification policy (the engine's actual capabilities, not wishes):
+
+- ``same``      — identical topology; plain restore.
+- ``unknown``   — pre-elastic checkpoint (no topology recorded); the
+  engine loads it as before, shape errors surface at placement time.
+- ``restage``   — the ``pipe`` axis changed. Pipeline restage-on-load
+  (`engine._reshape_for_restage`) predates elasticity and validates
+  payload dims itself, so this stays allowed with or without the
+  ``elasticity`` block (the accompanying ``data``-axis recount over a
+  fixed device pool is part of the same supported path).
+- ``relayout``  — only the ZeRO stage changed. Sharding declarations are
+  a pure relayout of the same logical arrays; always allowed.
+- ``elastic``   — the ``data`` axis size or process count changed.
+  Allowed only with ``elasticity.enabled`` (the batch/LR bookkeeping
+  must be re-solved); otherwise :class:`CheckpointTopologyError`.
+- hard mismatch — ``model``/``seq``/``expert`` axis or the offload flag
+  changed: :class:`ElasticResumeError` regardless of config. Tensor/
+  sequence/expert parallel degrees change what the saved arrays *mean*
+  (or, for offload, the state-tree structure), not just their layout.
+"""
+
+from typing import NamedTuple
+
+import jax
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.parallel.mesh import MESH_AXES, mesh_shape_dict
+from deepspeed_tpu.runtime.elastic.errors import (
+    CheckpointTopologyError,
+    ElasticResumeError,
+)
+
+# Axes whose size elasticity can absorb (pure relayout over the mesh)
+# vs. axes that change the meaning/partitioning of the model itself.
+ELASTIC_AXES = ("data",)
+RESTAGE_AXES = ("pipe",)
+HARD_AXES = ("model", "seq", "expert")
+
+
+def current_topology(mesh, zero_stage=0, offload=False, process_count=None):
+    """The live engine's topology, in the manifest's schema."""
+    if process_count is None:
+        process_count = jax.process_count()
+    return {
+        "mesh_shape": mesh_shape_dict(mesh),
+        "process_count": int(process_count),
+        "zero_stage": int(zero_stage),
+        "offload": bool(offload),
+    }
+
+
+class TopologyCheck(NamedTuple):
+    kind: str      # same | unknown | restage | relayout | elastic
+    changed: dict  # field -> (saved, current), empty for same/unknown
+
+
+def _axis_sizes(topo):
+    shape = dict(topo.get("mesh_shape") or {})
+    return {a: int(shape.get(a, 1) or 1) for a in MESH_AXES}
+
+
+def check_topology(saved, current, elastic=False):
+    """Classify a checkpoint/engine topology pair; raise typed errors.
+
+    ``saved`` is the manifest's topology section (None for pre-elastic
+    checkpoints), ``current`` the live engine's (:func:`current_topology`).
+    Returns a :class:`TopologyCheck`; raises
+    :class:`ElasticResumeError` for hard mismatches and
+    :class:`CheckpointTopologyError` for elastic-only mismatches when
+    ``elastic`` is False.
+    """
+    if not saved:
+        return TopologyCheck("unknown", {})
+
+    changed = {}
+    s_axes, c_axes = _axis_sizes(saved), _axis_sizes(current)
+    for axis in MESH_AXES:
+        if s_axes[axis] != c_axes[axis]:
+            changed[axis] = (s_axes[axis], c_axes[axis])
+    for field in ("process_count", "zero_stage", "offload"):
+        s, c = saved.get(field), current.get(field)
+        if s is not None and c is not None and s != c:
+            changed[field] = (s, c)
+
+    if not changed:
+        return TopologyCheck("same", {})
+
+    hard = [a for a in HARD_AXES if a in changed]
+    if hard or "offload" in changed:
+        what = (f"offload={changed['offload'][0]} -> "
+                f"{changed['offload'][1]}" if "offload" in changed else
+                ", ".join(f"{a}={changed[a][0]} -> {changed[a][1]}"
+                          for a in hard))
+        raise ElasticResumeError(
+            f"checkpoint cannot be resumed on this topology: {what} "
+            "changed. Resharding covers data-parallel world size and "
+            "ZeRO layout only — a tensor/sequence/expert-parallel degree "
+            "or offload change alters what the saved arrays mean, not "
+            "just their placement.", saved=saved, current=current)
+
+    if any(a in changed for a in RESTAGE_AXES):
+        # Pipeline restage-on-load owns this case (including the data-axis
+        # recount over the same device pool); payload-dim validation
+        # happens leaf-wise in the engine.
+        return TopologyCheck("restage", changed)
+
+    needs_elastic = [k for k in changed if k in ELASTIC_AXES or
+                     k == "process_count"]
+    if needs_elastic:
+        if not elastic:
+            desc = ", ".join(f"{k}: {changed[k][0]} -> {changed[k][1]}"
+                             for k in needs_elastic)
+            raise CheckpointTopologyError(
+                f"checkpoint was saved under a different topology "
+                f"({desc}) and elasticity is disabled. Set "
+                '{"elasticity": {"enabled": true}} to reshard-on-resume '
+                "(or use bin/ds_tpu_reshard to rewrite the checkpoint "
+                "offline).", saved=saved, current=current)
+        return TopologyCheck("elastic", changed)
+
+    # Only zero_stage differs: sharding declarations are a relayout of
+    # the same logical arrays — always loadable.
+    return TopologyCheck("relayout", changed)
+
+
+# ----------------------------------------------------------------------
+# PartitionSpec (de)serialization for the manifest's arrays section
+# ----------------------------------------------------------------------
+
+def spec_to_json(spec):
+    """PartitionSpec -> JSON list (str | None | [str, ...] per dim)."""
+    if spec is None:
+        return None
+    out = []
+    for entry in tuple(spec):
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append(list(entry))
+    return out
+
+
+def spec_from_json(data):
+    """Inverse of :func:`spec_to_json` (None -> replicated)."""
+    if data is None:
+        return PartitionSpec()
+    return PartitionSpec(
+        *[tuple(e) if isinstance(e, list) else e for e in data])
+
+
+def strip_axis(spec, axis="data"):
+    """Remove every occurrence of ``axis`` from a PartitionSpec.
+
+    Recovers the base (pre-ZeRO) spec from a saved one so the resharder
+    can re-run the zero partitioning decision for a new axis size.
+    """
+    entries = []
+    for e in tuple(spec or ()):
+        if e == axis:
+            entries.append(None)
+        elif isinstance(e, (tuple, list)):
+            kept = tuple(a for a in e if a != axis)
+            entries.append(kept if len(kept) > 1
+                           else (kept[0] if kept else None))
+        else:
+            entries.append(e)
+    return PartitionSpec(*entries)

@@ -44,6 +44,10 @@ from deepspeed_tpu.runtime.utils import check_overflow, clip_by_global_norm, glo
 from deepspeed_tpu.runtime.zero.sharding import (
     build_zero_shardings, constrain_tree, make_param_caster)
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+from deepspeed_tpu.runtime.elastic import (
+    CheckpointTopologyError, check_topology, current_topology,
+    stream_device_put)
+from deepspeed_tpu.runtime.elastic.topology import spec_to_json
 from deepspeed_tpu.runtime.resilience import fault_injection
 from deepspeed_tpu.runtime.resilience.checkpoint import CheckpointManager
 from deepspeed_tpu.runtime.resilience.guards import (
@@ -708,6 +712,14 @@ class DeepSpeedEngine:
         else:
             beta1 = getattr(self, "_betas", (0.9, 0.999))[0]
             self._mom_fn = lambda step: jnp.asarray(beta1, jnp.float32)
+        # Elastic batch re-factor may land on an inexact global batch; the
+        # configured lr_scaling rule compensates by scaling the whole
+        # schedule (exact factorizations leave scale == 1.0).
+        self._elastic_lr_scale = float(
+            getattr(self._config, "elastic_lr_scale", 1.0) or 1.0)
+        if self._elastic_lr_scale != 1.0 and self._lr_foldable:
+            inner, scale = self._lr_fn, self._elastic_lr_scale
+            self._lr_fn = lambda step: inner(step) * jnp.float32(scale)
 
     def _opt_state_shardings(self):
         """Shardings for the optimizer-state pytree: the m/v moment trees
@@ -746,7 +758,8 @@ class DeepSpeedEngine:
         if self._lr_foldable:
             return 0.0  # unused: lr comes from the folded schedule
         lrs = self.lr_scheduler.get_lr()
-        return float(lrs[0] if isinstance(lrs, (list, tuple)) else lrs)
+        lr = float(lrs[0] if isinstance(lrs, (list, tuple)) else lrs)
+        return lr * self._elastic_lr_scale
 
     def _init_device_state(self):
         rep = NamedSharding(self.mesh, PartitionSpec())
@@ -2319,6 +2332,35 @@ class DeepSpeedEngine:
     def _get_ckpt_name(self, checkpoints_path, tag):
         return os.path.join(checkpoints_path, str(tag))
 
+    def _topology(self):
+        """This engine's topology fingerprint (manifest "topology" section):
+        mesh shape, process count, ZeRO stage, offload flag — what
+        :func:`check_topology` compares on load to decide whether the
+        checkpoint needs an elastic reshard."""
+        return current_topology(self.mesh,
+                                zero_stage=self.zero_optimization_stage(),
+                                offload=self._offload)
+
+    def _arrays_manifest(self, state):
+        """Per-leaf logical metadata (manifest "arrays" section): shape,
+        dtype, and the PartitionSpec each leaf is laid out with — enough
+        for the offline resharder to re-partition the checkpoint for a
+        different world size without importing the model."""
+        arrays = {}
+        leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(state)
+        for path, leaf in leaves_with_path:
+            sharding = getattr(leaf, "sharding", None)
+            spec = getattr(sharding, "spec", None)
+            if spec is None:
+                spec = PartitionSpec()  # host numpy / scalar: replicated
+            arrays[jax.tree_util.keystr(path)] = {
+                "shape": list(np.shape(leaf)),
+                "dtype": str(leaf.dtype) if hasattr(leaf, "dtype")
+                else str(np.asarray(leaf).dtype),
+                "spec": spec_to_json(spec),
+            }
+        return arrays
+
     def _checkpoint_state_tree(self):
         """Array pytree a checkpoint persists (the orbax payload)."""
         # Under cpu_offload the device params are a compute-dtype copy;
@@ -2379,8 +2421,13 @@ class DeepSpeedEngine:
             tag = f"global_step{self.global_steps}"
         state = self._checkpoint_state_tree()
         meta = self._checkpoint_meta(client_state)
+        extra_manifest = {
+            "topology": self._topology(),
+            "arrays": self._arrays_manifest(state),
+        }
         path = self._ckpt_manager.save(save_dir, tag, state, meta,
-                                       save_latest=save_latest)
+                                       save_latest=save_latest,
+                                       extra_manifest=extra_manifest)
         log_dist(f"saved checkpoint {path}", ranks=[0])
         return True
 
@@ -2477,6 +2524,19 @@ class DeepSpeedEngine:
             logger.warning(f"no valid checkpoint found at {load_dir}; "
                            "cannot load")
             return None, {}
+        # Topology gate: a checkpoint saved under a different data-parallel
+        # layout only loads when elasticity is enabled (a reshard-on-load),
+        # and raises the typed ElasticResumeError when the change is one no
+        # relayout can absorb (tensor-parallel degree, offload toggle).
+        manifest = self._ckpt_manager.validate(
+            self._ckpt_manager.ckpt_path(load_dir, resolved))
+        check = check_topology(
+            manifest.get("topology"), self._topology(),
+            elastic=bool(self._config.elasticity.enabled))
+        if check.kind == "elastic":
+            log_dist(
+                f"elastic resume: checkpoint topology {check.changed} "
+                f"differs from current mesh; resharding on load", ranks=[0])
         # Restore as host numpy arrays (placement happens below on the
         # CURRENT mesh/shardings) — restoring with the saved shardings
         # trips orbax's "unsafe when restoring on a different topology"
@@ -2514,18 +2574,22 @@ class DeepSpeedEngine:
                 opt._step = int(saved["step"])
             self.params = self._upload_offload_params()
         else:
-            self.params = jax.device_put(
+            # Streaming placement: each leaf is device_put individually and
+            # its host copy dropped immediately after, so peak host memory
+            # during an (elastic) restore stays ~one full section + one
+            # leaf rather than the whole state tree twice.
+            self.params = stream_device_put(
                 self._reshape_for_restage(restored["params"], self.params,
                                           "param"),
                 self._shardings["param"])
+            del restored["params"]
             if load_optimizer_states:
-                opt_tree = jax.tree_util.tree_map(jnp.asarray,
-                                                  restored["opt_state"])
+                opt_tree = restored.pop("opt_state")
                 opt_tree["m"] = self._reshape_for_restage(
                     opt_tree["m"], self.opt_state.m, "opt.m")
                 opt_tree["v"] = self._reshape_for_restage(
                     opt_tree["v"], self.opt_state.v, "opt.v")
-                self.opt_state = jax.device_put(
+                self.opt_state = stream_device_put(
                     self._opt_state_from_tree(opt_tree, self.opt_state),
                     self._opt_state_shardings())
         ds = restored["device_state"]

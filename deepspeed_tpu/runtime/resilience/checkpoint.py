@@ -58,7 +58,12 @@ META_NAME = "meta.json"
 MANIFEST_NAME = "manifest.json"
 LATEST_NAME = "latest"
 TMP_PREFIX = ".tmp."
-MANIFEST_VERSION = 1
+# v2 adds the self-describing sections the elasticity subsystem reads
+# (`runtime/elastic/`): "topology" (mesh shape, process count, ZeRO
+# stage, offload flag) and "arrays" (per-leaf logical shape + dtype +
+# PartitionSpec). v1 checkpoints stay loadable — readers treat the
+# sections as optional.
+MANIFEST_VERSION = 2
 
 
 class CheckpointIOError(RuntimeError):
@@ -148,14 +153,20 @@ class CheckpointManager:
     # save
     # ------------------------------------------------------------------
     def save(self, save_dir, tag, state, meta, save_latest=True,
-             async_save=None):
+             async_save=None, extra_manifest=None, fault_op="save"):
         """Atomically write one checkpoint; returns its final path.
 
         ``state`` is the engine's array pytree (orbax payload), ``meta``
-        a JSON-serializable dict. With async enabled the state is
-        snapshotted to host numpy before returning (safe against the
-        engine's donated device buffers) and the I/O happens on a
-        background worker — call :meth:`wait` to join it.
+        a JSON-serializable dict. ``extra_manifest`` (JSON-serializable)
+        is merged into manifest.json — the engine records its
+        ``topology``/``arrays`` sections there so checkpoints are
+        self-describing (`runtime/elastic/topology.py`). ``fault_op``
+        names the fault-injection seam probed at the worst-case
+        interrupt point ("save" for engine saves, "reshard" for the
+        offline resharder). With async enabled the state is snapshotted
+        to host numpy before returning (safe against the engine's
+        donated device buffers) and the I/O happens on a background
+        worker — call :meth:`wait` to join it.
         """
         self.wait()  # surface a previous async failure before overwriting
         use_async = self.async_save if async_save is None else async_save
@@ -170,9 +181,11 @@ class CheckpointManager:
                     max_workers=1,
                     thread_name_prefix="ckpt_save")
             self._pending = self._pool.submit(
-                self._save_sync, save_dir, tag, state, meta, save_latest)
+                self._save_sync, save_dir, tag, state, meta, save_latest,
+                extra_manifest, fault_op)
             return self.ckpt_path(save_dir, tag)
-        return self._save_sync(save_dir, tag, state, meta, save_latest)
+        return self._save_sync(save_dir, tag, state, meta, save_latest,
+                               extra_manifest, fault_op)
 
     def wait(self):
         """Join an in-flight async save, raising its error if it failed."""
@@ -180,7 +193,8 @@ class CheckpointManager:
             pending, self._pending = self._pending, None
             pending.result()
 
-    def _save_sync(self, save_dir, tag, state, meta, save_latest):
+    def _save_sync(self, save_dir, tag, state, meta, save_latest,
+                   extra_manifest=None, fault_op="save"):
         save_dir = os.path.abspath(save_dir)
         final = self.ckpt_path(save_dir, tag)
         tmp = self._tmp_path(save_dir, tag)
@@ -194,11 +208,12 @@ class CheckpointManager:
                 os.path.join(tmp, STATE_SUBDIR), state, force=True)
             # Worst-case interrupt point for the harness: state is on
             # disk but the checkpoint is not yet valid or published.
-            fault_injection.maybe_fail_io("save")
+            fault_injection.maybe_fail_io(fault_op)
             if self._pi == 0:
                 with open(os.path.join(tmp, META_NAME), "w") as f:
                     json.dump(meta, f)
-                manifest = {
+                manifest = dict(extra_manifest or {})
+                manifest.update({
                     "format_version": MANIFEST_VERSION,
                     "tag": str(tag),
                     "global_steps": meta.get("global_steps"),
@@ -207,7 +222,7 @@ class CheckpointManager:
                     # any one host — inventory-only integrity there.
                     "checksums": _leaf_checksums(state)
                     if self._pc == 1 else None,
-                }
+                })
                 with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
                     json.dump(manifest, f)
                 if os.path.isdir(final):

@@ -87,9 +87,21 @@ def grad_fault_value(step):
 # --------------------------------------------------------------------------
 
 def inject_io_failure(op="save", times=1):
-    """Arm ``times`` consecutive failures of checkpoint ``op`` ("save"/"load")."""
+    """Arm ``times`` consecutive failures of checkpoint ``op``
+    ("save"/"load"/"reshard")."""
     with _lock:
         _faults[f"io_failure:{op}"] = {"times": int(times)}
+
+
+def inject_reshard_failure(times=1):
+    """Arm ``times`` consecutive mid-reshard I/O failures.
+
+    The probe fires inside the resharder's target write, after the state
+    bytes are staged and before the manifest seal + atomic rename — the
+    worst-case interrupt: the source checkpoint must stay intact and the
+    partial target must be garbage-collected.
+    """
+    inject_io_failure("reshard", times=times)
 
 
 def maybe_fail_io(op):

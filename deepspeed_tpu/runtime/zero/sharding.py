@@ -38,8 +38,13 @@ def zero_partition_spec(shape, base_spec, mesh, axis="data"):
     spec unchanged when nothing qualifies (small params stay replicated —
     the analog of the reference's padding of sub-partitions, without the
     padding).
+
+    ``mesh`` may also be a plain int axis size: the offline resharder
+    (`runtime/elastic/reshard.py`) re-solves specs for a world size that
+    has no live mesh. The decision depends only on the axis size, so the
+    int form is exactly equivalent.
     """
-    axis_size = mesh.shape[axis]
+    axis_size = mesh if isinstance(mesh, int) else mesh.shape[axis]
     if axis_size == 1 or not shape:
         return base_spec
     spec = tuple(base_spec) if base_spec else ()

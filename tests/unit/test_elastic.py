@@ -1,0 +1,327 @@
+"""Unit coverage for the elasticity subsystem (`runtime/elastic/`):
+batch solver, topology policy, PartitionSpec (de)serialization, the
+dataloader's global sample cursor, config-level elastic batch solving,
+and mid-reshard fault injection (source intact, partial target GC'd).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.dataloader import (
+    DeepSpeedDataLoader, RepeatingLoader)
+from deepspeed_tpu.runtime.elastic import (
+    BatchPlan,
+    CheckpointTopologyError,
+    ElasticResumeError,
+    check_topology,
+    reshard_checkpoint,
+    solve_elastic_batch,
+    stream_device_put,
+)
+from deepspeed_tpu.runtime.elastic.topology import (
+    spec_from_json, spec_to_json, strip_axis)
+from deepspeed_tpu.runtime.resilience.checkpoint import (
+    CheckpointIOError, CheckpointManager)
+from tests.unit.simple_model import RandomDataset, base_config
+
+
+# ----------------------------------------------------------------------
+# batch solver
+# ----------------------------------------------------------------------
+
+def test_solver_exact_factoring():
+    for world in (1, 2, 4, 8, 16):
+        plan = solve_elastic_batch(64, world)
+        assert plan.exact and plan.global_batch == 64
+        assert plan.micro_batch * plan.grad_accum * world == 64
+        assert plan.lr_scale == 1.0
+
+
+def test_solver_keeps_preferred_micro():
+    plan = solve_elastic_batch(64, 4, prefer_micro=4)
+    assert (plan.micro_batch, plan.grad_accum) == (4, 4)
+
+
+def test_solver_falls_back_to_preferred_accum():
+    # micro 16 no longer divides per-rank 8; accum 2 does.
+    plan = solve_elastic_batch(32, 4, prefer_micro=16, prefer_accum=2)
+    assert (plan.micro_batch, plan.grad_accum) == (4, 2)
+
+
+def test_solver_max_micro_cap():
+    plan = solve_elastic_batch(64, 1, max_micro=16)
+    assert plan.micro_batch <= 16
+    assert plan.micro_batch * plan.grad_accum == 64
+
+
+def test_solver_inexact_rounds_to_nearest():
+    plan = solve_elastic_batch(10, 4)      # 2.5/rank -> 3
+    assert not plan.exact and plan.global_batch == 12
+    plan = solve_elastic_batch(9, 4)       # 2.25/rank -> 2
+    assert plan.global_batch == 8
+
+
+def test_solver_inexact_lr_scaling_rules():
+    assert solve_elastic_batch(10, 4, lr_scaling="linear").lr_scale == \
+        pytest.approx(1.2)
+    assert solve_elastic_batch(10, 4, lr_scaling="sqrt").lr_scale == \
+        pytest.approx(np.sqrt(1.2))
+    assert solve_elastic_batch(10, 4, lr_scaling="none").lr_scale == 1.0
+
+
+def test_solver_strict_raises_on_inexact():
+    with pytest.raises(ElasticResumeError):
+        solve_elastic_batch(10, 4, strict=True)
+    # exact targets never raise under strict
+    assert solve_elastic_batch(12, 4, strict=True).exact
+
+
+def test_solver_at_least_one_sample_per_rank():
+    plan = solve_elastic_batch(2, 8)
+    assert plan.micro_batch >= 1 and plan.global_batch == 8
+
+
+# ----------------------------------------------------------------------
+# topology policy
+# ----------------------------------------------------------------------
+
+def topo(data=4, pipe=1, model=1, zero=0, offload=False, procs=1):
+    return {"mesh_shape": {"data": data, "pipe": pipe, "model": model,
+                           "seq": 1, "expert": 1},
+            "process_count": procs, "zero_stage": zero, "offload": offload}
+
+
+def test_topology_same_and_unknown():
+    assert check_topology(topo(), topo()).kind == "same"
+    assert check_topology(None, topo()).kind == "unknown"
+    assert check_topology({}, topo()).kind == "unknown"
+
+
+def test_topology_data_change_gates_on_elasticity():
+    with pytest.raises(CheckpointTopologyError) as ei:
+        check_topology(topo(data=4), topo(data=2))
+    assert ei.value.saved["mesh_shape"]["data"] == 4
+    check = check_topology(topo(data=4), topo(data=2), elastic=True)
+    assert check.kind == "elastic" and check.changed["data"] == (4, 2)
+
+
+def test_topology_pipe_restage_always_allowed():
+    # Restage over a fixed device pool changes BOTH pipe and data.
+    check = check_topology(topo(data=4, pipe=2), topo(data=2, pipe=4))
+    assert check.kind == "restage"
+
+
+def test_topology_zero_stage_relayout_always_allowed():
+    assert check_topology(topo(zero=1), topo(zero=0)).kind == "relayout"
+
+
+def test_topology_hard_mismatch_raises_typed():
+    with pytest.raises(ElasticResumeError):
+        check_topology(topo(model=2), topo(model=1), elastic=True)
+    with pytest.raises(ElasticResumeError):
+        check_topology(topo(offload=True), topo(offload=False),
+                       elastic=True)
+    # every mismatch flavor is catchable as the one typed error
+    assert issubclass(ElasticResumeError, CheckpointTopologyError)
+
+
+# ----------------------------------------------------------------------
+# PartitionSpec (de)serialization
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec", [
+    PartitionSpec(),
+    PartitionSpec("data"),
+    PartitionSpec(None, "data"),
+    PartitionSpec(("data", "model"), None),
+])
+def test_spec_json_round_trip(spec):
+    encoded = spec_to_json(spec)
+    json.dumps(encoded)  # must be JSON-serializable as-is
+    assert spec_from_json(encoded) == spec
+
+
+def test_strip_axis():
+    assert strip_axis(PartitionSpec("data")) == PartitionSpec(None)
+    assert strip_axis(PartitionSpec(("data", "model"))) == \
+        PartitionSpec("model")
+    assert strip_axis(PartitionSpec("model")) == PartitionSpec("model")
+
+
+def test_stream_device_put_places_and_structures():
+    tree = {"a": np.ones((4, 2), np.float32), "b": np.zeros(3, np.int32)}
+    out = stream_device_put(tree, jax.devices("cpu")[0])
+    assert isinstance(out["a"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(out["a"]), tree["a"])
+
+
+# ----------------------------------------------------------------------
+# dataloader global sample cursor
+# ----------------------------------------------------------------------
+
+def make_loader(batch_size):
+    return RepeatingLoader(DeepSpeedDataLoader(
+        RandomDataset(64), batch_size=batch_size, seed=0,
+        process_index=0, process_count=1))
+
+
+def test_sample_cursor_counts_rows():
+    loader = make_loader(16)
+    for _ in range(3):
+        next(loader)
+    assert loader.state_dict() == {
+        "epoch": 0, "batches_served": 3, "samples_served": 48}
+
+
+def test_sample_cursor_survives_batch_refactor():
+    src = make_loader(16)
+    for _ in range(3):
+        next(src)
+    # Resume counted in *samples*: a loader with a different batch size
+    # lands at the same global position (48 samples = 6 batches of 8).
+    dst = make_loader(8)
+    dst.load_state_dict(src.state_dict())
+    assert dst.samples_served == 48 and dst.batches_served == 6
+    # The next samples out of the re-factored loader are the leading
+    # rows of the batch the source loader would serve next.
+    np.testing.assert_array_equal(next(dst)["x"], next(src)["x"][:8])
+
+
+def test_sample_cursor_legacy_batch_key_still_loads():
+    dst = make_loader(16)
+    dst.load_state_dict({"epoch": 0, "batches_served": 2})
+    assert dst.batches_served == 2 and dst.samples_served == 32
+
+
+# ----------------------------------------------------------------------
+# config-level elastic batch solve
+# ----------------------------------------------------------------------
+
+def elastic_cfg(**kw):
+    cfg = base_config()
+    cfg["elasticity"] = {"enabled": True, **kw}
+    return cfg
+
+
+def test_config_elastic_refactors_batch_per_world():
+    for world in (1, 2, 4, 8):
+        c = DeepSpeedConfig(elastic_cfg(), world_size=world)
+        assert c.train_batch_size == 16
+        assert (c.train_micro_batch_size_per_gpu *
+                c.gradient_accumulation_steps * world) == 16
+        assert c.elastic_lr_scale == 1.0
+
+
+def test_config_elastic_inexact_sets_lr_scale():
+    c = DeepSpeedConfig(elastic_cfg(target_global_batch=10), world_size=4)
+    assert c.train_batch_size == 12
+    assert c.elastic_lr_scale == pytest.approx(1.2)
+
+
+def test_config_elastic_strict_raises():
+    with pytest.raises(ElasticResumeError):
+        DeepSpeedConfig(elastic_cfg(target_global_batch=10, strict=True),
+                        world_size=4)
+
+
+def test_config_elastic_max_world_size_enforced():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(elastic_cfg(max_world_size=2), world_size=4)
+    DeepSpeedConfig(elastic_cfg(max_world_size=4), world_size=4)
+
+
+def test_config_elastic_bad_lr_scaling_rejected():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(elastic_cfg(lr_scaling="cubic"), world_size=4)
+
+
+# ----------------------------------------------------------------------
+# mid-reshard fault injection
+# ----------------------------------------------------------------------
+
+def seed_checkpoint(tmp_path, world=4):
+    """A small engine-shaped checkpoint written directly through the
+    CheckpointManager (no engine boot needed for resharder tests)."""
+    src = str(tmp_path / "src")
+    state = {"params": {"w": np.arange(16, dtype=np.float32).reshape(4, 4)},
+             "opt_state": {"m": {"w": np.zeros((4, 4), np.float32)},
+                           "v": {"w": np.zeros((4, 4), np.float32)},
+                           "step": np.asarray(3, np.int32)}}
+    meta = {"global_steps": 3, "dp_world_size": world}
+    extra = {"topology": {"mesh_shape": {"data": world, "pipe": 1,
+                                         "model": 1, "seq": 1, "expert": 1},
+                          "process_count": 1, "zero_stage": 1,
+                          "offload": False},
+             "arrays": {"['params']['w']": {
+                 "shape": [4, 4], "dtype": "float32", "spec": ["data"]}}}
+    mgr = CheckpointManager(save_dir=src, process_index=0, process_count=1,
+                            io_retry_base_s=0.001)
+    mgr.save(src, "global_step3", state, meta, extra_manifest=extra)
+    return src, mgr
+
+
+@pytest.mark.faultinject
+def test_reshard_io_failure_source_intact_target_gcd(tmp_path,
+                                                     fault_registry):
+    src, mgr = seed_checkpoint(tmp_path)
+    dst = str(tmp_path / "dst")
+    # times > io_retries so the retry budget is exhausted.
+    fault_registry.inject_reshard_failure(times=10)
+    with pytest.raises(CheckpointIOError):
+        reshard_checkpoint(src, dst, target_world=2,
+                           io_retry_base_s=0.001)
+    # Source untouched and still valid.
+    mgr.validate(os.path.join(src, "global_step3"))
+    # Target holds no partial checkpoint and no tmp leftovers.
+    assert not os.path.isdir(os.path.join(dst, "global_step3"))
+    leftovers = os.listdir(dst) if os.path.isdir(dst) else []
+    assert not [d for d in leftovers if d.startswith(".tmp.")], leftovers
+
+    # Disarmed, the same reshard succeeds into the same target.
+    fault_registry.clear_faults()
+    summary = reshard_checkpoint(src, dst, target_world=2,
+                                 io_retry_base_s=0.001)
+    assert summary["target_world"] == 2
+    man = mgr.validate(summary["dst_path"])
+    assert man["topology"]["mesh_shape"]["data"] == 2
+
+
+@pytest.mark.faultinject
+def test_reshard_transient_fault_retries_through(tmp_path, fault_registry):
+    src, mgr = seed_checkpoint(tmp_path)
+    dst = str(tmp_path / "dst")
+    # One failure < io_retries: the retry loop absorbs it.
+    fault_registry.inject_reshard_failure(times=1)
+    summary = reshard_checkpoint(src, dst, target_world=2,
+                                 io_retry_base_s=0.001)
+    mgr.validate(summary["dst_path"])
+
+
+def test_reshard_retargets_manifest_and_meta(tmp_path):
+    src, mgr = seed_checkpoint(tmp_path)
+    dst = str(tmp_path / "dst")
+    summary = reshard_checkpoint(src, dst, target_world=2)
+    man = mgr.validate(summary["dst_path"])
+    assert man["topology"]["mesh_shape"]["data"] == 2
+    assert man["arrays"]["['params']['w']"]["spec"] == ["data"]
+    state, meta, _ = mgr.load(dst, "global_step3")
+    assert meta["dp_world_size"] == 2
+    assert meta["resharded_from"]["dp_world_size"] == 4
+    np.testing.assert_array_equal(
+        state["params"]["w"],
+        np.arange(16, dtype=np.float32).reshape(4, 4))
+
+
+def test_reshard_drops_axis_when_not_divisible(tmp_path):
+    src, mgr = seed_checkpoint(tmp_path)
+    dst = str(tmp_path / "dst")
+    summary = reshard_checkpoint(src, dst, target_world=3)  # 4 % 3 != 0
+    man = mgr.validate(summary["dst_path"])
+    assert man["arrays"]["['params']['w']"]["spec"] == [None]

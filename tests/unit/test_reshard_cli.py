@@ -1,0 +1,113 @@
+"""`bin/ds_tpu_reshard` offline CLI: subprocess smoke test plus the
+N→M→N round-trip guarantee — resharding a checkpoint down and back
+reproduces bit-identical array bytes and an identical manifest
+addressing, with CRC32 checksums valid at every hop.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.runtime.resilience.checkpoint import CheckpointManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CLI = os.path.join(REPO, "bin", "ds_tpu_reshard")
+
+
+def write_checkpoint(path, world=4, tag="global_step7"):
+    state = {
+        "params": {"kernel": np.random.default_rng(0).normal(
+            size=(8, 8)).astype(np.float32),
+            "bias": np.zeros(8, np.float32)},
+        "opt_state": {"m": {"kernel": np.ones((8, 8), np.float32),
+                            "bias": np.ones(8, np.float32)},
+                      "v": {"kernel": np.full((8, 8), 2.0, np.float32),
+                            "bias": np.full(8, 2.0, np.float32)},
+                      "step": np.asarray(7, np.int32)},
+    }
+    meta = {"global_steps": 7, "dp_world_size": world}
+    extra = {
+        "topology": {"mesh_shape": {"data": world, "pipe": 1, "model": 1,
+                                    "seq": 1, "expert": 1},
+                     "process_count": 1, "zero_stage": 1,
+                     "offload": False},
+        "arrays": {
+            "['opt_state']['m']['kernel']": {
+                "shape": [8, 8], "dtype": "float32", "spec": ["data"]},
+            "['opt_state']['v']['kernel']": {
+                "shape": [8, 8], "dtype": "float32", "spec": ["data"]},
+        },
+    }
+    mgr = CheckpointManager(save_dir=path, process_index=0,
+                            process_count=1, io_retry_base_s=0.001)
+    mgr.save(path, tag, state, meta, extra_manifest=extra)
+    return mgr, tag
+
+
+def run_cli(*args):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, CLI, *args],
+                          capture_output=True, text=True, env=env)
+
+
+def test_cli_smoke_prints_json_summary(tmp_path):
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    write_checkpoint(src)
+    r = run_cli(src, dst, "--data", "2")
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout)
+    assert summary["src_world"] == 4 and summary["target_world"] == 2
+    assert os.path.isdir(summary["dst_path"])
+
+
+def test_cli_requires_target_world(tmp_path):
+    r = run_cli(str(tmp_path / "a"), str(tmp_path / "b"))
+    assert r.returncode != 0
+    assert "--data" in r.stderr
+
+
+def test_cli_fails_cleanly_on_missing_source(tmp_path):
+    r = run_cli(str(tmp_path / "nope"), str(tmp_path / "dst"),
+                "--data", "2")
+    assert r.returncode != 0
+
+
+def test_round_trip_byte_identical_with_valid_crc(tmp_path):
+    src = str(tmp_path / "src")
+    mid = str(tmp_path / "mid")
+    back = str(tmp_path / "back")
+    mgr, tag = write_checkpoint(src, world=4)
+
+    for args in [(src, mid, "--data", "2", "--tag", tag),
+                 (mid, back, "--data", "4")]:
+        r = run_cli(*args)
+        assert r.returncode == 0, r.stderr
+
+    # CRC32 manifests valid at every hop (load verifies checksums).
+    a, meta_a, _ = mgr.load(src, tag)
+    m, _, _ = mgr.load(mid, tag)
+    b, meta_b, _ = mgr.load(back, tag)
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(x, y)
+
+    man_src = mgr.validate(os.path.join(src, tag))
+    man_mid = mgr.validate(os.path.join(mid, tag))
+    man_back = mgr.validate(os.path.join(back, tag))
+    assert man_mid["topology"]["mesh_shape"]["data"] == 2
+    assert man_back["topology"] == man_src["topology"]
+    assert man_back["arrays"] == man_src["arrays"]
+    assert meta_b["dp_world_size"] == 4
+    # Provenance chain records where the bytes came from.
+    assert meta_b["resharded_from"]["dp_world_size"] == 2
