@@ -595,6 +595,25 @@ def run_once_elastic(jax, work_dir):
     return reshard_s, resume_s, summary["state_bytes"], src_world, tgt_world
 
 
+def run_once_audit(jax):
+    """Audit-pass wall time per compiled-step flavor: build each stock
+    toy engine, compile its step, lower + run the full rule catalog
+    (`deepspeed_tpu/analysis/`). Reports seconds per flavor so the audit
+    can be priced into CI/compile budgets."""
+    from deepspeed_tpu.analysis import audit_engine, build_flavor_engine
+    from deepspeed_tpu.analysis.audit import STEP_FLAVORS
+    per_flavor, findings = {}, 0
+    for flavor in STEP_FLAVORS:
+        hb(f"audit: {flavor} step")
+        engine, batch = build_flavor_engine(flavor)
+        engine.train_batch(batch)      # pay the compile outside the timer
+        t0 = time.perf_counter()
+        report = audit_engine(engine, batch)
+        per_flavor[flavor] = time.perf_counter() - t0
+        findings += len(report.findings)
+    return per_flavor, findings
+
+
 def main():
     try:
         jax, devices = init_backend_with_retry()
@@ -836,6 +855,35 @@ def main():
                   "traceback": traceback.format_exc(limit=5)})
         finally:
             shutil.rmtree(work_dir, ignore_errors=True)
+        return
+    if bench_model == "audit":
+        # Analysis PR row: what a full compile-time audit pass costs per
+        # compiled-step flavor (lower + parse + rule catalog; the step
+        # compile itself is excluded). The toy flavors mirror the CLI's.
+        if not on_tpu:
+            emit({"metric": "compiled-step audit pass wall time",
+                  "value": 0, "unit": "s", "vs_baseline": 0.0,
+                  "error": f"requires a TPU; backend is {platform!r}"})
+            return
+        try:
+            per_flavor, findings = run_once_audit(jax)
+            total = sum(per_flavor.values())
+            out = {"metric": "compiled-step audit pass wall time "
+                             "(six stock flavors, full rule catalog)",
+                   "value": round(total, 3), "unit": "s",
+                   # no reference counterpart; the audit is new tooling
+                   "vs_baseline": 0.0,
+                   "findings": findings,
+                   "per_flavor_s": {k: round(v, 3)
+                                    for k, v in per_flavor.items()},
+                   "live": True}
+            save_tpu_result(out)
+            emit(out)
+        except Exception as e:
+            emit({"metric": "compiled-step audit pass wall time",
+                  "value": 0, "unit": "s", "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {e}",
+                  "traceback": traceback.format_exc(limit=5)})
         return
     if bench_model == "bert_large" and not on_tpu:
         emit({"metric": "BERT-Large MLM samples/sec/chip", "value": 0,

@@ -1,0 +1,58 @@
+"""Compiled-program audit subsystem (see docs/analysis.md).
+
+Static analysis over the HLO of compiled train steps: trip-count-aware
+collective accounting, donation/aliasing audits, ZeRO byte budgets,
+dtype hygiene, host-transfer and recompile detection. The parser lives
+in `analysis/hlo.py`, the declarative rule catalog in
+`analysis/rules.py`, and the orchestrator + stock-flavor builders in
+`analysis/audit.py`; ``bin/ds_tpu_audit`` fronts it all from the
+command line.
+"""
+
+from deepspeed_tpu.analysis.hlo import (
+    aliased_param_numbers,
+    collective_bytes,
+    collective_ops,
+    computation_multipliers,
+    host_transfer_ops,
+    input_output_aliases,
+    ring_send_bytes,
+    split_computations,
+    while_loops,
+)
+from deepspeed_tpu.analysis.rules import (
+    RULE_IDS,
+    RULES,
+    SEV_ERROR,
+    SEV_INFO,
+    SEV_WARNING,
+    Finding,
+    StepContext,
+    run_rules,
+)
+from deepspeed_tpu.analysis.audit import (
+    STEP_FLAVORS,
+    AuditError,
+    AuditReport,
+    audit_compiled_step,
+    audit_engine,
+    audit_flavors,
+    audit_hlo,
+    build_flavor_engine,
+    check_recompile,
+    compiled_cache_size,
+    donated_jit,
+)
+
+__all__ = [
+    "aliased_param_numbers", "collective_bytes", "collective_ops",
+    "computation_multipliers", "host_transfer_ops",
+    "input_output_aliases", "ring_send_bytes", "split_computations",
+    "while_loops",
+    "RULE_IDS", "RULES", "SEV_ERROR", "SEV_INFO", "SEV_WARNING",
+    "Finding", "StepContext", "run_rules",
+    "STEP_FLAVORS", "AuditError", "AuditReport", "audit_compiled_step",
+    "audit_engine",
+    "audit_flavors", "audit_hlo", "build_flavor_engine",
+    "check_recompile", "compiled_cache_size", "donated_jit",
+]

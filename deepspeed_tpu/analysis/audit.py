@@ -1,0 +1,440 @@
+"""Audit orchestrator: lower a compiled step, run the rule catalog.
+
+Three entry points, layered:
+
+- ``audit_hlo(hlo_text, **ctx)`` — rules over HLO text you already have.
+- ``audit_engine(engine, batch)`` — lower a live engine's compiled train
+  step (any flavor: dense, ZeRO-1/2/3, offload, quantized, onebit,
+  pipeline), build the :class:`StepContext` from the engine's own
+  config, and run the catalog plus the recompile detector.
+- ``audit_flavors(...)`` — build toy engines for the stock step flavors
+  and audit each; backs ``bin/ds_tpu_audit`` and the zero-findings pins
+  in ``tests/unit/test_audit_rules.py``.
+
+``donated_jit`` is the declaration side of the donation audit: the
+engine's step factories jit through it so the *declared*
+``donate_argnums`` ride on the compiled callable
+(``_ds_donate_argnums``) where the audit can diff them against the
+executable's actual ``input_output_alias`` map.
+"""
+
+import json
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.analysis.hlo import (
+    aliased_param_numbers,
+    collective_bytes,
+    ring_send_bytes,
+    while_loops,
+)
+from deepspeed_tpu.analysis.rules import (
+    SEV_ERROR,
+    Finding,
+    StepContext,
+    run_rules,
+)
+
+# The engine's six stock compiled-step flavors, auditable end-to-end.
+STEP_FLAVORS = ("dense", "zero1", "zero2", "offload", "quantized",
+                "pipeline")
+
+
+class AuditError(RuntimeError):
+    """Raised by the engine when ``analysis.fail_on_findings`` is set and
+    the compile-time audit found error-severity findings."""
+
+    def __init__(self, report):
+        super().__init__(report.to_text())
+        self.report = report
+
+
+def donated_jit(fn, donate_argnums=()):
+    """``jax.jit`` that records its declared donations on the wrapper.
+
+    The stamp (``_ds_donate_argnums``) makes the engine's donation
+    *intent* machine-readable so the donation audit can diff it against
+    the compiled executable's actual input/output aliasing — a plain
+    ``jax.jit`` call site that silently drops ``donate_argnums`` loses
+    the stamp too, which the audit reports as un-donated state."""
+    jitted = jax.jit(fn, donate_argnums=tuple(donate_argnums))
+    try:
+        jitted._ds_donate_argnums = tuple(donate_argnums)
+    except Exception:  # pragma: no cover - jit wrappers accept attrs today
+        pass
+    return jitted
+
+
+@dataclass
+class AuditReport:
+    flavor: str
+    findings: list
+    stats: dict = field(default_factory=dict)
+
+    @property
+    def ok(self):
+        """No error-severity findings (warnings don't fail a run)."""
+        return all(f.severity != SEV_ERROR for f in self.findings)
+
+    def to_dict(self):
+        return {"flavor": self.flavor, "ok": self.ok,
+                "findings": [f.to_dict() for f in self.findings],
+                "stats": self.stats}
+
+    def to_json(self, indent=2):
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
+
+    def to_text(self):
+        lines = [f"[{self.flavor}] "
+                 + ("OK — no findings" if not self.findings else
+                    f"{len(self.findings)} finding(s)")]
+        cb = self.stats.get("collective_bytes") or {}
+        if cb:
+            vols = ", ".join(f"{op} {b / 1e6:.2f}MB"
+                             for op, b in sorted(cb.items())
+                             if op != "total")
+            lines.append(f"  collectives/step (trip-aware): "
+                         f"{vols or 'none'}; total {cb.get('total', 0) / 1e6:.2f}MB")
+        if "donated_expected" in self.stats:
+            lines.append(
+                f"  donation: {self.stats.get('donated_aliased', 0)}"
+                f"/{self.stats['donated_expected']} donated buffers aliased")
+        if "while_loops" in self.stats:
+            n = self.stats["while_loops"]
+            unknown = self.stats.get("unknown_trip_counts", 0)
+            lines.append(f"  loops: {n} while loop(s), "
+                         + ("all trip counts known" if not unknown
+                            else f"{unknown} with UNKNOWN trip count"))
+        if "compile_cache_size" in self.stats:
+            lines.append(f"  recompiles: cache size "
+                         f"{self.stats['compile_cache_size']} after "
+                         f"{self.stats.get('steps_run', 0)} step(s)")
+        for f in self.findings:
+            lines.append(f"  - [{f.severity}] {f.rule}: {f.message}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# lowering and context extraction
+# ---------------------------------------------------------------------------
+
+def _lower_step(fn, args):
+    """Lower+compile a jitted step; map declared donations through arg
+    flattening and unused-arg pruning onto HLO entry-parameter numbers.
+
+    Returns ``(hlo_text, expected_donated_params, donated_param_info)``.
+    ``args_info`` carries per-flat-leaf donation flags; the executable's
+    ``_kept_var_idx`` says which flat leaves survived pruning (HLO
+    parameter i is the i-th kept leaf). Pruned leaves never reach the
+    executable so they are no HBM concern and drop out of the
+    expectation.
+    """
+    lowered = fn.lower(*args)
+    compiled = lowered.compile()
+    hlo_text = compiled.as_text()
+    info_leaves = jax.tree_util.tree_leaves(
+        lowered.args_info, is_leaf=lambda x: hasattr(x, "donated"))
+    kept = getattr(getattr(compiled, "_executable", None),
+                   "_kept_var_idx", None)
+    kept = sorted(kept) if kept is not None else range(len(info_leaves))
+    expected, pinfo = set(), {}
+    for hlo_param, flat_idx in enumerate(kept):
+        if flat_idx >= len(info_leaves):
+            continue
+        leaf = info_leaves[flat_idx]
+        if not getattr(leaf, "donated", False):
+            continue
+        expected.add(hlo_param)
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        dtype = getattr(leaf, "dtype", None)
+        itemsize = np.dtype(dtype).itemsize if dtype is not None else 1
+        nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize \
+            if shape else itemsize
+        pinfo[hlo_param] = {"shape": list(shape), "dtype": str(dtype),
+                            "bytes": nbytes}
+    return hlo_text, expected, pinfo
+
+
+def _engine_flavor(engine):
+    cfg = engine._config
+    if getattr(engine.loss_fn, "direct_value_and_grad", None) is not None:
+        return "pipeline"
+    if engine._offload:
+        return "offload"
+    if cfg.comm_quantization.enabled:
+        return "quantized"
+    if engine.optimizer_name == "onebitadam" or \
+            (engine.optimizer_name or "").lower() == "onebitadam":
+        return "onebit"
+    if engine.sparse_gradients_enabled():
+        return "sparse"
+    stage = engine.zero_optimization_stage()
+    return f"zero{stage}" if stage else "dense"
+
+
+def _engine_fn_args(engine, placed, rng, lr):
+    """The compiled step callable and the exact lowering argument list —
+    mirrors ``train_batch``'s call so ``lower()`` hits the jit cache."""
+    step = engine._compiled_train_step
+    fn = getattr(step, "inner", step)
+    if engine._offload:
+        args = [engine.params, engine.device_state, placed, rng, lr]
+    else:
+        args = [engine.params, engine.opt_state, engine.device_state,
+                placed, rng, lr]
+        if hasattr(step, "inner"):   # error-feedback residual threading
+            args.append(engine._qcomm_residuals)
+    if engine._fault_arg:
+        args.append(jnp.asarray(1.0))
+    return fn, tuple(args)
+
+
+def _engine_context(engine, hlo_text, expected, pinfo):
+    cfg = engine._config
+    dtype = engine.compute_dtype
+    compute = ("bf16" if dtype == jnp.bfloat16 else
+               "f16" if dtype == jnp.float16 else "f32")
+    param_bytes = sum(
+        int(np.prod(l.shape, dtype=np.int64)) * 4
+        for l in jax.tree_util.tree_leaves(engine.params))
+    flavor = _engine_flavor(engine)
+    skip = set()
+    if flavor in ("onebit", "sparse"):
+        # Both replace the gradient exchange with their own compressed /
+        # CSR wire formats — the generic ZeRO/dtype budgets don't model
+        # them (their exact ratios are pinned by dedicated tests).
+        skip |= {"zero_budget", "dtype_hygiene"}
+    step = engine._compiled_train_step
+    declared = getattr(getattr(step, "inner", step),
+                       "_ds_donate_argnums", None)
+    return StepContext(
+        hlo_text=hlo_text,
+        flavor=flavor,
+        n_devices=int(engine.mesh.shape.get("data", 1)),
+        compute_dtype=compute,
+        zero_stage=engine.zero_optimization_stage(),
+        comm_quantized=cfg.comm_quantization.enabled,
+        offload=engine._offload,
+        pipeline=(flavor == "pipeline"),
+        param_bytes=param_bytes,
+        expected_donated_params=expected,
+        donated_param_info=pinfo,
+        declared_donate_argnums=declared,
+        skip_rules=skip)
+
+
+def compiled_cache_size(engine):
+    """Entries in the compiled train step's jit cache (None if the jit
+    wrapper doesn't expose it). 1 after any number of same-shape steps —
+    growth means something recompiles every call."""
+    step = engine._compiled_train_step
+    if step is None:
+        return None
+    fn = getattr(step, "inner", step)
+    cache_size = getattr(fn, "_cache_size", None)
+    try:
+        return int(cache_size()) if callable(cache_size) else None
+    except Exception:
+        return None
+
+
+def check_recompile(engine, baseline=1):
+    """Recompile detector: Finding when the step's jit cache outgrew the
+    expected single entry (shape-unstable batches, dtype drift, a python
+    value captured as a tracer-changing constant, ...)."""
+    n = compiled_cache_size(engine)
+    if n is None or n <= baseline:
+        return []
+    return [Finding(
+        "recompile", SEV_ERROR,
+        f"compiled train step has {n} cache entries (expected "
+        f"{baseline}) — the step recompiled during the run",
+        {"cache_size": n, "expected": baseline})]
+
+
+# ---------------------------------------------------------------------------
+# audit entry points
+# ---------------------------------------------------------------------------
+
+def audit_hlo(hlo_text, rules=None, **ctx_kwargs):
+    """Run the rule catalog over raw HLO text (no engine needed)."""
+    ctx = StepContext(hlo_text=hlo_text, **ctx_kwargs)
+    report = AuditReport(flavor=ctx.flavor, findings=run_rules(ctx, rules))
+    report.stats = _hlo_stats(hlo_text, ctx)
+    return report
+
+
+def _hlo_stats(hlo_text, ctx):
+    loops = while_loops(hlo_text)
+    stats = {
+        "collective_bytes": collective_bytes(hlo_text),
+        "collective_bytes_flat": collective_bytes(hlo_text,
+                                                  trip_aware=False),
+        "ring_send_bytes": ring_send_bytes(hlo_text,
+                                           max(ctx.n_devices, 2)),
+        "while_loops": len(loops),
+        "unknown_trip_counts": sum(1 for l in loops
+                                   if l["trip_count"] is None),
+        "trip_counts": [l["trip_count"] for l in loops],
+        "param_bytes": ctx.param_bytes,
+    }
+    if ctx.expected_donated_params is not None:
+        aliased = aliased_param_numbers(hlo_text)
+        stats["donated_expected"] = len(ctx.expected_donated_params)
+        stats["donated_aliased"] = len(
+            ctx.expected_donated_params & aliased)
+    return stats
+
+
+def audit_compiled_step(engine, placed, rng, lr, rules=None):
+    """In-engine compile-time audit: lower the just-compiled step with
+    the live call's exact avals (so the engine's own step call right
+    after is a jit-cache hit) and run the rule catalog. Backs the
+    opt-in ``analysis`` config block (`runtime/engine.py`)."""
+    fn, args = _engine_fn_args(engine, placed, rng, lr)
+    hlo_text, expected, pinfo = _lower_step(fn, args)
+    ctx = _engine_context(engine, hlo_text, expected, pinfo)
+    report = AuditReport(flavor=ctx.flavor, findings=run_rules(ctx, rules))
+    report.stats = _hlo_stats(hlo_text, ctx)
+    return report
+
+
+def audit_engine(engine, batch, rules=None, steps=0):
+    """Audit a live engine's compiled train step.
+
+    Runs one ``train_batch`` if the step isn't compiled yet (lazy
+    compile), plus ``steps`` more for the recompile detector, then
+    lowers the step with the exact argument avals ``train_batch`` uses
+    (a jit-cache hit, not a second compile) and runs the rule catalog.
+    """
+    t0 = time.perf_counter()
+    steps_run = 0
+    if engine._compiled_train_step is None:
+        engine.train_batch(batch)
+        steps_run += 1
+    for _ in range(steps):
+        engine.train_batch(batch)
+        steps_run += 1
+    placed = engine._shard_batch(batch)
+    rng = jax.random.PRNGKey(0)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    fn, args = _engine_fn_args(engine, placed, rng, lr)
+    hlo_text, expected, pinfo = _lower_step(fn, args)
+    ctx = _engine_context(engine, hlo_text, expected, pinfo)
+    findings = run_rules(ctx, rules)
+    if (rules is None or "recompile" in rules) \
+            and "recompile" not in ctx.skip_rules:
+        findings.extend(check_recompile(engine))
+    report = AuditReport(flavor=ctx.flavor, findings=findings)
+    report.stats = _hlo_stats(hlo_text, ctx)
+    report.stats["compile_cache_size"] = compiled_cache_size(engine)
+    report.stats["steps_run"] = steps_run
+    report.stats["audit_wall_s"] = round(time.perf_counter() - t0, 3)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# stock flavor builders (toy engines; used by the CLI, tests, and bench)
+# ---------------------------------------------------------------------------
+
+_TOY_HIDDEN = 256
+_TOY_LAYERS = 4
+
+
+def _toy_params_and_loss(hidden=_TOY_HIDDEN, nlayers=_TOY_LAYERS):
+    keys = jax.random.split(jax.random.PRNGKey(0), nlayers)
+    params = {
+        f"linear_{i}": {
+            "kernel": jax.random.normal(
+                k, (hidden, hidden), jnp.float32) * 0.02,
+            "bias": jnp.zeros((hidden,), jnp.float32),
+        }
+        for i, k in enumerate(keys)
+    }
+
+    def loss_fn(params, batch, rng=None):
+        x = batch["x"]
+        for i in range(nlayers):
+            layer = params[f"linear_{i}"]
+            x = x @ layer["kernel"] + layer["bias"]
+            if i < nlayers - 1:
+                x = jax.nn.relu(x)
+        return jnp.mean(jnp.square(x - batch["y"]))
+
+    return params, loss_fn
+
+
+def _toy_batch(rows=16, hidden=_TOY_HIDDEN):
+    rng = np.random.default_rng(0)
+    return {"x": rng.normal(size=(rows, hidden)).astype(np.float32),
+            "y": rng.normal(size=(rows, hidden)).astype(np.float32)}
+
+
+def _dense_family_config(flavor):
+    cfg = {"train_batch_size": 16,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "steps_per_print": 10 ** 9}
+    if flavor == "dense":
+        cfg["bf16"] = {"enabled": True}
+    elif flavor in ("zero1", "zero2"):
+        cfg["bf16"] = {"enabled": True}
+        cfg["zero_optimization"] = {"stage": int(flavor[-1])}
+    elif flavor == "offload":
+        cfg["bf16"] = {"enabled": True}
+        cfg["zero_optimization"] = {"stage": 2, "cpu_offload": True}
+    elif flavor == "quantized":
+        # fp32 compute keeps the dense baseline's wire dtype — the
+        # quantized audit checks the int8 replacement, not bf16 hygiene.
+        cfg["comm_quantization"] = {"enabled": True, "chunk_size": 512,
+                                    "bucket_mb": 4}
+    else:
+        raise ValueError(f"unknown dense-family flavor {flavor!r}")
+    return cfg
+
+
+def build_flavor_engine(flavor, config_overrides=None):
+    """``(engine, batch)`` for one stock step flavor, toy-sized so all
+    six compile inside a CPU test budget."""
+    import deepspeed_tpu
+
+    if flavor == "pipeline":
+        from deepspeed_tpu.models.gpt2 import gpt2_tiny
+        from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+        from deepspeed_tpu.parallel.mesh import build_mesh
+        rows, seq = 8, 16
+        mesh = build_mesh({"pipe": 2, "data": 4},
+                          devices=jax.devices()[:8])
+        module = gpt2_pipeline_module(gpt2_tiny(), seq_len=seq)
+        cfg = {"train_batch_size": rows,
+               "gradient_accumulation_steps": 2,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 10 ** 9}
+        cfg.update(config_overrides or {})
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=cfg, model=module, mesh=mesh)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(
+            0, 255, (rows, seq)).astype(np.int32)}
+        return engine, batch
+
+    cfg = _dense_family_config(flavor)
+    cfg.update(config_overrides or {})
+    params, loss_fn = _toy_params_and_loss()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, loss_fn=loss_fn, params=params)
+    return engine, _toy_batch()
+
+
+def audit_flavors(flavors=None, rules=None, steps=0):
+    """Build + audit toy engines for the stock flavors.
+
+    Returns ``{flavor: AuditReport}`` in the order requested."""
+    out = {}
+    for flavor in flavors or STEP_FLAVORS:
+        engine, batch = build_flavor_engine(flavor)
+        out[flavor] = audit_engine(engine, batch, rules=rules, steps=steps)
+    return out

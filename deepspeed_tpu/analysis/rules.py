@@ -1,0 +1,306 @@
+"""Declarative audit rules over a compiled step's HLO.
+
+Each rule is a pure function ``rule(ctx: StepContext) -> [Finding]`` —
+it reads compile-time facts off the HLO text (via `analysis/hlo.py`)
+and diffs them against what the engine configuration *promises*:
+donated buffers actually alias outputs, bf16/fp16 runs don't leak fp32
+onto the wire beyond the fp32-master design allowance, ZeRO stages stay
+inside their per-stage byte budgets, nothing round-trips through the
+host mid-step, and every collective-carrying loop has a statically
+known trip count (else its volume cannot be accounted at all).
+
+Rules return ``[]`` when not applicable (e.g. the dtype-hygiene rule on
+a pure-fp32 run) so the orchestrator (`analysis/audit.py`) can run the
+whole catalog over any step flavor. The allowances are deliberately
+generous versions of the exact pins in ``tests/unit`` — tests pin exact
+architecture numbers; rules catch order-of-magnitude regressions on
+arbitrary user models.
+"""
+
+from dataclasses import dataclass, field, asdict
+
+from deepspeed_tpu.analysis.hlo import (
+    aliased_param_numbers,
+    collective_bytes,
+    collective_ops,
+    host_transfer_ops,
+    while_loops,
+)
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+SEV_INFO = "info"
+_SEV_RANK = {SEV_INFO: 0, SEV_WARNING: 1, SEV_ERROR: 2}
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    message: str
+    details: dict = field(default_factory=dict)
+
+    def to_dict(self):
+        return asdict(self)
+
+
+@dataclass
+class StepContext:
+    """Everything a rule may diff the HLO against.
+
+    ``expected_donated_params`` are HLO entry-parameter numbers (i.e.
+    already mapped from ``donate_argnums`` through arg flattening and
+    unused-arg pruning by the audit orchestrator); ``param_bytes`` is
+    the fp32 master footprint the ZeRO budgets are expressed in.
+    """
+    hlo_text: str
+    flavor: str = "custom"
+    n_devices: int = 1
+    compute_dtype: str = "f32"       # "bf16" | "f16" | "f32"
+    zero_stage: int = 0
+    comm_quantized: bool = False
+    offload: bool = False
+    pipeline: bool = False
+    param_bytes: int = 0
+    expected_donated_params: set = None
+    donated_param_info: dict = field(default_factory=dict)
+    declared_donate_argnums: tuple = None
+    # Donated buffers smaller than this (scalar step counters, loss-scale
+    # flags) are not an HBM concern; XLA may legitimately skip aliasing
+    # them.
+    min_donation_bytes: int = 64
+    skip_rules: set = field(default_factory=set)
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+
+
+def _slack(ctx):
+    """Budget slack: 20% of the fp32 master footprint (floor 4KB).
+
+    Generous on purpose — XLA may legitimately reduce a tied/shared
+    parameter's gradient contributions separately before adding (e.g. a
+    tied embedding pays its grad all-reduce twice), and scalars/norms
+    ride along. The violations these rules exist for (a silent fp32
+    upcast doubling wire bytes, a missing refresh gather, a whole extra
+    param-sized exchange) overshoot 20% by construction."""
+    return max(4096, int(0.2 * ctx.param_bytes))
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def rule_donation(ctx):
+    """Declared ``donate_argnums`` must become real input/output aliases.
+
+    The engine donates params/opt-state/device-state into each step so
+    XLA updates them in place; a donation that fails to alias (or a
+    dropped ``donate_argnums``) silently doubles that buffer's HBM."""
+    if ctx.expected_donated_params is None:
+        return []
+    aliased = aliased_param_numbers(ctx.hlo_text)
+    missing = []
+    for p in sorted(ctx.expected_donated_params):
+        info = ctx.donated_param_info.get(p, {})
+        if info.get("bytes", ctx.min_donation_bytes) < ctx.min_donation_bytes:
+            continue
+        if p not in aliased:
+            missing.append({"param": p, **info})
+    if not missing:
+        return []
+    total = sum(m.get("bytes", 0) for m in missing)
+    return [Finding(
+        "donation", SEV_ERROR,
+        f"{len(missing)} donated input buffer(s) totalling "
+        f"{_fmt_bytes(total)} are not aliased into the step outputs — "
+        f"un-donated params/opt-state live twice in HBM",
+        {"missing_count": len(missing), "missing_bytes": total,
+         "missing": missing[:16],
+         "declared_donate_argnums":
+             list(ctx.declared_donate_argnums or ()) or None,
+         "aliased_params": len(aliased)})]
+
+
+def rule_dtype_hygiene(ctx):
+    """No fp32 on the wire beyond the fp32-master design allowance.
+
+    In a bf16/fp16 run the *gradient* exchange legitimately rides fp32
+    (fp32 master weights; `grad_epilogue` casts grads up before the
+    all-reduce) and ZeRO-1/2's param-refresh all-gather ships the fp32
+    masters — but ZeRO-3 gathers at compute dtype (cast-then-gather,
+    `zero/sharding.py:make_param_caster`), and under comm_quantization
+    the gradient all-reduce must have been replaced by the int8 exchange
+    entirely. Anything above those allowances is a silent upcast paying
+    2x wire bytes."""
+    low_precision = ctx.compute_dtype in ("bf16", "f16")
+    if not low_precision and not ctx.comm_quantized:
+        return []
+    f32 = {}
+    for op in collective_ops(ctx.hlo_text):
+        b = op["dtype_bytes"].get("f32", 0) * op["multiplier"]
+        if b:
+            f32[op["op"]] = f32.get(op["op"], 0) + b
+    m_bytes = ctx.param_bytes
+    slack = _slack(ctx)
+    findings = []
+
+    reduce_f32 = f32.get("all-reduce", 0) + f32.get("reduce-scatter", 0)
+    gather_f32 = f32.get("all-gather", 0)
+    other_f32 = sum(b for op, b in f32.items()
+                    if op not in ("all-reduce", "reduce-scatter",
+                                  "all-gather"))
+
+    if ctx.comm_quantized:
+        # scales ride all-gather; the gradient all-reduce must be gone.
+        if f32.get("all-reduce", 0) > 4096:
+            findings.append(Finding(
+                "dtype_hygiene", SEV_ERROR,
+                f"comm_quantization is on but an fp32 all-reduce of "
+                f"{_fmt_bytes(f32['all-reduce'])} remains — the gradient "
+                f"sync was not replaced by the int8 exchange",
+                {"f32_all_reduce_bytes": f32["all-reduce"]}))
+        if not low_precision:
+            return findings
+
+    allow_reduce = m_bytes + slack
+    if ctx.zero_stage in (1, 2):
+        allow_gather = m_bytes + slack      # fp32 master param refresh
+    else:
+        # stage 0 has no param traffic; stage >= 3 gathers at compute
+        # dtype (cast-then-gather) so fp32 gathers should be noise-sized.
+        allow_gather = slack
+
+    checks = [("all-reduce/reduce-scatter", reduce_f32, allow_reduce),
+              ("all-gather", gather_f32, allow_gather),
+              ("other collectives", other_f32, slack)]
+    for name, got, allowed in checks:
+        if got > allowed:
+            findings.append(Finding(
+                "dtype_hygiene", SEV_ERROR,
+                f"fp32 {name} traffic of {_fmt_bytes(got)} exceeds the "
+                f"{ctx.compute_dtype} run's allowance of "
+                f"{_fmt_bytes(allowed)} — a silent upcast is paying 2x "
+                f"wire bytes",
+                {"family": name, "f32_bytes": got, "allowed_bytes": allowed,
+                 "zero_stage": ctx.zero_stage,
+                 "compute_dtype": ctx.compute_dtype}))
+    return findings
+
+
+def rule_zero_budget(ctx):
+    """Per-stage ZeRO collective byte ceilings (output-bytes basis).
+
+    Generalizes the pinned proofs of ``test_zero_comm_volume.py`` into
+    ceilings any model can be checked against: stage 0 moves one
+    gradient exchange and NO param traffic; stages 1/2 add exactly one
+    param-sized refresh gather; stage 3's total stays within the ZeRO
+    paper's 1.5x-of-DP envelope. M = fp32 param bytes."""
+    if ctx.param_bytes <= 0 or ctx.comm_quantized or ctx.pipeline:
+        return []
+    v = collective_bytes(ctx.hlo_text)
+    m_bytes = ctx.param_bytes
+    slack = _slack(ctx)
+    ar = v.get("all-reduce", 0) + v.get("reduce-scatter", 0)
+    ag = v.get("all-gather", 0)
+    findings = []
+
+    def over(name, got, allowed, extra=None):
+        findings.append(Finding(
+            "zero_budget", SEV_ERROR,
+            f"stage-{ctx.zero_stage} {name} volume {_fmt_bytes(got)} "
+            f"exceeds the budget {_fmt_bytes(allowed)} "
+            f"(M = {_fmt_bytes(m_bytes)})",
+            dict({"got_bytes": got, "allowed_bytes": allowed,
+                  "param_bytes": m_bytes, "volumes": v}, **(extra or {}))))
+
+    if ctx.offload or ctx.zero_stage == 0:
+        if ar > m_bytes + slack:
+            over("gradient exchange (all-reduce)", ar, m_bytes + slack)
+        if ag > slack:
+            over("all-gather", ag, slack,
+                 {"note": "plain DP / offload grad step has no param "
+                          "refresh gather"})
+    elif ctx.zero_stage in (1, 2):
+        if ar > m_bytes + slack:
+            over("gradient exchange (all-reduce)", ar, m_bytes + slack)
+        if ag > m_bytes + slack:
+            over("param refresh (all-gather)", ag, m_bytes + slack)
+        if ar < m_bytes - slack:
+            findings.append(Finding(
+                "zero_budget", SEV_WARNING,
+                f"stage-{ctx.zero_stage} gradient exchange "
+                f"{_fmt_bytes(ar)} is below M-{_fmt_bytes(slack)} — "
+                f"gradient sync may be missing",
+                {"got_bytes": ar, "param_bytes": m_bytes}))
+    else:  # stage >= 3: per-use gathers re-total ~M; paper's 1.5x envelope
+        total = v.get("total", 0)
+        if total > int(2.1 * m_bytes) + 2 * slack:
+            over("total collective", total, int(2.1 * m_bytes) + 2 * slack)
+    return findings
+
+
+def rule_host_transfer(ctx):
+    """No host round-trips inside a compiled step.
+
+    Infeed/outfeed, ``is_host_transfer=true`` sends/recvs, and Python
+    host-callback custom-calls each force a device/host sync mid-step —
+    the async dispatch pipeline stalls every step."""
+    hits = host_transfer_ops(ctx.hlo_text)
+    if not hits:
+        return []
+    kinds = sorted({h["kind"] for h in hits})
+    return [Finding(
+        "host_transfer", SEV_ERROR,
+        f"{len(hits)} host transfer op(s) inside the compiled step "
+        f"({', '.join(kinds)}) — each forces a mid-step host sync",
+        {"count": len(hits), "kinds": kinds,
+         "ops": [h["line"][:200] for h in hits[:8]]})]
+
+
+def rule_trip_count(ctx):
+    """Every collective-carrying loop must have a static trip count.
+
+    Without one the loop's collective volume cannot be accounted (the
+    historical flat-count limitation) and none of the byte-budget rules
+    can be trusted for this program."""
+    unknown = [l for l in while_loops(ctx.hlo_text)
+               if l["has_collectives"] and l["trip_count"] is None]
+    if not unknown:
+        return []
+    return [Finding(
+        "trip_count", SEV_WARNING,
+        f"{len(unknown)} while loop(s) carry collectives but have no "
+        f"statically known trip count — their wire volume is "
+        f"under-accounted (counted once, not per iteration)",
+        {"loops": [{"body": l["body"], "parent": l["parent"]}
+                   for l in unknown]})]
+
+
+# Rule catalog: id -> rule. `recompile` is listed for config validation
+# but runs in the orchestrator (it needs live step objects, not HLO).
+RULES = {
+    "donation": rule_donation,
+    "dtype_hygiene": rule_dtype_hygiene,
+    "zero_budget": rule_zero_budget,
+    "host_transfer": rule_host_transfer,
+    "trip_count": rule_trip_count,
+}
+RULE_IDS = tuple(RULES) + ("recompile",)
+
+
+def run_rules(ctx, rules=None):
+    """Run the catalog (or the named subset) over one step's context."""
+    findings = []
+    for rule_id, rule in RULES.items():
+        if rules is not None and rule_id not in rules:
+            continue
+        if rule_id in ctx.skip_rules:
+            continue
+        findings.extend(rule(ctx))
+    findings.sort(key=lambda f: -_SEV_RANK.get(f.severity, 0))
+    return findings

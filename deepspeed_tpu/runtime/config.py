@@ -396,6 +396,33 @@ class ElasticityConfig:
                 f"strict={self.strict}, lr_scaling={self.lr_scaling!r})")
 
 
+class AnalysisConfig:
+    """Typed view of the ``analysis`` block: opt-in compile-time audits
+    of the compiled train step (`deepspeed_tpu/analysis/`) — donation/
+    aliasing, ZeRO byte budgets, dtype hygiene, host transfers, loop
+    trip counts, plus the per-step recompile detector.
+    See docs/analysis.md."""
+
+    def __init__(self, param_dict):
+        sub = param_dict.get(ANALYSIS, {}) or {}
+        self.enabled = get_scalar_param(sub, ANALYSIS_ENABLED,
+                                        ANALYSIS_ENABLED_DEFAULT)
+        self.fail_on_findings = get_scalar_param(
+            sub, ANALYSIS_FAIL_ON_FINDINGS,
+            ANALYSIS_FAIL_ON_FINDINGS_DEFAULT)
+        self.rules = get_scalar_param(sub, ANALYSIS_RULES,
+                                      ANALYSIS_RULES_DEFAULT)
+        self.check_recompile = get_scalar_param(
+            sub, ANALYSIS_CHECK_RECOMPILE,
+            ANALYSIS_CHECK_RECOMPILE_DEFAULT)
+
+    def __repr__(self):
+        return (f"AnalysisConfig(enabled={self.enabled}, "
+                f"fail_on_findings={self.fail_on_findings}, "
+                f"rules={self.rules!r}, "
+                f"check_recompile={self.check_recompile})")
+
+
 class DeepSpeedConfig:
     def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
         if param_dict is None:
@@ -525,6 +552,7 @@ class DeepSpeedConfig:
         self.comm_quantization = CommQuantizationConfig(param_dict)
         self.resilience = ResilienceConfig(param_dict)
         self.elasticity = ElasticityConfig(param_dict)
+        self.analysis = AnalysisConfig(param_dict)
         # Set by the elastic batch solver when the target batch cannot
         # factor exactly at this world size; the engine multiplies it
         # into the lr schedule.
@@ -667,6 +695,28 @@ class DeepSpeedConfig:
                 "ZeRO-Offload steps the optimizer on host")
         self._check_resilience()
         self._check_elasticity()
+        self._check_analysis()
+
+    def _check_analysis(self):
+        from deepspeed_tpu.analysis.rules import RULE_IDS
+        an = self.analysis
+        for name, v in (("enabled", an.enabled),
+                        ("fail_on_findings", an.fail_on_findings),
+                        ("check_recompile", an.check_recompile)):
+            if not isinstance(v, bool):
+                raise ValueError(
+                    f"analysis: {name} must be a bool, got {v!r}")
+        if an.rules is not None:
+            if not isinstance(an.rules, (list, tuple)) or \
+                    not all(isinstance(r, str) for r in an.rules):
+                raise ValueError(
+                    f"analysis: rules must be a list of rule ids, "
+                    f"got {an.rules!r}")
+            unknown = sorted(set(an.rules) - set(RULE_IDS))
+            if unknown:
+                raise ValueError(
+                    f"analysis: unknown rule id(s) {unknown}; "
+                    f"known: {list(RULE_IDS)}")
 
     def _check_elasticity(self):
         from deepspeed_tpu.runtime.elastic.batch import LR_SCALING_RULES

@@ -277,3 +277,17 @@ ELASTICITY_STRICT = "strict"
 ELASTICITY_STRICT_DEFAULT = False
 ELASTICITY_LR_SCALING = "lr_scaling"
 ELASTICITY_LR_SCALING_DEFAULT = "linear"  # linear | sqrt | none
+
+# Compiled-program analysis (deepspeed_tpu/analysis): opt-in audits of
+# the compiled train step's HLO at compile time — donation/aliasing,
+# ZeRO byte budgets, dtype hygiene, host transfers, trip-count
+# accounting — plus a per-step recompile detector. See docs/analysis.md.
+ANALYSIS = "analysis"
+ANALYSIS_ENABLED = "enabled"
+ANALYSIS_ENABLED_DEFAULT = False
+ANALYSIS_FAIL_ON_FINDINGS = "fail_on_findings"
+ANALYSIS_FAIL_ON_FINDINGS_DEFAULT = False
+ANALYSIS_RULES = "rules"
+ANALYSIS_RULES_DEFAULT = None  # None = the full rule catalog
+ANALYSIS_CHECK_RECOMPILE = "check_recompile"
+ANALYSIS_CHECK_RECOMPILE_DEFAULT = True

@@ -28,6 +28,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from deepspeed_tpu.analysis.audit import (
+    AuditError,
+    AuditReport,
+    audit_compiled_step,
+    check_recompile,
+    donated_jit,
+)
 from deepspeed_tpu.runtime.config import (
     ADAM_OPTIMIZER,
     DeepSpeedConfig,
@@ -487,6 +494,16 @@ class DeepSpeedEngine:
             self.cpu_optimizer.host_adam_retries = rz.host_adam_retries
             self.cpu_optimizer.host_adam_timeout_s = rz.io_timeout_s
 
+        # --- compiled-program analysis (deepspeed_tpu/analysis) ----------
+        an = self._config.analysis
+        self.last_audit_report = None
+        self._recompile_reported = 1
+        if an.enabled:
+            log_dist("analysis: compile-time audit enabled "
+                     f"(rules={list(an.rules) if an.rules else 'all'}, "
+                     f"fail_on_findings={an.fail_on_findings}, "
+                     f"check_recompile={an.check_recompile})", ranks=[0])
+
         if self._config.dump_state:
             self._config.print("DeepSpeedEngine configuration")
 
@@ -930,7 +947,7 @@ class DeepSpeedEngine:
         # Inputs arrive pre-placed (device_put with committed shardings);
         # outputs are pinned by the constrain_tree calls above, so plain jit
         # with donation suffices.
-        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+        return donated_jit(train_step, (0, 1, 2))
 
     def _nan_guard_flags(self):
         """(detect_nonfinite, nan_skip, fault_on) for the step factories:
@@ -1162,9 +1179,9 @@ class DeepSpeedEngine:
                 out = train_step(params, opt_state, dstate, batch, rng,
                                  lr_in, None)
                 return out[0], out[1], out[2], out[3]
-            return jax.jit(train_step_no_res, donate_argnums=(0, 1, 2))
+            return donated_jit(train_step_no_res, (0, 1, 2))
 
-        inner = jax.jit(train_step, donate_argnums=(0, 1, 2, 6))
+        inner = donated_jit(train_step, (0, 1, 2, 6))
         engine = self
 
         def compiled(params, opt_state, dstate, batch, rng, lr_in):
@@ -1272,7 +1289,7 @@ class DeepSpeedEngine:
                 return flat, dstate_out, metrics
             return grads, dstate_out, metrics
 
-        return jax.jit(grad_step, donate_argnums=(1,))
+        return donated_jit(grad_step, (1,))
 
     def _train_batch_offload(self, placed, step_rng, lr_in, fault_extra=()):
         """Host half of the offload step: pull grads, C++ Adam update on
@@ -1692,7 +1709,7 @@ class DeepSpeedEngine:
                       rep, rep),
             out_specs=(param_specs, opt_specs, dstate_specs, metrics_specs),
             check_vma=False)
-        return jax.jit(mapped, donate_argnums=(0, 1, 2))
+        return donated_jit(mapped, (0, 1, 2))
 
     def _make_onebit_train_step(self):
         """Compiled 1-bit Adam step: shard_map over the ``data`` axis so
@@ -1796,7 +1813,7 @@ class DeepSpeedEngine:
                       rep, rep),
             out_specs=(param_specs, opt_specs, dstate_specs, metrics_specs),
             check_vma=False)
-        return jax.jit(mapped, donate_argnums=(0, 1, 2))
+        return donated_jit(mapped, (0, 1, 2))
 
     def _make_pipeline_onebit_train_step(self):
         """Compiled step for the pipeline x 1-bit Adam composition
@@ -2027,7 +2044,7 @@ class DeepSpeedEngine:
                                    nonfinite=nonfinite)
             return new_params, opt_out, dstate_out, metrics
 
-        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+        return donated_jit(train_step, (0, 1, 2))
 
     def _shard_batch(self, batch):
         """Host-side: this process's batch rows → [accum, per_step_global, ...]
@@ -2060,6 +2077,26 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # public training API
     # ------------------------------------------------------------------
+    def _run_compile_audit(self, placed, step_rng, lr_in):
+        """Opt-in compile-time audit (``analysis`` config block): lower
+        the just-compiled step, run the rule catalog over its HLO, and
+        surface findings through logging — or raise
+        :class:`AuditError` when ``fail_on_findings`` is set."""
+        report = audit_compiled_step(self, placed, step_rng, lr_in,
+                                     rules=self._config.analysis.rules)
+        self.last_audit_report = report
+        cb = report.stats.get("collective_bytes", {})
+        log_dist(
+            f"analysis: audited compiled {report.flavor} step — "
+            f"{len(report.findings)} finding(s), "
+            f"{cb.get('total', 0) / 1e6:.2f}MB collectives/step "
+            f"(trip-aware)", ranks=[0])
+        for f in report.findings:
+            log_dist(f"analysis[{f.rule}/{f.severity}]: {f.message}",
+                     ranks=[0])
+        if not report.ok and self._config.analysis.fail_on_findings:
+            raise AuditError(report)
+
     def train_batch(self, batch=None):
         """One full optimizer step over a global batch (the fast path).
 
@@ -2074,7 +2111,8 @@ class DeepSpeedEngine:
             assert self._data_iter is not None, \
                 "no training_data given; pass a batch explicitly"
             batch = next(self._data_iter)
-        if self._compiled_train_step is None:
+        first_compile = self._compiled_train_step is None
+        if first_compile:
             self._compiled_train_step = self._make_offload_grad_step() \
                 if self._offload else self._make_train_step()
         # Fault harness: the compiled step takes a trailing grad multiplier
@@ -2102,6 +2140,11 @@ class DeepSpeedEngine:
         step_rng = jax.random.fold_in(
             jax.random.fold_in(self._rng, 0), self.global_steps)
         lr_in = jnp.asarray(self._current_host_lr(), jnp.float32)
+        if first_compile and self._config.analysis.enabled:
+            # Compile-time audit: lowering here both triggers the one real
+            # compile (the step call below is then a jit-cache hit) and
+            # hands the audit the exact HLO that will execute.
+            self._run_compile_audit(placed, step_rng, lr_in)
         if self._offload:
             metrics = self._train_batch_offload(placed, step_rng, lr_in,
                                                 fault_extra)
@@ -2148,6 +2191,25 @@ class DeepSpeedEngine:
 
         self.micro_steps += self._config.gradient_accumulation_steps
         self.global_steps += 1
+
+        # Recompile detector (analysis block): the step's jit cache must
+        # hold exactly one entry after warm-up; growth means some input
+        # changes aval every call and each step pays a fresh compile.
+        an = self._config.analysis
+        if an.enabled and an.check_recompile and \
+                (an.rules is None or "recompile" in an.rules):
+            findings = check_recompile(self,
+                                       baseline=self._recompile_reported)
+            if findings:
+                self._recompile_reported = findings[0].details["cache_size"]
+                if self.last_audit_report is not None:
+                    self.last_audit_report.findings.extend(findings)
+                for f in findings:
+                    log_dist(f"analysis[{f.rule}/{f.severity}]: "
+                             f"{f.message}", ranks=[0])
+                if an.fail_on_findings:
+                    raise AuditError(AuditReport(flavor="live",
+                                                 findings=findings))
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
         if self.lr_scheduler is not None and \

@@ -56,10 +56,21 @@ def zero_partition_spec(shape, base_spec, mesh, axis="data"):
         if size % axis_size == 0 and size > best_size:
             best_dim, best_size = dim, size
     if best_dim is None:
-        return PartitionSpec(*spec)
+        return _canonical(spec)
     new_spec = list(spec)
     new_spec[best_dim] = axis
-    return PartitionSpec(*new_spec)
+    return _canonical(new_spec)
+
+
+def _canonical(spec):
+    # Strip trailing Nones: jit canonicalizes output shardings the same
+    # way, and an equivalent-but-unequal spec (('data', None) vs
+    # ('data',)) on the placed optimizer state forces a full retrace +
+    # recompile on the second step.
+    spec = list(spec)
+    while spec and spec[-1] is None:
+        spec.pop()
+    return PartitionSpec(*spec)
 
 
 def build_zero_shardings(params, base_specs, mesh, stage, axis="data"):

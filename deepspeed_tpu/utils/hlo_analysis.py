@@ -1,4 +1,4 @@
-"""Static HLO communication accounting.
+"""Static HLO communication accounting (compatibility shim).
 
 The reference *claims* its 1-bit Adam moves ~5x less data
 (`README.md:19,40`, `runtime/fp16/onebit_adam.py:104-228`) but never
@@ -8,156 +8,26 @@ with a static shape, so the bytes a compiled step moves per device can be
 read off the HLO text. ``collective_bytes`` does exactly that — the basis
 of the pinned byte-ratio test in ``tests/unit/test_onebit_adam.py``.
 
-LIMITATION — flat programs only: each HLO op is counted ONCE, but an op
-inside a ``while``/``scan`` body executes trip-count times. The pinned
-proofs (1-bit collective, ZeRO stage volumes at accum=1) are flat in
-their collectives — grad exchange and param refresh sit outside the
-accumulation scan. The executed-1F1B pipeline is NOT: its per-tick
-``ppermute`` lives inside the schedule scan, so this accounting cannot
-express pipeline transfer volume (measured: the static number is one
-tick's buffer regardless of micro-batch count). Pinning that would need
-trip-count-aware parsing.
+The implementation now lives in `deepspeed_tpu/analysis/hlo.py` as the
+parser core of the compiled-program audit subsystem; this module
+re-exports it for existing imports. The historical flat-program
+LIMITATION (each op counted ONCE even inside a ``while``/``scan`` body)
+is fixed there: accounting is trip-count-aware by default — ``while``
+bodies are weighted by their static trip count, so the executed-1F1B
+pipeline's per-tick ``collective-permute`` volume is finally
+expressible. Pass ``trip_aware=False`` for the old flat behavior.
 """
 
-import re
+from deepspeed_tpu.analysis.hlo import (  # noqa: F401
+    _COLLECTIVES,
+    _DTYPE_BYTES,
+    _OP_RE,
+    _RING_SEND_FACTORS,
+    _SHAPE_RE,
+    _element_bytes,
+    _shape_bytes,
+    collective_bytes,
+    ring_send_bytes,
+)
 
-_DTYPE_BYTES = {
-    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
-    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
-}
-
-# e.g. "f32[8,128]{1,0}" or "u8[16]" or "f32[]"
-_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
-
-# `%name = <shape-or-tuple> <op>(` — ops may be async "-start" forms;
-# "-done" forms return the same buffer and are skipped to avoid double
-# counting.
-_COLLECTIVES = ("all-reduce", "all-gather", "all-to-all", "reduce-scatter",
-                "collective-permute", "collective-broadcast")
-# The shape is everything between "=" and the op name — matched
-# non-greedily so nested variadic tuples like ((f32[8], f32[4]),
-# (f32[8], f32[4])) capture whole (a "[^)]*" shape class truncates them
-# at the first close-paren and silently undercounts).
-_OP_RE = re.compile(
-    r"=\s+(?P<shape>.+?)\s+"
-    r"(?P<op>" + "|".join(_COLLECTIVES) + r")(?P<suffix>-start|-done)?\(")
-
-
-def _element_bytes(shape_text, skip_scalars=False):
-    """(dtype, bytes) of each array element appearing in a (tuple) shape.
-    ``skip_scalars`` drops zero-rank elements (async-start context/scratch
-    scalars like ``u32[]``, which are bookkeeping, not payload)."""
-    sizes = []
-    for dtype, dims in _SHAPE_RE.findall(shape_text):
-        if dtype not in _DTYPE_BYTES:
-            continue  # token/opaque types carry no payload
-        if skip_scalars and not dims:
-            continue
-        n = 1
-        if dims:
-            for d in dims.split(","):
-                n *= int(d)
-        sizes.append((dtype, n * _DTYPE_BYTES[dtype]))
-    return sizes
-
-
-def _shape_bytes(shape_text):
-    return sum(b for _, b in _element_bytes(shape_text))
-
-
-def collective_bytes(hlo_text, by_dtype=False):
-    """Sum output bytes of every collective op in an HLO dump.
-
-    Returns ``{op_name: bytes, ..., "total": bytes}``. Async pairs are
-    counted once (the ``-start``, result element only — its output tuple
-    also aliases the operand); sync tuple outputs sum their array
-    elements.
-    For ``all-reduce``/``all-to-all`` the output size equals the input
-    size, so "output bytes" is the per-device payload in both directions
-    of a symmetric exchange — a consistent basis for *ratios* between two
-    programs, which is what the tests pin.
-
-    With ``by_dtype=True`` every per-op entry is a ``{dtype: bytes}``
-    dict instead ("total" stays a plain sum) — how the quantized-allreduce
-    proof separates the int8 gradient exchange from same-op fp32 traffic
-    (scale vectors, the ZeRO-1 param-refresh gather) sharing the program.
-    """
-    counts = {}
-    for m in _OP_RE.finditer(hlo_text):
-        if m.group("suffix") == "-done":
-            continue
-        op = m.group("op")
-        shape = m.group("shape")
-        # async-start outputs are (operands..., results..., scratch...):
-        # count only the result half. Halving the whole tuple's bytes is
-        # exact only for symmetric collectives (all-reduce);
-        # all-gather-start / reduce-scatter-start pair shard-sized
-        # operands with differently-sized results. Scratch entries are
-        # zero-rank scalars (collective-permute-start appends two u32[]
-        # contexts) — drop them FIRST, then the remaining flattened list
-        # is (operands..., results...) with matching counts, variadic
-        # included, and the second half is the results.
-        if m.group("suffix") == "-start" and shape.startswith("("):
-            elems = _element_bytes(shape, skip_scalars=True)
-            elems = elems[len(elems) // 2:]
-        else:
-            elems = _element_bytes(shape)
-        per_op = counts.setdefault(op, {})
-        for dtype, b in elems:
-            per_op[dtype] = per_op.get(dtype, 0) + b
-    if by_dtype:
-        out = {op: dict(d) for op, d in counts.items()}
-        out["total"] = sum(b for d in counts.values() for b in d.values())
-        return out
-    flat = {op: sum(d.values()) for op, d in counts.items()}
-    flat["total"] = sum(flat.values())
-    return flat
-
-
-# Per-device ring-algorithm send bytes as a multiple of the op's OUTPUT
-# bytes (N = ring size): all-reduce sends 2·(N-1)/N · M; all-gather sends
-# (N-1)/N · M (output M, shard M/N moved N-1 times); reduce-scatter
-# output is the M/N shard but each device sends M·(N-1)/N = (N-1)·out;
-# all-to-all and collective-permute move (N-1)/N and 1× their payload.
-_RING_SEND_FACTORS = {
-    "all-reduce": lambda n: 2 * (n - 1) / n,
-    "all-gather": lambda n: (n - 1) / n,
-    "reduce-scatter": lambda n: float(n - 1),
-    "all-to-all": lambda n: (n - 1) / n,
-    "collective-permute": lambda n: 1.0,
-    "collective-broadcast": lambda n: 1.0,
-}
-# Every parsed collective must have a send factor — fail at import, not
-# at some caller's KeyError, when _COLLECTIVES grows.
-assert set(_RING_SEND_FACTORS) == set(_COLLECTIVES)
-
-
-def ring_send_bytes(hlo_text, n_devices, by_dtype=False):
-    """Per-device bytes each device *sends* under ring algorithms.
-
-    Converts ``collective_bytes``'s output-bytes basis into the send-volume
-    basis the ZeRO paper's communication claims use (2M for an all-reduce
-    of M bytes, M for all-gather / reduce-scatter) so ratios between
-    compiled programs can be compared against published numbers directly.
-    Approximation: every collective is assumed to span ``n_devices`` (true
-    for the single-axis ZeRO tests this backs; subgroup collectives would
-    need per-op replica-group parsing).
-
-    ``by_dtype=True`` keys each op's sends by element dtype, mirroring
-    ``collective_bytes(by_dtype=True)``.
-    """
-    out = collective_bytes(hlo_text, by_dtype=True)
-    sends = {}
-    for op, d in out.items():
-        if op == "total":
-            continue
-        factor = _RING_SEND_FACTORS[op](n_devices)
-        sends[op] = {dt: int(b * factor) for dt, b in d.items()}
-    if by_dtype:
-        sends["total"] = sum(b for d in sends.values() for b in d.values())
-        return sends
-    flat = {op: sum(d.values()) for op, d in sends.items()}
-    flat["total"] = sum(flat.values())
-    return flat
+__all__ = ["collective_bytes", "ring_send_bytes"]

@@ -1,0 +1,192 @@
+"""Audit-rule pins (`deepspeed_tpu/analysis/`).
+
+Two halves:
+
+- zero-findings pins: every stock compiled-step flavor must audit clean,
+  with full donation coverage — a future change that drops a
+  ``donate_argnums`` (``donated_expected`` collapses to 0) or breaks
+  aliasing/byte budgets fails here, in tier-1.
+- seeded violations: each rule class is fed a program that *should*
+  fail — a donation that doesn't alias, an fp32 all-reduce in a bf16
+  context, a host callback inside the step, an unaccountable loop, a
+  forced recompile — and must produce its finding.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.analysis import (
+    AuditError,
+    StepContext,
+    audit_engine,
+    audit_hlo,
+    build_flavor_engine,
+    check_recompile,
+    donated_jit,
+)
+from deepspeed_tpu.analysis.audit import STEP_FLAVORS, _lower_step
+from deepspeed_tpu.analysis.rules import (
+    SEV_ERROR,
+    rule_donation,
+    rule_trip_count,
+)
+
+# Donated buffers per flavor: params + opt m/v (+ dstate); a floor, not
+# an exact count, so model tweaks don't churn the pin. The offload grad
+# step donates only device_state (params stay, masters live on host).
+_MIN_DONATED = {"dense": 8, "zero1": 8, "zero2": 8, "offload": 1,
+                "quantized": 8, "pipeline": 8}
+
+
+@pytest.mark.parametrize("flavor", STEP_FLAVORS)
+def test_stock_flavor_audits_clean(flavor):
+    engine, batch = build_flavor_engine(flavor)
+    report = audit_engine(engine, batch)
+    assert report.flavor == flavor
+    assert report.findings == [], report.to_text()
+    # donation pin: the flavor must still DECLARE donations (a dropped
+    # donate_argnums empties the expectation and fails here) and every
+    # declared one must alias.
+    assert report.stats["donated_expected"] >= _MIN_DONATED[flavor]
+    assert report.stats["donated_aliased"] == \
+        report.stats["donated_expected"]
+    assert report.stats["compile_cache_size"] == 1
+    if flavor == "pipeline":
+        # the executed-1F1B loops must be statically accountable — this
+        # is what makes the collective-permute volume pinnable at all.
+        assert report.stats["while_loops"] >= 1
+        assert report.stats["unknown_trip_counts"] == 0
+
+
+def test_pipeline_permute_volume_trip_aware():
+    """The 1F1B collective-permute rides inside while loops; flat
+    counting used to see (at most) one tick of it."""
+    engine, batch = build_flavor_engine("pipeline")
+    report = audit_engine(engine, batch)
+    aware = report.stats["collective_bytes"].get("collective-permute", 0)
+    flat = report.stats["collective_bytes_flat"].get(
+        "collective-permute", 0)
+    assert aware > 0
+    assert aware >= flat
+
+
+# ---------------------------------------------------------------------------
+# seeded violations — each rule must catch its class
+# ---------------------------------------------------------------------------
+
+def _toy_update(params, grads):
+    return jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+
+
+def test_dropped_donation_is_reported():
+    params = {"w": jnp.ones((512, 512)), "b": jnp.ones((512,))}
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+
+    donated = donated_jit(_toy_update, (0,))
+    plain = jax.jit(_toy_update)     # the "regression": donation dropped
+    _, expected, pinfo = _lower_step(donated, (params, grads))
+    assert expected, "donated lowering must produce an expectation"
+    hlo_plain = plain.lower(params, grads).compile().as_text()
+
+    findings = rule_donation(StepContext(
+        hlo_text=hlo_plain, expected_donated_params=expected,
+        donated_param_info=pinfo,
+        declared_donate_argnums=donated._ds_donate_argnums))
+    assert len(findings) == 1 and findings[0].severity == SEV_ERROR
+    assert findings[0].details["missing_count"] == len(expected)
+    assert findings[0].details["missing_bytes"] >= 512 * 512 * 4
+
+
+def test_f32_all_reduce_in_bf16_run_is_reported():
+    from jax.sharding import Mesh, PartitionSpec as P
+    from deepspeed_tpu.utils.compat import shard_map
+    mesh = Mesh(np.array(jax.devices()[:2]), ("d",))
+    mapped = shard_map(lambda x: jax.lax.psum(x, "d"), mesh=mesh,
+                       in_specs=(P("d"),), out_specs=P(None),
+                       check_vma=False)
+    # 64KB fp32 all-reduce, declared compute dtype bf16, no fp32-master
+    # allowance (param_bytes=0): a silent upcast by construction.
+    hlo = jax.jit(mapped).lower(
+        jnp.ones((2, 8192), jnp.float32)).compile().as_text()
+    report = audit_hlo(hlo, rules=["dtype_hygiene"], compute_dtype="bf16")
+    assert any(f.rule == "dtype_hygiene" and f.severity == SEV_ERROR
+               for f in report.findings), report.to_text()
+    # the same program audits clean when the run really is fp32
+    assert audit_hlo(hlo, rules=["dtype_hygiene"],
+                     compute_dtype="f32").findings == []
+
+
+def test_host_callback_in_step_is_reported():
+    def on_host(x):
+        return np.asarray(x) + 1.0
+
+    @jax.jit
+    def step(x):
+        return jax.pure_callback(
+            on_host, jax.ShapeDtypeStruct(x.shape, x.dtype), x) * 2.0
+
+    hlo = step.lower(jnp.ones((16,))).compile().as_text()
+    report = audit_hlo(hlo, rules=["host_transfer"])
+    assert [f.rule for f in report.findings] == ["host_transfer"]
+    assert report.findings[0].severity == SEV_ERROR
+
+
+def test_unaccountable_loop_is_reported():
+    synth = """\
+HloModule synth, entry_computation_layout={(f32[64])->f32[64]}
+
+%body.1 (p: f32[64]) -> f32[64] {
+  %p = f32[64]{0} parameter(0)
+  ROOT %ar = f32[64]{0} all-reduce(f32[64]{0} %p), to_apply=%add
+}
+
+%cond.1 (p: f32[64]) -> pred[] {
+  %p2 = f32[64]{0} parameter(0)
+  ROOT %lt = pred[] custom-call(), custom_call_target="dyn"
+}
+
+ENTRY %main (a: f32[64]) -> f32[64] {
+  %a = f32[64]{0} parameter(0)
+  ROOT %w = f32[64]{0} while(f32[64]{0} %a), condition=%cond.1, \
+body=%body.1
+}
+"""
+    findings = rule_trip_count(StepContext(hlo_text=synth))
+    assert len(findings) == 1 and findings[0].rule == "trip_count"
+
+
+def test_recompile_detected_and_raises_when_configured():
+    engine, batch = build_flavor_engine("dense", config_overrides={
+        "analysis": {"enabled": True, "fail_on_findings": True}})
+    engine.train_batch(batch)
+    # opt-in compile-time audit ran and was clean
+    assert engine.last_audit_report is not None
+    assert engine.last_audit_report.ok
+    assert check_recompile(engine) == []
+
+    # Aval drift: a weak-typed python lr instead of the engine's f32
+    # array adds a second cache entry (donate copies so the engine's
+    # own buffers survive the extra call).
+    placed = engine._shard_batch(batch)
+    copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)  # noqa: E731
+    engine._compiled_train_step(
+        copy(engine.params), copy(engine.opt_state),
+        copy(engine.device_state), placed, jax.random.PRNGKey(0), 0.001)
+    assert [f.rule for f in check_recompile(engine)] == ["recompile"]
+    with pytest.raises(AuditError, match="recompile"):
+        engine.train_batch(batch)
+
+
+def test_unknown_rule_id_rejected_by_config():
+    params = {"w": jnp.ones((8, 8))}
+    with pytest.raises((ValueError, AssertionError),
+                       match="unknown rule id"):
+        deepspeed_tpu.initialize(
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "analysis": {"enabled": True, "rules": ["no_such"]}},
+            loss_fn=lambda p, b, rng=None: jnp.sum(p["w"]),
+            params=params)

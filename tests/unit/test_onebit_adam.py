@@ -276,6 +276,11 @@ def test_engine_onebit_rejects_zero():
 # wire-volume accounting (VERDICT r2 weak #5): the reference claims "up to
 # 5x less communication" (README.md:19,40) but never measures it. Under
 # XLA the volume is static — read it off the compiled HLO and pin it.
+# Accounting is trip-count-aware (`deepspeed_tpu/analysis/hlo.py`):
+# collectives inside a ``while``/``scan`` body are weighted by the
+# loop's static trip count, so these pins hold even if XLA ever rolls
+# the exchange into a loop. (The programs below are loop-free, so the
+# weighting is a no-op here.)
 # ---------------------------------------------------------------------------
 
 def _hlo_for(fn, *args):
@@ -283,7 +288,7 @@ def _hlo_for(fn, *args):
 
 
 def test_compressed_allreduce_moves_4x_fewer_bytes_than_dense():
-    from deepspeed_tpu.utils.hlo_analysis import collective_bytes
+    from deepspeed_tpu.analysis.hlo import collective_bytes
 
     world = 8
     n = 2 ** 20                      # 1M fp32 = 4 MB dense payload
