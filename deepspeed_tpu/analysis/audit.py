@@ -42,6 +42,11 @@ from deepspeed_tpu.analysis.rules import (
 # The engine's six stock compiled-step flavors, auditable end-to-end.
 STEP_FLAVORS = ("dense", "zero1", "zero2", "offload", "quantized",
                 "pipeline")
+# Extra toy flavors the CLI accepts but the default sweep (and the
+# un-slow flavor test matrix) skips — heavier compiles exercising
+# specific subsystems. `pipeline_tp` runs pipe x model x data with
+# tensor_parallel.overlap on, driving the overlap rule end-to-end.
+EXTRA_FLAVORS = ("pipeline_tp",)
 
 
 class AuditError(RuntimeError):
@@ -211,6 +216,7 @@ def _engine_context(engine, hlo_text, expected, pinfo):
     step = engine._compiled_train_step
     declared = getattr(getattr(step, "inner", step),
                        "_ds_donate_argnums", None)
+    tp = getattr(cfg, "tensor_parallel", None)
     return StepContext(
         hlo_text=hlo_text,
         flavor=flavor,
@@ -224,6 +230,8 @@ def _engine_context(engine, hlo_text, expected, pinfo):
         expected_donated_params=expected,
         donated_param_info=pinfo,
         declared_donate_argnums=declared,
+        overlap_enabled=bool(tp is not None and tp.overlap_enabled),
+        overlap_chunks=int(tp.overlap_chunks) if tp is not None else 1,
         skip_rules=skip)
 
 
@@ -419,6 +427,31 @@ def build_flavor_engine(flavor, config_overrides=None):
         rng = np.random.default_rng(0)
         batch = {"input_ids": rng.integers(
             0, 255, (rows, seq)).astype(np.int32)}
+        return engine, batch
+
+    if flavor == "pipeline_tp":
+        # pipe x model x data with tensor_parallel.overlap on: the 1F1B
+        # step whose row-parallel combines lower to chunked ppermute
+        # rings — the flavor the overlap rule audits end-to-end.
+        from deepspeed_tpu.parallel.mesh import build_mesh
+        from deepspeed_tpu.parallel.pipe_tp import tp_pipeline_module
+        rows, seq = 8, 16
+        mesh = build_mesh({"pipe": 2, "model": 2, "data": 2},
+                          devices=jax.devices()[:8])
+        module = tp_pipeline_module(vocab=64, d_model=16, n_head=4,
+                                    seq_len=seq, n_blocks=2, num_stages=2)
+        cfg = {"train_batch_size": rows,
+               "gradient_accumulation_steps": 2,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 10 ** 9,
+               "tensor_parallel": {"overlap": {"enabled": True,
+                                               "chunks": 4}}}
+        cfg.update(config_overrides or {})
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=cfg, model=module, mesh=mesh)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(
+            0, 64, (rows, seq)).astype(np.int32)}
         return engine, batch
 
     cfg = _dense_family_config(flavor)

@@ -55,7 +55,9 @@ def main(argv=None):
                              "(engine built with a toy GPT-2 model)")
     parser.add_argument("--flavors", default=None,
                         help="comma-separated stock flavors to audit "
-                             "(default: all six); ignored with --config")
+                             "(default: all six); extra flavors like "
+                             "pipeline_tp (TP overlap) must be named "
+                             "explicitly; ignored with --config")
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule ids to run "
                              "(default: full catalog)")
@@ -101,8 +103,8 @@ def main(argv=None):
             parser.error(f"unknown rule id(s) {unknown}; "
                          f"known: {list(RULE_IDS)}")
 
-    from deepspeed_tpu.analysis.audit import (STEP_FLAVORS, audit_engine,
-                                              audit_flavors)
+    from deepspeed_tpu.analysis.audit import (EXTRA_FLAVORS, STEP_FLAVORS,
+                                              audit_engine, audit_flavors)
     if args.config:
         engine, batch = _build_config_engine(args.config)
         reports = {"config": audit_engine(engine, batch, rules=rules,
@@ -112,10 +114,11 @@ def main(argv=None):
         if args.flavors:
             flavors = [f.strip() for f in args.flavors.split(",")
                        if f.strip()]
-            unknown = sorted(set(flavors) - set(STEP_FLAVORS))
+            known = STEP_FLAVORS + EXTRA_FLAVORS
+            unknown = sorted(set(flavors) - set(known))
             if unknown:
                 parser.error(f"unknown flavor(s) {unknown}; "
-                             f"known: {list(STEP_FLAVORS)}")
+                             f"known: {list(known)}")
         reports = audit_flavors(flavors, rules=rules, steps=args.steps)
 
     fail_severities = {"error": (SEV_ERROR,),

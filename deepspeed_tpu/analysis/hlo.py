@@ -275,6 +275,21 @@ def collective_ops(hlo_text, trip_aware=True):
     return ops
 
 
+def collective_counts(hlo_text, trip_aware=True):
+    """Execution counts of every collective op: ``{op_name: count}``.
+
+    The per-step number of times each collective RUNS — ops inside a
+    ``while``/``scan`` body count once per trip (``trip_aware=True``,
+    the default). The byte-free companion of :func:`collective_bytes`,
+    for pins on op *mix* (e.g. the overlap rule: a chunked collective
+    matmul must show ``collective-permute`` executions where the
+    monolithic form had ``all-reduce``)."""
+    counts = {}
+    for op in collective_ops(hlo_text, trip_aware=trip_aware):
+        counts[op["op"]] = counts.get(op["op"], 0) + op["multiplier"]
+    return counts
+
+
 def collective_bytes(hlo_text, by_dtype=False, trip_aware=True):
     """Sum output bytes of every collective op in an HLO dump.
 

@@ -22,6 +22,7 @@ from dataclasses import dataclass, field, asdict
 from deepspeed_tpu.analysis.hlo import (
     aliased_param_numbers,
     collective_bytes,
+    collective_counts,
     collective_ops,
     host_transfer_ops,
     while_loops,
@@ -69,6 +70,11 @@ class StepContext:
     # flags) are not an HBM concern; XLA may legitimately skip aliasing
     # them.
     min_donation_bytes: int = 64
+    # tensor_parallel.overlap: when the config promises the latency-
+    # hiding collective matmul, the overlap rule pins that the lowered
+    # step actually carries the chunked ppermute rings.
+    overlap_enabled: bool = False
+    overlap_chunks: int = 1
     skip_rules: set = field(default_factory=set)
 
 
@@ -281,6 +287,51 @@ def rule_trip_count(ctx):
                    for l in unknown]})]
 
 
+def rule_overlap(ctx):
+    """The promised latency-hiding collective matmul must be in the HLO.
+
+    With ``tensor_parallel.overlap`` enabled on a pipeline step, the
+    rewired manual-TP sites replace their monolithic blocking collectives
+    with chunked ``collective-permute`` rings — so the lowered program
+    must execute at least ``chunks - 1`` collective-permutes (the 1F1B
+    stage transfers alone already permute; the ring chunks add more),
+    and the in-loop (per-tick) ``all-reduce`` count must be ZERO: any
+    all-reduce executing more than once per step means a rewired site
+    regressed to the blocking form. (The legitimate grad/loss psums run
+    once, after the tick scan — multiplier 1.)"""
+    if not ctx.overlap_enabled or not ctx.pipeline:
+        return []
+    findings = []
+    counts = collective_counts(ctx.hlo_text)
+    permutes = counts.get("collective-permute", 0)
+    need = max(1, ctx.overlap_chunks - 1)
+    if permutes < need:
+        findings.append(Finding(
+            "overlap", SEV_ERROR,
+            f"tensor_parallel.overlap promises chunked ppermute rings "
+            f"(chunks={ctx.overlap_chunks}) but the step executes only "
+            f"{permutes} collective-permute(s) (< {need}) — the overlap "
+            f"rewiring did not reach the lowered program",
+            {"collective_permutes": permutes, "required": need,
+             "chunks": ctx.overlap_chunks, "counts": counts}))
+    if ctx.overlap_chunks > 1:
+        in_loop = [op for op in collective_ops(ctx.hlo_text)
+                   if op["op"] == "all-reduce" and op["multiplier"] > 1]
+        if in_loop:
+            total = sum(op["multiplier"] for op in in_loop)
+            findings.append(Finding(
+                "overlap", SEV_ERROR,
+                f"{len(in_loop)} all-reduce op(s) execute inside the "
+                f"pipeline tick loop ({total} executions/step) — a "
+                f"rewired row-parallel/combine site regressed to the "
+                f"monolithic blocking collective",
+                {"in_loop_all_reduces": len(in_loop),
+                 "executions_per_step": total,
+                 "computations": sorted({op["computation"] or ""
+                                         for op in in_loop})}))
+    return findings
+
+
 # Rule catalog: id -> rule. `recompile` is listed for config validation
 # but runs in the orchestrator (it needs live step objects, not HLO).
 RULES = {
@@ -289,6 +340,7 @@ RULES = {
     "zero_budget": rule_zero_budget,
     "host_transfer": rule_host_transfer,
     "trip_count": rule_trip_count,
+    "overlap": rule_overlap,
 }
 RULE_IDS = tuple(RULES) + ("recompile",)
 

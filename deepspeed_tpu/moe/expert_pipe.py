@@ -30,7 +30,8 @@ from deepspeed_tpu.moe.layer import MoEConfig, compute_capacity, top_k_gating
 # Canonical home is parallel/collectives.py (shared with the TP pipeline
 # layers); re-exported here for back-compat with round-3 imports.
 from deepspeed_tpu.parallel.collectives import (  # noqa: F401
-    axis_is_manual, psum_combine, psum_grad)
+    axis_is_manual, matmul_psum_overlap, overlap_plan, psum_combine,
+    psum_grad)
 
 
 class ExpertParallelFFNLayer:
@@ -98,14 +99,24 @@ class ExpertParallelFFNLayer:
         # not the round-3 NameError probe.
         bound = axis_is_manual(ax)
         rank = lax.axis_index(ax) if bound else 0
+        plan = overlap_plan("expert_combine") if bound else None
+        if plan is not None and plan.chunks <= 1:
+            plan = None
 
         gate = params["gate"]
         if bound:
             # Partial cotangents from the local-expert paths below must
             # sum across the expert axis; the residual path outside stays
-            # untouched.
-            h = psum_grad(h, ax)
-            gate = psum_grad(gate, ax)
+            # untouched. Under an overlap plan the backward all-reduces
+            # become chunked ppermute rings.
+            if plan is not None:
+                h = psum_grad(h, ax, chunks=plan.chunks,
+                              bidirectional=plan.bidirectional)
+                gate = psum_grad(gate, ax, chunks=plan.chunks,
+                                 bidirectional=plan.bidirectional)
+            else:
+                h = psum_grad(h, ax)
+                gate = psum_grad(gate, ax)
 
         C = compute_capacity(x.shape[1], cfg, deterministic=rng is None)
         logits = h.astype(jnp.float32) @ gate
@@ -127,9 +138,21 @@ class ExpertParallelFFNLayer:
         hh = jax.nn.gelu(jnp.einsum("becm,emh->bech", de, w1) +
                          b1[None, :, None])
         eo = jnp.einsum("bech,ehm->becm", hh, w2) + b2[None, :, None]
-        y = jnp.einsum("bsec,becm->bsm", comb_l, eo)
-        if bound:
-            y = psum_combine(y, ax)              # combine across experts
+        if plan is not None:
+            # The combine einsum is a batched matmul over the flattened
+            # (e_loc, C) contraction; matmul_psum_overlap fuses it with
+            # the cross-expert reduction as chunked ppermute rings
+            # overlapping the per-chunk matmuls.
+            B_, S_, _, C_ = comb_l.shape
+            M_ = eo.shape[-1]
+            y = matmul_psum_overlap(
+                comb_l.reshape(B_, S_, e_loc * C_),
+                eo.reshape(B_, e_loc * C_, M_), ax,
+                chunks=plan.chunks, bidirectional=plan.bidirectional)
+        else:
+            y = jnp.einsum("bsec,becm->bsm", comb_l, eo)
+            if bound:
+                y = psum_combine(y, ax)          # combine across experts
         out = x + y.astype(x.dtype)
         if aux_in is None:
             return out

@@ -20,11 +20,29 @@ Manual mode is an explicit, trace-time property: the pipeline enters
 NameError probe, which misfired whenever a caller happened to bind the
 axis name for unrelated reasons (and depended on an exception message
 contract).
+
+Latency-hiding collective matmul (DeepCompile, arXiv:2504.09983; the
+standard TPU transformation): the monolithic blocking collectives above
+leave the MXU idle for the whole exchange. The overlap primitives below
+(:func:`matmul_psum_overlap`, :func:`matmul_reduce_scatter`,
+:func:`all_gather_matmul_overlap`, :func:`all_to_all_overlap`) split the
+contraction into ``chunks`` pieces and software-pipeline the
+``ppermute`` of chunk *i* against the matmul of chunk *i+1*, so
+communication hides behind dependent compute. Each carries a
+``custom_vjp`` whose backward runs the *transposed* overlapped schedule
+(reduce-scatter ↔ all-gather duality); ``chunks=1`` reproduces the
+monolithic collective bit-for-bit. Layers opt in per call site through
+the trace-time :func:`overlap_scope` / :func:`overlap_plan` pair, which
+mirrors :func:`manual_axes` and is driven by the engine's
+``tensor_parallel.overlap`` config block.
 """
 
 import contextlib
+import dataclasses
+import functools
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 _MANUAL_AXES = ()
@@ -77,10 +95,16 @@ def gather_from_chunk_servers(tree, axis_name):
         lambda v: lax.all_gather(v, axis_name), tree)
 
 
-def psum_grad(x, axis_name):
+def psum_grad(x, axis_name, chunks=1, bidirectional=False):
     """Identity in forward; ``psum`` of the cotangent over ``axis_name`` in
     backward. Makes grads of tensors consumed by axis-partitioned compute
-    exact (each rank's backward contributes only its shard's part)."""
+    exact (each rank's backward contributes only its shard's part).
+
+    ``chunks > 1`` replaces the backward's monolithic all-reduce with the
+    chunked rotate-accumulate ring (:func:`ring_psum`) so the cotangent
+    exchange can overlap adjacent backward matmuls; ``chunks=1`` (the
+    default) keeps ``lax.psum`` — bit-identical to the historical
+    behavior."""
 
     @jax.custom_vjp
     def _f(y):
@@ -90,6 +114,9 @@ def psum_grad(x, axis_name):
         return y, None
 
     def _bwd(_, g):
+        if chunks > 1:
+            return (ring_psum(g, axis_name, chunks=chunks,
+                              bidirectional=bidirectional),)
         return (lax.psum(g, axis_name),)
 
     _f.defvjp(_fwd, _bwd)
@@ -118,3 +145,500 @@ def psum_combine(x, axis_name):
 
     _f.defvjp(_fwd, _bwd)
     return _f(x)
+
+
+# ---------------------------------------------------------------------------
+# overlap plan: trace-time opt-in for the chunked collective matmuls
+# ---------------------------------------------------------------------------
+
+# The rewired call sites. Per-site overrides in the config's
+# ``tensor_parallel.overlap.sites`` are validated against this tuple.
+#   row_parallel    — the Megatron "g" combine in pipe_tp.row_parallel
+#                     (and the grad ring of psum_grad at its column dual)
+#   column_parallel — the Megatron "f" backward grad-psum feeding
+#                     column-parallel compute (pipe_tp.replicated_input)
+#   expert_combine  — the expert-output combine in moe/expert_pipe.py
+#   ulysses         — the all_to_all brackets of Ulysses attention
+#                     (parallel/sequence.py); ``bidirectional`` is a
+#                     no-op here: the decomposed shift-h ppermutes
+#                     already use both ring directions
+OVERLAP_SITES = ("row_parallel", "column_parallel", "expert_combine",
+                 "ulysses")
+
+
+@dataclasses.dataclass(frozen=True)
+class SitePlan:
+    """Resolved overlap parameters for one call site."""
+    chunks: int = 1
+    bidirectional: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlapPlan:
+    """The ``tensor_parallel.overlap`` block, resolved: global chunk
+    count / ring direction plus per-site overrides
+    (``{site: {"enabled", "chunks", "bidirectional"}}``)."""
+    chunks: int = 4
+    bidirectional: bool = False
+    sites: dict = dataclasses.field(default_factory=dict)
+
+    def site(self, name):
+        """SitePlan for ``name``, or None when the site is disabled."""
+        ov = (self.sites or {}).get(name) or {}
+        if ov.get("enabled", True) is False:
+            return None
+        return SitePlan(
+            chunks=int(ov.get("chunks", self.chunks)),
+            bidirectional=bool(ov.get("bidirectional", self.bidirectional)))
+
+
+_OVERLAP_PLAN = None
+
+
+@contextlib.contextmanager
+def overlap_scope(plan):
+    """Declare an :class:`OverlapPlan` active for layers traced within
+    this context (trace-time only, exactly like :func:`manual_axes` —
+    the pipeline wraps its device function with both). ``plan=None``
+    keeps overlap off."""
+    global _OVERLAP_PLAN
+    prev = _OVERLAP_PLAN
+    _OVERLAP_PLAN = plan
+    try:
+        yield
+    finally:
+        _OVERLAP_PLAN = prev
+
+
+def overlap_plan(site):
+    """The active :class:`SitePlan` for ``site``, or None when no
+    :func:`overlap_scope` is active or the site is disabled."""
+    if _OVERLAP_PLAN is None:
+        return None
+    return _OVERLAP_PLAN.site(site)
+
+
+# ---------------------------------------------------------------------------
+# chunk / ring helpers
+# ---------------------------------------------------------------------------
+
+def _chunk_slices(size, chunks):
+    """(start, size) pairs splitting ``size`` into at most ``chunks``
+    contiguous pieces; a non-dividing size spreads the remainder over
+    the leading chunks (e.g. 10/4 → 3,3,2,2)."""
+    k = max(1, min(int(chunks), int(size)))
+    base, rem = divmod(int(size), k)
+    out, start = [], 0
+    for i in range(k):
+        sz = base + (1 if i < rem else 0)
+        out.append((start, sz))
+        start += sz
+    return out
+
+
+def _ring_perm(n, reverse=False):
+    shift = -1 if reverse else 1
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+@jax.custom_vjp
+def _barrier_pair(x, dep):
+    x, _ = lax.optimization_barrier((x, dep))
+    return x
+
+
+def _barrier_pair_fwd(x, dep):
+    return _barrier_pair(x, dep), dep
+
+
+def _barrier_pair_bwd(dep, g):
+    # ``optimization_barrier`` has no AD rule, so plain-AD users of the
+    # chain (all_to_all_overlap, the Ulysses brackets inside a stage
+    # vjp) need this custom transpose. ``x``'s cotangent is identity.
+    # ``dep``'s cotangent is mathematically zero — but emitting it WITH
+    # a dataflow edge on ``g`` re-chains the TRANSPOSED collectives in
+    # reverse order: dep's producer transposes only after g exists, so
+    # the backward permutes serialize exactly like the forward ones
+    # (same global-rendezvous hazard, mirrored).
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, dep)
+    zeros, _ = lax.optimization_barrier((zeros, g))
+    return g, zeros
+
+
+_barrier_pair.defvjp(_barrier_pair_fwd, _barrier_pair_bwd)
+
+
+def barrier_after(x, dep):
+    """Give ``x`` (and everything downstream of it) a dataflow edge on
+    ``dep``: collectives consuming ``x`` cannot issue before ``dep`` is
+    produced. The overlap library chains every ``ppermute`` it emits
+    through this — two *independent* in-flight collectives are exactly
+    what deadlocks the in-process CPU runtime's global rendezvous
+    (different device threads pick them up in different orders; see the
+    auto_axes gate in runtime/pipe/engine.py). Chaining comm→comm costs
+    nothing we need: the latency hiding comes from compute overlapping
+    the chain, not from concurrent rings."""
+    if dep is None:
+        return x
+    return _barrier_pair(x, dep)
+
+
+def _ordered_ppermute(buf, axis_name, perm, dep):
+    out = lax.ppermute(barrier_after(buf, dep), axis_name, perm)
+    return out, out
+
+
+def ring_psum(x, axis_name, chunks=1, bidirectional=False):
+    """Rotate-accumulate ring psum: ``buf = ppermute(buf); acc += buf``
+    for n-1 hops — each hop forwards the value just *received*, so after
+    n-1 hops every rank holds the full sum as n-1 ``collective-permute``s
+    instead of one blocking ``all-reduce``.
+
+    ``chunks > 1`` splits the trailing dim into independent ring
+    pipelines (wavefront-interleaved in trace order: chunk *i*'s hops
+    issue against chunk *i+1*'s slicing/adds, and XLA's scheduler can
+    overlap them with adjacent compute). ``bidirectional`` sends
+    even-indexed chunks one way around the ring and odd-indexed chunks
+    the other, halving the per-direction ring latency."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return x
+    if x.ndim == 0 or chunks <= 1:
+        slices = [None]          # one ring over the whole tensor
+    else:
+        slices = _chunk_slices(x.shape[-1], chunks)
+    k = len(slices)
+    hops = n - 1
+    state = [None] * k
+    dep = None
+    for step in range(k + hops):
+        if step < k:
+            sl = slices[step]
+            piece = x if sl is None else lax.slice_in_dim(
+                x, sl[0], sl[0] + sl[1], axis=-1)
+            state[step] = (piece, piece)
+        for j in range(max(0, step - hops), min(step, k)):
+            acc, buf = state[j]
+            buf, dep = _ordered_ppermute(
+                buf, axis_name,
+                _ring_perm(n, bidirectional and j % 2 == 1), dep)
+            state[j] = (acc + buf, buf)
+    if k == 1:
+        return state[0][0]
+    return jnp.concatenate([acc for acc, _ in state], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# collective matmul: psum / reduce-scatter / all-gather forms
+# ---------------------------------------------------------------------------
+
+def _local_matmul_chunked(a, b, chunks):
+    """The purely local chunked product ``concat_j(a @ b[..., sl_j])``.
+    The overlap primitives' backward is ``jax.vjp`` of this — the
+    transposed schedule stays chunk-granular for free."""
+    slices = _chunk_slices(b.shape[-1], chunks)
+    if len(slices) == 1:
+        return jnp.matmul(a, b)
+    return jnp.concatenate(
+        [jnp.matmul(a, lax.slice_in_dim(b, st, st + sz, axis=-1))
+         for st, sz in slices], axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _matmul_psum_overlap(a, b, axis_name, chunks, bidirectional):
+    n = lax.psum(1, axis_name)
+    if chunks <= 1 or n == 1 or b.shape[-1] < 2:
+        # monolithic path: bit-identical to psum_combine(a @ b)
+        return lax.psum(jnp.matmul(a, b), axis_name)
+    slices = _chunk_slices(b.shape[-1], chunks)
+    k = len(slices)
+    hops = n - 1
+    state = [None] * k
+    dep = None
+    # Wavefront: at trace step s the matmul of chunk s issues alongside
+    # one ring hop for every in-flight chunk s-hops..s-1 — the literal
+    # "ppermute of chunk i against the matmul of chunk i+1" interleave.
+    # The matmuls are free of the permute chain; the permutes order
+    # among themselves (barrier_after) for the CPU rendezvous.
+    for step in range(k + hops):
+        if step < k:
+            st, sz = slices[step]
+            p = jnp.matmul(a, lax.slice_in_dim(b, st, st + sz, axis=-1))
+            state[step] = (p, p)
+        for j in range(max(0, step - hops), min(step, k)):
+            acc, buf = state[j]
+            buf, dep = _ordered_ppermute(
+                buf, axis_name,
+                _ring_perm(n, bidirectional and j % 2 == 1), dep)
+            state[j] = (acc + buf, buf)
+    return jnp.concatenate([acc for acc, _ in state], axis=-1)
+
+
+def _mpo_fwd(a, b, axis_name, chunks, bidirectional):
+    return _matmul_psum_overlap(a, b, axis_name, chunks, bidirectional), \
+        (a, b)
+
+
+def _mpo_bwd(axis_name, chunks, bidirectional, res, g):
+    # The combine's transpose is identity (output consumed replicated —
+    # same convention as psum_combine); the matmul transposes
+    # chunk-for-chunk through the vjp of the local chunked product.
+    a, b = res
+    _, vjp = jax.vjp(
+        lambda aa, bb: _local_matmul_chunked(aa, bb, chunks), a, b)
+    return vjp(g)
+
+
+_matmul_psum_overlap.defvjp(_mpo_fwd, _mpo_bwd)
+
+
+def matmul_psum_overlap(a, b, axis_name, chunks=1, bidirectional=False):
+    """Overlapped ``psum_combine(a @ b)``: the row-parallel contraction
+    with the output dim split into ``chunks`` pieces, each reduced by a
+    rotate-accumulate ``ppermute`` ring that software-pipelines against
+    the next chunk's matmul.
+
+    ``a``: [..., K] local partial input; ``b``: [K, M] or batched
+    [..., K, M] (this rank's shard of the contraction). Output [..., M]
+    replicated across ``axis_name``. Backward: identity transpose of the
+    combine + the chunk-granular transposed matmuls (no collective).
+    ``chunks=1`` is bit-identical to ``psum_combine(a @ b)``."""
+    return _matmul_psum_overlap(a, b, axis_name, int(chunks),
+                                bool(bidirectional))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _matmul_reduce_scatter(a, b, axis_name, chunks, bidirectional):
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return jnp.matmul(a, b)
+    M = b.shape[-1]
+    assert M % n == 0, (
+        f"matmul_reduce_scatter: output dim {M} must divide the axis "
+        f"size {n}")
+    m_loc = M // n
+    if chunks <= 1 or m_loc < 2:
+        y = jnp.matmul(a, b)
+        return lax.psum_scatter(y, axis_name,
+                                scatter_dimension=y.ndim - 1, tiled=True)
+    r = lax.axis_index(axis_name)
+    outs = []
+    dep = None
+    for j, (st, sz) in enumerate(_chunk_slices(m_loc, chunks)):
+        rev = bidirectional and j % 2 == 1
+        shift = -1 if rev else 1
+        perm = _ring_perm(n, rev)
+        # Ring reduce-scatter for this column stripe: the accumulator
+        # destined for rank d visits every rank once and lands at d; at
+        # step t this rank adds its contribution for destination
+        # (r - shift*(1+t)) mod n. The hop of step t's accumulator is
+        # independent of step t's contribution matmul — the pipeline.
+        acc = None
+        for t in range(n):
+            dest = jnp.mod(r - shift * (1 + t), n)
+            contrib = jnp.matmul(a, lax.dynamic_slice_in_dim(
+                b, dest * m_loc + st, sz, axis=-1))
+            if t == 0:
+                acc = contrib
+            else:
+                hop, dep = _ordered_ppermute(acc, axis_name, perm, dep)
+                acc = hop + contrib
+        outs.append(acc)
+    return jnp.concatenate(outs, axis=-1)
+
+
+def _mrs_fwd(a, b, axis_name, chunks, bidirectional):
+    return _matmul_reduce_scatter(a, b, axis_name, chunks, bidirectional), \
+        (a, b)
+
+
+def _mrs_bwd(axis_name, chunks, bidirectional, res, g):
+    # Transposed schedule (reduce-scatter ↔ all-gather duality): ring-
+    # gather the output-shard cotangent, overlapping each arriving shard
+    # with its transposed matmul piece (vjp of a @ b[:, shard_src]).
+    a, b = res
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        _, vjp = jax.vjp(jnp.matmul, a, b)
+        return vjp(g)
+    m_loc = g.shape[-1]
+    r = lax.axis_index(axis_name)
+    if chunks <= 1:
+        ghat = lax.all_gather(g, axis_name, axis=g.ndim - 1, tiled=True)
+        _, vjp = jax.vjp(jnp.matmul, a, b)
+        return vjp(ghat)
+    perm = _ring_perm(n)
+    buf = g
+    dep = None
+    ga = gb = None
+    for h in range(n):
+        if h:
+            buf, dep = _ordered_ppermute(buf, axis_name, perm, dep)
+        src = jnp.mod(r - h, n)      # whose output-shard cotangent arrived
+
+        def piece(aa, bb, src=src):
+            return jnp.matmul(aa, lax.dynamic_slice_in_dim(
+                bb, src * m_loc, m_loc, axis=-1))
+
+        _, vjp = jax.vjp(piece, a, b)
+        dga, dgb = vjp(buf)
+        ga = dga if ga is None else ga + dga
+        gb = dgb if gb is None else gb + dgb
+    return ga, gb
+
+
+_matmul_reduce_scatter.defvjp(_mrs_fwd, _mrs_bwd)
+
+
+def matmul_reduce_scatter(a, b, axis_name, chunks=1, bidirectional=False):
+    """Overlapped ``psum_scatter(a @ b)``: each rank ends with its
+    output-dim shard of the reduced product. ``chunks > 1`` stripes the
+    local shard width and runs an overlapped ring reduce-scatter per
+    stripe (contribution matmuls pipeline against the accumulator hops);
+    ``chunks=1`` is the monolithic matmul + ``lax.psum_scatter``.
+
+    ``a``: [..., K] local input; ``b``: [K, M] / [..., K, M] local shard
+    of the contraction, M divisible by the axis size. Output
+    [..., M/n]. Backward ring-gathers the cotangent with the transposed
+    overlapped schedule (all-gather ↔ reduce-scatter duality)."""
+    return _matmul_reduce_scatter(a, b, axis_name, int(chunks),
+                                  bool(bidirectional))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _all_gather_matmul(x, w, axis_name, chunks, bidirectional):
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return jnp.matmul(x, w)
+    k_loc = x.shape[-1]
+    assert w.shape[-2] == n * k_loc, (
+        f"all_gather_matmul_overlap: w contraction dim {w.shape[-2]} != "
+        f"axis size {n} x local width {k_loc}")
+    if chunks <= 1 or k_loc < 2:
+        xhat = lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+        return jnp.matmul(xhat, w)
+    r = lax.axis_index(axis_name)
+    out = None
+    dep = None
+    for j, (st, sz) in enumerate(_chunk_slices(k_loc, chunks)):
+        rev = bidirectional and j % 2 == 1
+        shift = -1 if rev else 1
+        perm = _ring_perm(n, rev)
+        buf = lax.slice_in_dim(x, st, st + sz, axis=-1)
+        for h in range(n):
+            if h:
+                buf, dep = _ordered_ppermute(buf, axis_name, perm, dep)
+            src = jnp.mod(r - shift * h, n)   # owner of the stripe in buf
+            rows = lax.dynamic_slice_in_dim(w, src * k_loc + st, sz,
+                                            axis=-2)
+            t = jnp.matmul(buf, rows)
+            out = t if out is None else out + t
+    return out
+
+
+def _agm_fwd(x, w, axis_name, chunks, bidirectional):
+    return _all_gather_matmul(x, w, axis_name, chunks, bidirectional), \
+        (x, w)
+
+
+def _agm_bwd(axis_name, chunks, bidirectional, res, g):
+    # Replicated-output convention (the conjugate of psum_combine): the
+    # cotangent g is THE cotangent, taken once. dx is the purely local
+    # s = r piece; dw needs the full gathered x again — re-run the ring,
+    # overlapping each arriving x shard with its transposed dw matmul
+    # (the transposed overlapped schedule).
+    x, w = res
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        _, vjp = jax.vjp(jnp.matmul, x, w)
+        return vjp(g)
+    k_loc = x.shape[-1]
+    r = lax.axis_index(axis_name)
+    if chunks <= 1:
+        xhat = lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+        _, vjp = jax.vjp(jnp.matmul, xhat, w)
+        dxhat, gw = vjp(g)
+        gx = lax.dynamic_slice_in_dim(dxhat, r * k_loc, k_loc, axis=-1)
+        return gx, gw
+    perm = _ring_perm(n)
+    buf = x
+    dep = None
+    gx = gw = None
+    for h in range(n):
+        if h:
+            buf, dep = _ordered_ppermute(buf, axis_name, perm, dep)
+        src = jnp.mod(r - h, n)
+
+        def piece(xx, ww, src=src):
+            return jnp.matmul(xx, lax.dynamic_slice_in_dim(
+                ww, src * k_loc, k_loc, axis=-2))
+
+        _, vjp = jax.vjp(piece, buf, w)
+        dxx, dgw = vjp(g)
+        if h == 0:
+            gx = dxx                  # the s = r term is the local one
+        gw = dgw if gw is None else gw + dgw
+    return gx, gw
+
+
+_all_gather_matmul.defvjp(_agm_fwd, _agm_bwd)
+
+
+def all_gather_matmul_overlap(x, w, axis_name, chunks=1,
+                              bidirectional=False):
+    """Overlapped ``matmul(all_gather(x), w)`` — the conjugate
+    decomposition for gather-then-matmul sites: rotate the contraction
+    shards around the ring, multiplying each arriving stripe against its
+    matching row block of ``w`` while the next stripe is in flight.
+
+    ``x``: [..., K/n] this rank's shard of the contraction dim;
+    ``w``: [K, M] replicated. Output [..., M] replicated. The cotangent
+    is taken once (replicated-output convention, the conjugate of
+    :func:`psum_combine`): dx is the local row block of ``g @ w.T`` and
+    dw re-gathers x through the transposed overlapped ring.
+    ``chunks=1`` is bit-identical to ``all_gather`` + ``matmul``."""
+    return _all_gather_matmul(x, w, axis_name, int(chunks),
+                              bool(bidirectional))
+
+
+def all_to_all_overlap(x, axis_name, split_axis, concat_axis, chunks=1):
+    """Tiled ``all_to_all`` decomposed into n-1 shift-``ppermute``s plus
+    the local slice, so each peer exchange is an independently
+    schedulable transfer XLA can overlap with chunked compute (the
+    Ulysses bracket decomposition). ``chunks <= 1`` keeps the monolithic
+    ``lax.all_to_all``. Pure data movement — a permutation of elements —
+    so plain AD transposes it exactly (no ``custom_vjp`` needed).
+    Shift-h perms already use both ring directions, so there is no
+    separate bidirectional variant."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return x
+    if chunks <= 1:
+        return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+    size = x.shape[split_axis]
+    assert size % n == 0, (
+        f"all_to_all_overlap: split dim {size} not divisible by axis "
+        f"size {n}")
+    piece = size // n
+    keep = x.shape[concat_axis]
+    r = lax.axis_index(axis_name)
+    out_shape = list(x.shape)
+    out_shape[split_axis] = piece
+    out_shape[concat_axis] = keep * n
+    out = jnp.zeros(out_shape, x.dtype)
+    dep = None
+    for h in range(n):
+        dst = jnp.mod(r + h, n)
+        send = lax.dynamic_slice_in_dim(x, dst * piece, piece,
+                                        axis=split_axis)
+        if h == 0:
+            recv = send
+        else:
+            recv, dep = _ordered_ppermute(
+                send, axis_name,
+                [(i, (i + h) % n) for i in range(n)], dep)
+        src = jnp.mod(r - h, n)       # tiled semantics: block src of out
+        out = lax.dynamic_update_slice_in_dim(out, recv, src * keep,
+                                              axis=concat_axis)
+    return out

@@ -36,7 +36,9 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from deepspeed_tpu.parallel.collectives import (axis_is_manual,
-                                                psum_combine, psum_grad)
+                                                matmul_psum_overlap,
+                                                overlap_plan, psum_combine,
+                                                psum_grad)
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
 
@@ -47,8 +49,19 @@ from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 def replicated_input(h, axis_name):
     """Megatron ``f``: identity forward; in manual mode, psum of the
     cotangent over ``axis_name`` in backward. Apply ONCE to each
-    replicated tensor feeding column-parallel compute."""
-    return psum_grad(h, axis_name) if axis_is_manual(axis_name) else h
+    replicated tensor feeding column-parallel compute.
+
+    Under an active ``column_parallel`` overlap plan the backward's
+    monolithic all-reduce becomes the chunked rotate-accumulate
+    ``ppermute`` ring (latency-hiding against the adjacent backward
+    matmuls)."""
+    if not axis_is_manual(axis_name):
+        return h
+    plan = overlap_plan("column_parallel")
+    if plan is not None and plan.chunks > 1:
+        return psum_grad(h, axis_name, chunks=plan.chunks,
+                         bidirectional=plan.bidirectional)
+    return psum_grad(h, axis_name)
 
 
 def column_parallel(h, w, b=None):
@@ -65,10 +78,22 @@ def row_parallel(y, w, b, axis_name):
     """Row-parallel matmul: ``w`` [in_local, M] (shard dim first) →
     partial [B, T, M] summed across ``axis_name`` (Megatron ``g``, one
     psum_combine) in manual mode. ``b`` [M] is replicated and added once,
-    after the combine."""
-    part = y @ w.astype(y.dtype)
+    after the combine.
+
+    Under an active ``row_parallel`` overlap plan the matmul + monolithic
+    all-reduce is replaced by :func:`matmul_psum_overlap`: the output dim
+    is split into chunks whose ``ppermute`` ring reductions software-
+    pipeline against the next chunk's matmul."""
     if axis_is_manual(axis_name):
-        part = psum_combine(part, axis_name)
+        plan = overlap_plan("row_parallel")
+        if plan is not None and plan.chunks > 1:
+            part = matmul_psum_overlap(y, w.astype(y.dtype), axis_name,
+                                       chunks=plan.chunks,
+                                       bidirectional=plan.bidirectional)
+        else:
+            part = psum_combine(y @ w.astype(y.dtype), axis_name)
+    else:
+        part = y @ w.astype(y.dtype)
     if b is not None:
         part = part + b.astype(y.dtype)
     return part

@@ -33,6 +33,9 @@ from deepspeed_tpu.ops.pallas.flash_attention import (
     flash_attention,
     fold_in_seed,
 )
+from deepspeed_tpu.parallel.collectives import (all_to_all_overlap,
+                                                barrier_after,
+                                                overlap_plan)
 
 
 def _check_dropout_args(dropout_rate, dropout_seed):
@@ -143,6 +146,14 @@ def ulysses_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
     unfolded seed would repeat the identical mask pattern across head
     groups (correlated dropout). ``data_axis_name``: as in
     :func:`ring_attention_local`.
+
+    Under an active ``ulysses`` overlap plan the heads are split into
+    chunk groups: group *j+1*'s decomposed ``all_to_all`` (shift
+    ``ppermute``s, :func:`all_to_all_overlap`) can overlap group *j*'s
+    attention. The un-chunked result is identical (the inverse
+    ``all_to_all`` restores the original head order) except under
+    dropout, where each group additionally folds its index into the seed
+    (decorrelated but not bit-matching the monolithic mask).
     """
     _check_dropout_args(dropout_rate, dropout_seed)
     n = jax.lax.psum(1, axis_name)
@@ -156,6 +167,37 @@ def ulysses_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
         if data_axis_name is not None:
             seed = fold_in_seed(seed, jax.lax.axis_index(data_axis_name))
         kwargs = {"dropout_rate": dropout_rate, "dropout_seed": seed}
+
+    plan = overlap_plan("ulysses")
+    c = 0
+    if plan is not None and plan.chunks > 1 and n > 1:
+        # groups must keep the per-group head dim divisible by n:
+        # largest divisor of H/n that is <= plan.chunks
+        h_loc = H // n
+        c = min(plan.chunks, h_loc)
+        while c > 1 and h_loc % c:
+            c -= 1
+    if c > 1:
+        h_grp = H // c
+        outs = []
+        dep = None   # serialize the decomposed exchanges (barrier_after)
+        for j in range(c):
+            gkw = dict(kwargs)
+            if gkw:
+                gkw["dropout_seed"] = fold_in_seed(gkw["dropout_seed"], j)
+            start = j * h_grp
+            grp = []
+            for t in (q, k, v):
+                t = jax.lax.slice_in_dim(t, start, start + h_grp, axis=2)
+                t = all_to_all_overlap(barrier_after(t, dep), axis_name,
+                                       2, 1, chunks=c)
+                dep = t
+                grp.append(t)
+            og = attn_fn(*grp, causal=causal, sm_scale=sm_scale, **gkw)
+            back = all_to_all_overlap(og, axis_name, 1, 2, chunks=c)
+            dep = back
+            outs.append(back)
+        return jnp.concatenate(outs, axis=2)
 
     def scatter_heads(x):   # [B, Tloc, H, D] → [B, T, H/n, D]
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,

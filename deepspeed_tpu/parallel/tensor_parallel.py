@@ -43,14 +43,28 @@ def partition_specs(tree):
     return nn.get_partition_spec(tree)
 
 
+def _axes_of(entry):
+    """Mesh axis names referenced by one PartitionSpec entry — an entry
+    may be None, a single name, or a TUPLE of names (a dim sharded over
+    several axes at once, e.g. ``('data', 'model')``)."""
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
 def logical_constraint(x, *spec, mesh=None):
     """``with_sharding_constraint`` that degrades to a no-op when no mesh
-    axis of that name exists (lets TP modules run unsharded in tests)."""
+    axis of that name exists (lets TP modules run unsharded in tests).
+    Tuple entries constrain one array dim over several mesh axes:
+    ``logical_constraint(x, ('data', 'model'), None, mesh=mesh)``."""
     if mesh is None:
         return x
     names = set(mesh.axis_names)
-    if not all(s is None or s in names for s in spec):
-        return x
+    for entry in spec:
+        if not all(a in names for a in _axes_of(entry)):
+            return x
     return jax.lax.with_sharding_constraint(
         x, jax.sharding.NamedSharding(mesh, P(*spec)))
 
@@ -116,11 +130,19 @@ class RowParallelLinear(nn.Module):
 class TPMultiHeadAttention(nn.Module):
     """Self-attention with heads sharded over ``axis``: column-parallel
     QKV (each shard owns n_head/axis_size heads end-to-end),
-    row-parallel output projection."""
+    row-parallel output projection.
+
+    ``use_flash`` routes the score/softmax/value contraction through
+    :func:`deepspeed_tpu.ops.pallas.flash_attention.flash_attention`
+    (Pallas kernel on TPU, XLA fallback elsewhere) instead of
+    materializing the [B, H, T, T] score matrix — same math, O(T)
+    memory. The head partition is unchanged: the kernel only ever sees
+    this shard's heads."""
 
     n_head: int
     axis: Optional[str] = "model"
     causal: bool = True
+    use_flash: bool = False
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -139,14 +161,21 @@ class TPMultiHeadAttention(nn.Module):
         q, k, v = heads(q), heads(k), heads(v)
         # head dim sharded over the model axis
         q = logical_constraint(q, None, None, self.axis, None, mesh=mesh)
-        scale = 1.0 / jnp.sqrt(jnp.asarray(C // H, jnp.float32))
-        att = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
-        if self.causal:
-            mask = jnp.tril(jnp.ones((T, T), bool))
-            att = jnp.where(mask[None, None], att,
-                            jnp.finfo(jnp.float32).min)
-        att = jax.nn.softmax(att, axis=-1).astype(self.dtype)
-        y = jnp.einsum("bhts,bshd->bthd", att, v).reshape(B, T, C)
+        if self.use_flash:
+            from deepspeed_tpu.ops.pallas.flash_attention import \
+                flash_attention
+            y = flash_attention(q, k, v, causal=self.causal)
+            y = y.astype(self.dtype).reshape(B, T, C)
+        else:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(C // H, jnp.float32))
+            att = jnp.einsum("bthd,bshd->bhts",
+                             q, k).astype(jnp.float32) * scale
+            if self.causal:
+                mask = jnp.tril(jnp.ones((T, T), bool))
+                att = jnp.where(mask[None, None], att,
+                                jnp.finfo(jnp.float32).min)
+            att = jax.nn.softmax(att, axis=-1).astype(self.dtype)
+            y = jnp.einsum("bhts,bshd->bthd", att, v).reshape(B, T, C)
         return RowParallelLinear(
             C, axis=self.axis, dtype=self.dtype,
             param_dtype=self.param_dtype, name="c_proj")(y)

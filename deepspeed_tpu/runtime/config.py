@@ -423,6 +423,44 @@ class AnalysisConfig:
                 f"check_recompile={self.check_recompile})")
 
 
+class TensorParallelConfig:
+    """Typed view of the ``tensor_parallel`` block. Its ``overlap``
+    sub-block opts the manual-mode TP/SP/MoE layers into the
+    latency-hiding collective matmul (chunked ppermute rings pipelined
+    against the adjacent matmuls, ``parallel/collectives.py``).
+    See docs/tensor-parallel.md."""
+
+    def __init__(self, param_dict):
+        sub = param_dict.get(TENSOR_PARALLEL, {}) or {}
+        ov = sub.get(TP_OVERLAP, {}) or {}
+        self.overlap_enabled = get_scalar_param(ov, TP_OVERLAP_ENABLED,
+                                                TP_OVERLAP_ENABLED_DEFAULT)
+        self.overlap_chunks = get_scalar_param(ov, TP_OVERLAP_CHUNKS,
+                                               TP_OVERLAP_CHUNKS_DEFAULT)
+        self.overlap_bidirectional = get_scalar_param(
+            ov, TP_OVERLAP_BIDIRECTIONAL, TP_OVERLAP_BIDIRECTIONAL_DEFAULT)
+        self.overlap_sites = get_scalar_param(ov, TP_OVERLAP_SITES,
+                                              TP_OVERLAP_SITES_DEFAULT)
+
+    def overlap_plan(self):
+        """The resolved :class:`~..parallel.collectives.OverlapPlan`, or
+        None when overlap is disabled (layers keep their monolithic
+        collectives)."""
+        if not self.overlap_enabled:
+            return None
+        from deepspeed_tpu.parallel.collectives import OverlapPlan
+        return OverlapPlan(chunks=int(self.overlap_chunks),
+                           bidirectional=bool(self.overlap_bidirectional),
+                           sites=dict(self.overlap_sites or {}))
+
+    def __repr__(self):
+        return (f"TensorParallelConfig(overlap_enabled="
+                f"{self.overlap_enabled}, "
+                f"overlap_chunks={self.overlap_chunks}, "
+                f"overlap_bidirectional={self.overlap_bidirectional}, "
+                f"overlap_sites={self.overlap_sites!r})")
+
+
 class DeepSpeedConfig:
     def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
         if param_dict is None:
@@ -553,6 +591,7 @@ class DeepSpeedConfig:
         self.resilience = ResilienceConfig(param_dict)
         self.elasticity = ElasticityConfig(param_dict)
         self.analysis = AnalysisConfig(param_dict)
+        self.tensor_parallel = TensorParallelConfig(param_dict)
         # Set by the elastic batch solver when the target batch cannot
         # factor exactly at this world size; the engine multiplies it
         # into the lr schedule.
@@ -696,6 +735,55 @@ class DeepSpeedConfig:
         self._check_resilience()
         self._check_elasticity()
         self._check_analysis()
+        self._check_tensor_parallel()
+
+    def _check_tensor_parallel(self):
+        from deepspeed_tpu.parallel.collectives import OVERLAP_SITES
+        tp = self.tensor_parallel
+
+        def _bool(name, v):
+            if not isinstance(v, bool):
+                raise ValueError(
+                    f"tensor_parallel.overlap: {name} must be a bool, "
+                    f"got {v!r}")
+
+        def _chunks(name, v):
+            if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+                raise ValueError(
+                    f"tensor_parallel.overlap: {name} must be an int >= 1,"
+                    f" got {v!r}")
+
+        _bool("enabled", tp.overlap_enabled)
+        _bool("bidirectional", tp.overlap_bidirectional)
+        _chunks("chunks", tp.overlap_chunks)
+        sites = tp.overlap_sites
+        if sites is None:
+            return
+        if not isinstance(sites, dict):
+            raise ValueError(
+                f"tensor_parallel.overlap: sites must be a dict of "
+                f"per-site overrides, got {sites!r}")
+        for site, ov in sites.items():
+            if site not in OVERLAP_SITES:
+                raise ValueError(
+                    f"tensor_parallel.overlap: unknown site {site!r}; "
+                    f"known: {list(OVERLAP_SITES)}")
+            if not isinstance(ov, dict):
+                raise ValueError(
+                    f"tensor_parallel.overlap: sites[{site!r}] must be a "
+                    f"dict, got {ov!r}")
+            for key, v in ov.items():
+                if key == TP_OVERLAP_ENABLED or \
+                        key == TP_OVERLAP_BIDIRECTIONAL:
+                    _bool(f"sites[{site!r}].{key}", v)
+                elif key == TP_OVERLAP_CHUNKS:
+                    _chunks(f"sites[{site!r}].{key}", v)
+                else:
+                    raise ValueError(
+                        f"tensor_parallel.overlap: unknown key {key!r} in "
+                        f"sites[{site!r}]; allowed: "
+                        f"[{TP_OVERLAP_ENABLED!r}, {TP_OVERLAP_CHUNKS!r}, "
+                        f"{TP_OVERLAP_BIDIRECTIONAL!r}]")
 
     def _check_analysis(self):
         from deepspeed_tpu.analysis.rules import RULE_IDS

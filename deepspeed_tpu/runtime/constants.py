@@ -291,3 +291,21 @@ ANALYSIS_RULES = "rules"
 ANALYSIS_RULES_DEFAULT = None  # None = the full rule catalog
 ANALYSIS_CHECK_RECOMPILE = "check_recompile"
 ANALYSIS_CHECK_RECOMPILE_DEFAULT = True
+
+# Manual tensor-parallel tuning (parallel/pipe_tp.py, parallel/sequence.py,
+# moe/expert_pipe.py). The `overlap` block enables the latency-hiding
+# collective matmul: row-parallel combines / Ulysses all_to_all brackets
+# are split into `chunks` pieces whose ppermute rings software-pipeline
+# against the adjacent matmuls (parallel/collectives.py). Per-site
+# overrides under `sites` keyed by parallel.collectives.OVERLAP_SITES.
+# See docs/tensor-parallel.md.
+TENSOR_PARALLEL = "tensor_parallel"
+TP_OVERLAP = "overlap"
+TP_OVERLAP_ENABLED = "enabled"
+TP_OVERLAP_ENABLED_DEFAULT = False
+TP_OVERLAP_CHUNKS = "chunks"
+TP_OVERLAP_CHUNKS_DEFAULT = 4
+TP_OVERLAP_BIDIRECTIONAL = "bidirectional"
+TP_OVERLAP_BIDIRECTIONAL_DEFAULT = False
+TP_OVERLAP_SITES = "sites"
+TP_OVERLAP_SITES_DEFAULT = None  # None = no per-site overrides

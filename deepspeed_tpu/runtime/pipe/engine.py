@@ -132,10 +132,14 @@ class PipelineEngine(DeepSpeedEngine):
                 "axis. Use make_pipeline_value_and_grad_fn(...) directly "
                 "(works, see tests/unit/test_pipe_auto.py) or the "
                 "manual-collective TP blocks (parallel/pipe_tp.py)")
+        # tensor_parallel.overlap: the latency-hiding collective-matmul
+        # plan for manual-mode TP/SP/MoE layers, threaded to the trace-
+        # time overlap_scope inside the pipeline's shard_map.
+        overlap = probe.tensor_parallel.overlap_plan()
         loss_fn = make_pipeline_loss_fn(
             self.pipeline_parts, mesh, self.micro_batches,
             remat=model.activation_checkpoint_interval > 0,
-            auto_axes=auto_axes)
+            auto_axes=auto_axes, overlap=overlap)
         # Training runs the hand-scheduled 1F1B (loss, grads) program —
         # O(num_stages) activation memory independent of micro_batches;
         # the GPipe loss above remains the eval/forward-only path.
@@ -143,14 +147,15 @@ class PipelineEngine(DeepSpeedEngine):
             jnp.float16 if probe.fp16_enabled else None)
         loss_fn.direct_value_and_grad = make_pipeline_value_and_grad_fn(
             self.pipeline_parts, mesh, self.micro_batches,
-            compute_dtype=compute_dtype, auto_axes=auto_axes)
+            compute_dtype=compute_dtype, auto_axes=auto_axes,
+            overlap=overlap)
         # 1-bit Adam composition: same 1F1B program, but gradients come
         # back data-LOCAL (stacked data axis) for the compressed
         # collective to average (engine._make_pipeline_onebit_train_step).
         loss_fn.direct_value_and_grad_local = make_pipeline_value_and_grad_fn(
             self.pipeline_parts, mesh, self.micro_batches,
             compute_dtype=compute_dtype, data_local=True,
-            auto_axes=auto_axes)
+            auto_axes=auto_axes, overlap=overlap)
 
         super().__init__(args=args,
                          model=model,

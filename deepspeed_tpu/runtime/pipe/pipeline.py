@@ -44,7 +44,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.parallel.collectives import manual_axes
+from deepspeed_tpu.parallel.collectives import (barrier_after, manual_axes,
+                                                overlap_scope)
 from deepspeed_tpu.utils.compat import axis_size, shard_map
 from deepspeed_tpu.runtime.pipe.module import LayerSpec, TiedLayerSpec
 
@@ -202,10 +203,14 @@ def body_param_specs(body_params, auto_axes=()):
     at the jit level and inside via sharding constraints)."""
 
     def spec(path, a):
+        # No trailing Nones after the sharded dim: the compiled step
+        # round-trips these shardings with the trailing Nones normalized
+        # away, and a spec that differs only there is a NEW jit cache key
+        # — every step after the first would recompile once.
         if _is_expert_leaf(path, a):
-            s = P("pipe", None, "expert", *([None] * (a.ndim - 3)))
+            s = P("pipe", None, "expert")
         elif _is_mp_leaf(path, a):
-            s = P("pipe", None, "model", *([None] * (a.ndim - 3)))
+            s = P("pipe", None, "model")
         else:
             s = P("pipe", *([None] * (a.ndim - 1)))
         if auto_axes:
@@ -366,7 +371,8 @@ def sequential_loss_fn(parts: PipelineParts, params, micro_batches, rng=None):
 # the compiled pipeline loss
 # ---------------------------------------------------------------------------
 def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
-                          remat: bool = True, auto_axes=None):
+                          remat: bool = True, auto_axes=None,
+                          overlap=None):
     """Build ``loss_fn(params, batch, rng)`` executing the GPipe rotation.
 
     ``batch``: pytree of ``[rows, ...]`` arrays, rows divisible by
@@ -376,6 +382,8 @@ def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
 
     ``auto_axes``: GSPMD-mode mesh axes (see ``_call_pipeline``);
     defaults to the module's, recorded on ``parts``.
+    ``overlap``: optional ``parallel.collectives.OverlapPlan`` switching
+    manual-mode layers to the latency-hiding chunked collectives.
     """
     auto_axes = _resolve_auto_axes(parts, mesh, auto_axes)
     S = parts.num_stages
@@ -498,7 +506,7 @@ def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
     def pipeline_loss(params, batch, rng):
         return _call_pipeline(mesh, M, device_fn, params, batch, rng,
                               out_specs=lambda body_specs, rest_specs: P(),
-                              auto_axes=auto_axes)
+                              auto_axes=auto_axes, overlap=overlap)
 
     return pipeline_loss
 
@@ -531,7 +539,7 @@ def _resolve_auto_axes(parts, mesh, auto_axes):
 
 
 def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
-                   out_specs=None, auto_axes=()):
+                   out_specs=None, auto_axes=(), overlap=None):
     """Shared shard_map wrapper for the pipeline programs: microbatch the
     batch rows, split off the replicated param groups, build the in/out
     specs, and invoke ``device_fn`` over the mesh. ``out_specs`` is a
@@ -572,8 +580,11 @@ def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
         # layers with explicit collectives (TP blocks, expert-parallel
         # FFN) switch them on via parallel.collectives.axis_is_manual;
         # auto axes stay GSPMD-driven (axis_is_manual False → manual
-        # collectives no-op, constraints rule).
-        with manual_axes(manual):
+        # collectives no-op, constraints rule). ``overlap`` (an
+        # OverlapPlan or None) rides the same trace-time channel: layers
+        # consult parallel.collectives.overlap_plan to swap monolithic
+        # collectives for the chunked latency-hiding form.
+        with manual_axes(manual), overlap_scope(overlap):
             return device_fn(*args, **kwargs)
 
     fn = shard_map(
@@ -587,12 +598,28 @@ def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
     return fn(params["body"], rest, batch_m, key, *extra)
 
 
+def _tree_ppermute(tree, perm):
+    """Stage-transfer ppermute over a pytree with the leaf permutes chained
+    (``barrier_after``): two *independent* in-flight collective-permutes
+    split the in-process CPU runtime's global rendezvous (half the devices
+    arrive at one op_id, half at the other) and deadlock. Chaining costs
+    nothing — per-tick latency is bounded by the largest leaf anyway."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    dep, out = None, []
+    for leaf in leaves:
+        leaf = lax.ppermute(barrier_after(leaf, dep), "pipe", perm)
+        dep = leaf
+        out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 # ---------------------------------------------------------------------------
 # executed 1F1B: interleaved forward/backward in ONE compiled scan
 # ---------------------------------------------------------------------------
 def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
                                     num_micro: int, compute_dtype=None,
-                                    data_local=False, auto_axes=None):
+                                    data_local=False, auto_axes=None,
+                                    overlap=None):
     """Build ``vag(params, batch, rng, scale) -> (loss, grads)`` running a
     hand-scheduled 1F1B pipeline (the reference's ``TrainSchedule``
     interleave, `runtime/pipe/schedule.py:189-241`, executed rather than
@@ -705,28 +732,21 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
                 return num.astype(f32), den.astype(f32)
             return res.astype(f32), jnp.asarray(1.0, f32)
 
-        def last_vjp(x_in, m):
-            """Full vjp through stage_fwd → epilogue → loss at the last
-            stage; seeds the backward wave."""
-            def f(b, r, xx):
-                y = stage_fwd(b, xx, mb_rng(m, 1))
-                out = parts.epilogue_apply(cast(r), y, mb_rng(m, 2))
+        def loss_head_pair(y_b, m):
+            """vjp of epilogue → loss at the stage OUTPUT (last stage
+            only). Contains no model-axis collectives, so it may sit
+            inside the stage-divergent cond; the stage vjp itself (which
+            does) runs uniformly in the tick body. Seeded with the loss
+            scale so fp16 cotangents ride above the underflow floor
+            through the whole backward (the reference scales the loss
+            before backprop; scaling only at the end in fp32 would make
+            dynamic loss scaling a numeric no-op)."""
+            def h(r, yy):
+                out = parts.epilogue_apply(cast(r), yy, mb_rng(m, 2))
                 return as_pair(parts.loss_fn(out, micro_at(m)))
-            (num, den), vjp = jax.vjp(f, body_local, rest, x_in)
-            # Seed with the loss scale so fp16 cotangents ride above the
-            # underflow floor through the whole backward (the reference
-            # scales the loss before backprop; scaling only at the end in
-            # fp32 would make dynamic loss scaling a numeric no-op).
-            gb, gr, gx = vjp((scale.astype(f32), jnp.asarray(0.0, f32)))
-            return gb, gr, gx, num, den
-
-        def mid_vjp(x_in, g, m):
-            def f(b, xx):
-                return stage_fwd(b, xx, mb_rng(m, 1))
-            _, vjp = jax.vjp(f, body_local, x_in)
-            gb, gx = vjp(g)
-            return (gb, zeros_rest_g, gx, jnp.asarray(0.0, f32),
-                    jnp.asarray(0.0, f32))
+            (num, den), hvjp = jax.vjp(h, rest, y_b)
+            gr, gy = hvjp((scale.astype(f32), jnp.asarray(0.0, f32)))
+            return gy, gr, num, den
 
         def prologue_vjp(gx, m):
             _, vjp = jax.vjp(lambda r: prologue(r, m), rest)
@@ -753,14 +773,17 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
                     lambda b, xi: lax.dynamic_update_index_in_dim(
                         b, xi, slot_f, 0), buf, x_in),
                 lambda: buf)
-            # only stages that send forward need y this half (the last
-            # stage consumes its x_in in the backward half, same tick)
-            y = lax.cond(valid_f & (s < S - 1),
-                         lambda: stage_fwd(body_local, x_in, mb_rng(mf_c, 1)),
-                         lambda: zeros_act)
-            x_next = jax.tree_util.tree_map(
-                lambda a: lax.ppermute(
-                    a, "pipe", [(i, (i + 1) % S) for i in range(S)]), y)
+            # stage_fwd runs UNCONDITIONALLY: TP layers put model-axis
+            # collectives inside it, and a collective inside stage-
+            # divergent control flow is invalid SPMD — the in-process CPU
+            # runtime's global collective-permute rendezvous deadlocks
+            # when one stage enters the branch and another doesn't (the
+            # seed got away with `s < S - 1` here only because all-reduce
+            # rendezvous is per replica group). Bubble ticks and the last
+            # stage compute on zeros and the result is discarded.
+            y = stage_fwd(body_local, x_in, mb_rng(mf_c, 1))
+            x_next = _tree_ppermute(
+                y, [(i, (i + 1) % S) for i in range(S)])
 
             # ---- backward half: microbatch mb = t - (2S-2-s) ---------
             mb_ = t - (2 * S - 2 - s)
@@ -769,31 +792,50 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
             x_b = jax.tree_util.tree_map(
                 lambda b: lax.dynamic_index_in_dim(b, mb_c % K, 0,
                                                    keepdims=False), buf)
+            # The two halves are data-independent, so the backward half's
+            # collectives (TP chunk rings, g_next) would race x_next on
+            # the in-process CPU runtime's global rendezvous. Order the
+            # whole backward half after the forward stage transfer by
+            # barriering its inputs — the tick's collectives then form
+            # one chain: fwd TP → x_next → bwd TP → g_next.
+            (x_b, g_in), _ = lax.optimization_barrier(
+                ((x_b, g_recv), x_next))
 
-            def do_bwd():
-                gb, gr, gx, num, den = lax.cond(
-                    s == S - 1,
-                    lambda: last_vjp(x_b, mb_c),
-                    lambda: mid_vjp(x_b, g_recv, mb_c))
-                gr = lax.cond(
-                    s == 0,
-                    lambda: jax.tree_util.tree_map(
-                        jnp.add, gr, prologue_vjp(gx, mb_c)),
-                    lambda: gr)
-                return gb, gr, gx, num, den
+            # The stage vjp — the piece holding model-axis collectives —
+            # runs UNCONDITIONALLY and uniformly across stages (same SPMD
+            # constraint as stage_fwd above; the seed's per-stage
+            # last_vjp/mid_vjp branches compile to DIFFERENT permute
+            # channels, splitting the rendezvous). Only the collective-
+            # free cotangent seed diverges: the last stage seeds from
+            # epilogue∘loss at its own output, the rest from the received
+            # cotangent. Invalid (bubble) ticks run on buffer garbage and
+            # are masked out of the accumulators below.
+            y_b, stage_vjp = jax.vjp(
+                lambda b, xx: stage_fwd(b, xx, mb_rng(mb_c, 1)),
+                body_local, x_b)
+            gy, gr, num, den = lax.cond(
+                s == S - 1,
+                lambda: loss_head_pair(y_b, mb_c),
+                lambda: (g_in, zeros_rest_g, jnp.asarray(0.0, f32),
+                         jnp.asarray(0.0, f32)))
+            gb, gx = stage_vjp(gy)
+            gr = lax.cond(
+                s == 0,
+                lambda: jax.tree_util.tree_map(
+                    jnp.add, gr, prologue_vjp(gx, mb_c)),
+                lambda: gr)
 
-            def no_bwd():
-                return (zeros_body_g, zeros_rest_g, zeros_act,
-                        jnp.asarray(0.0, f32), jnp.asarray(0.0, f32))
+            def mask(tree):
+                return jax.tree_util.tree_map(
+                    lambda a: jnp.where(valid_b, a, jnp.zeros_like(a)),
+                    tree)
 
-            gb, gr, gx, num, den = lax.cond(valid_b, do_bwd, no_bwd)
-            gb_acc = jax.tree_util.tree_map(jnp.add, gb_acc, gb)
-            gr_acc = jax.tree_util.tree_map(jnp.add, gr_acc, gr)
-            num_acc = num_acc + num
-            den_acc = den_acc + den
-            g_next = jax.tree_util.tree_map(
-                lambda a: lax.ppermute(
-                    a, "pipe", [(i, (i - 1) % S) for i in range(S)]), gx)
+            gb_acc = jax.tree_util.tree_map(jnp.add, gb_acc, mask(gb))
+            gr_acc = jax.tree_util.tree_map(jnp.add, gr_acc, mask(gr))
+            num_acc = num_acc + jnp.where(valid_b, num, 0.0)
+            den_acc = den_acc + jnp.where(valid_b, den, 0.0)
+            g_next = _tree_ppermute(
+                mask(gx), [(i, (i - 1) % S) for i in range(S)])
             return (x_next, g_next, buf, gb_acc, gr_acc, num_acc,
                     den_acc), None
 
@@ -889,7 +931,7 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
         loss, gb, gr = _call_pipeline(
             mesh, M, device_fn, params, batch, rng,
             extra=(jnp.asarray(scale, jnp.float32),),
-            out_specs=_out_specs, auto_axes=auto_axes)
+            out_specs=_out_specs, auto_axes=auto_axes, overlap=overlap)
         grads = {"prologue": gr["prologue"], "body": gb,
                  "epilogue": gr["epilogue"], "tied": gr["tied"]}
         return loss, grads

@@ -40,7 +40,7 @@ def test_list_rules():
     proc = run_cli("--list-rules")
     out = proc.stdout
     for rule_id in ("donation", "dtype_hygiene", "zero_budget",
-                    "host_transfer", "trip_count", "recompile"):
+                    "host_transfer", "trip_count", "overlap", "recompile"):
         assert rule_id in out, out
 
 
@@ -92,3 +92,17 @@ def test_all_flavors_cli_clean():
     assert payload["ok"] is True
     assert sorted(payload["reports"]) == sorted(
         ["dense", "zero1", "zero2", "offload", "quantized", "pipeline"])
+
+
+@pytest.mark.slow
+def test_pipeline_tp_flavor_cli_clean():
+    """The TP-overlap flavor through the CLI: the compiled 1F1B step with
+    tensor_parallel.overlap chunks=4 passes every rule, including the
+    overlap pin (chunked collective-permute rings, no in-loop
+    all-reduce) and the recompile detector."""
+    proc = run_cli("--flavors", "pipeline_tp", "--steps", "2", "--json")
+    payload = _json_payload(proc.stdout)
+    assert payload["ok"] is True, proc.stdout
+    rep = payload["reports"]["pipeline_tp"]
+    assert rep["findings"] == []
+    assert rep["stats"]["collective_bytes"].get("collective-permute", 0) > 0

@@ -133,3 +133,52 @@ def test_gpt2_tp_training_matches_dp_through_engine():
     tp_losses = run(build_mesh({"model": 2, "data": 4}),
                     gpt2_partition_specs(base_params))
     np.testing.assert_allclose(tp_losses, dp_losses, rtol=2e-4)
+
+
+def test_logical_constraint_tuple_spec_entries():
+    """A dim sharded over SEVERAL mesh axes at once — spec entries like
+    ``('data', 'model')`` must be honored (flattened axis check), and
+    unknown names inside a tuple still degrade to the no-op."""
+    from deepspeed_tpu.parallel.tensor_parallel import logical_constraint
+
+    mesh = build_mesh({"model": 4, "data": 2})
+    x = jnp.arange(16 * 8, dtype=jnp.float32).reshape(16, 8)
+
+    y = jax.jit(
+        lambda a: logical_constraint(a, ("data", "model"), None, mesh=mesh)
+    )(x)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    # XLA normalizes trailing Nones away; only entry 0 matters
+    assert tuple(y.sharding.spec)[0] == ("data", "model")
+
+    # unknown axis inside the tuple → constraint silently skipped
+    z = logical_constraint(x, ("data", "no_such_axis"), None, mesh=mesh)
+    assert z is x
+    # plain single-name entries keep working
+    w = jax.jit(lambda a: logical_constraint(a, "data", None, mesh=mesh))(x)
+    assert tuple(w.sharding.spec)[0] == "data"
+
+
+def test_tp_attention_use_flash_matches_dense():
+    """use_flash=True swaps the materialized-score attention for the
+    flash kernel (XLA fallback off-TPU) — same params, same output."""
+    from deepspeed_tpu.parallel.tensor_parallel import TPMultiHeadAttention
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 32))
+    dense = TPMultiHeadAttention(n_head=4, use_flash=False)
+    flash = TPMultiHeadAttention(n_head=4, use_flash=True)
+    variables = dense.init(jax.random.PRNGKey(1), x)
+
+    y_dense = dense.apply(variables, x)
+    y_flash = flash.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(y_flash), np.asarray(y_dense),
+                               rtol=2e-5, atol=2e-5)
+
+    g_dense = jax.grad(lambda v: jnp.sum(dense.apply(v, x) ** 2))(variables)
+    g_flash = jax.grad(lambda v: jnp.sum(flash.apply(v, x) ** 2))(variables)
+    flat_d, _ = jax.tree_util.tree_flatten(g_dense)
+    flat_f, _ = jax.tree_util.tree_flatten(g_flash)
+    assert len(flat_d) == len(flat_f) and len(flat_f) > 0
+    for a, b in zip(flat_d, flat_f):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=1e-5)
