@@ -372,7 +372,7 @@ def run_once_quantized(jax, quantized, batch_size, seq_len, steps):
     import jax.numpy as jnp
     from deepspeed_tpu.models.gpt2 import (
         GPT2LMHead, gpt2_125m, init_gpt2_params, make_gpt2_loss_fn)
-    from deepspeed_tpu.utils.hlo_analysis import ring_send_bytes
+    from deepspeed_tpu.analysis.hlo import ring_send_bytes
 
     ndev = len(jax.devices())
     cfg = gpt2_125m(n_positions=seq_len)
@@ -662,6 +662,48 @@ def run_once_audit(jax):
         per_flavor[flavor] = time.perf_counter() - t0
         findings += len(report.findings)
     return per_flavor, findings
+
+
+def run_once_static_analysis(jax):
+    """Static-analysis pass wall time per compiled-step flavor: the
+    trace-time jaxpr passes (deadlock, ordering, spec flow) plus the
+    schedule-order peak-memory estimate, and the estimate's ratio to
+    XLA's own compiled buffer-assignment peak (``memory_analysis()`` —
+    argument + temp + output net of aliasing)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.analysis import estimate_peak_memory
+    from deepspeed_tpu.analysis.audit import (STEP_FLAVORS,
+                                              _engine_fn_args,
+                                              _jaxpr_facts,
+                                              build_flavor_engine)
+    rows = {}
+    for flavor in STEP_FLAVORS:
+        hb(f"static analysis: {flavor} step")
+        engine, batch = build_flavor_engine(flavor)
+        engine.train_batch(batch)      # pay the compile outside the timer
+        placed = engine._shard_batch(batch)
+        rng = jax.random.PRNGKey(0)
+        lr = jnp.asarray(1e-3, jnp.float32)
+        fn, args = _engine_fn_args(engine, placed, rng, lr)
+        compiled = fn.lower(*args).compile()   # jit-cache hit, no recompile
+        hlo = compiled.as_text()               # scheduled HLO
+        t0 = time.perf_counter()
+        facts = _jaxpr_facts(fn, args)
+        est = estimate_peak_memory(hlo)
+        wall = time.perf_counter() - t0
+        ma = compiled.memory_analysis()
+        xla_peak = (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                    + ma.output_size_in_bytes - ma.alias_size_in_bytes)
+        rows[flavor] = {
+            "analyzer_s": round(wall, 3),
+            "est_peak_mb": round(est["peak_bytes"] / 2 ** 20, 3),
+            "xla_peak_mb": round(xla_peak / 2 ** 20, 3),
+            "est_vs_xla": round(est["peak_bytes"] / max(xla_peak, 1), 3),
+            "deadlock_findings": sum(
+                len(facts.get(k) or ()) for k in ("divergent",
+                                                  "unordered")),
+        }
+    return rows
 
 
 def main():
@@ -976,6 +1018,35 @@ def main():
             emit(out)
         except Exception as e:
             emit({"metric": "compiled-step audit pass wall time",
+                  "value": 0, "unit": "s", "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {e}",
+                  "traceback": traceback.format_exc(limit=5)})
+        return
+    if bench_model == "static_analysis":
+        # Static-analysis PR row: trace-time jaxpr passes + schedule-
+        # order peak estimate per flavor, and how the estimate compares
+        # to XLA's compiled buffer-assignment peak. Clean skip off-TPU
+        # (the CPU-virtual-mesh numbers live in the tier-1 tests).
+        if not on_tpu:
+            emit({"metric": "static-analysis pass wall time",
+                  "value": 0, "unit": "s", "vs_baseline": 0.0,
+                  "error": f"requires a TPU; backend is {platform!r}"})
+            return
+        try:
+            rows = run_once_static_analysis(jax)
+            total = sum(r["analyzer_s"] for r in rows.values())
+            out = {"metric": "static-analysis pass wall time "
+                             "(six stock flavors: jaxpr passes + "
+                             "peak-memory estimate)",
+                   "value": round(total, 3), "unit": "s",
+                   # no reference counterpart; the analyzer is new tooling
+                   "vs_baseline": 0.0,
+                   "per_flavor": rows,
+                   "live": True}
+            save_tpu_result(out)
+            emit(out)
+        except Exception as e:
+            emit({"metric": "static-analysis pass wall time",
                   "value": 0, "unit": "s", "vs_baseline": 0.0,
                   "error": f"{type(e).__name__}: {e}",
                   "traceback": traceback.format_exc(limit=5)})

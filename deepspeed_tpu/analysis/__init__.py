@@ -1,12 +1,16 @@
 """Compiled-program audit subsystem (see docs/analysis.md).
 
-Static analysis over the HLO of compiled train steps: trip-count-aware
-collective accounting, donation/aliasing audits, ZeRO byte budgets,
-dtype hygiene, host-transfer and recompile detection. The parser lives
-in `analysis/hlo.py`, the declarative rule catalog in
-`analysis/rules.py`, and the orchestrator + stock-flavor builders in
-`analysis/audit.py`; ``bin/ds_tpu_audit`` fronts it all from the
-command line.
+Static analysis over compiled train steps at two levels. HLO text
+(`analysis/hlo.py`): trip-count-aware collective accounting,
+donation/aliasing audits, ZeRO byte budgets, dtype hygiene,
+host-transfer and recompile detection, and a schedule-order liveness
+estimator for static peak memory. Traced jaxpr (`analysis/jaxpr.py`):
+collective-deadlock proofs (divergent control flow, unchained
+concurrent permutes) and PartitionSpec flow lint (accidental
+replication, implicit reshards) — all before the program ever runs.
+The declarative rule catalog lives in `analysis/rules.py`, the
+orchestrator + stock-flavor builders in `analysis/audit.py`;
+``bin/ds_tpu_audit`` fronts it all from the command line.
 """
 
 from deepspeed_tpu.analysis.hlo import (
@@ -14,11 +18,22 @@ from deepspeed_tpu.analysis.hlo import (
     collective_bytes,
     collective_ops,
     computation_multipliers,
+    estimate_peak_memory,
     host_transfer_ops,
     input_output_aliases,
     ring_send_bytes,
     split_computations,
     while_loops,
+)
+from deepspeed_tpu.analysis.jaxpr import (
+    CollectiveSite,
+    ReshardEvent,
+    check_divergent_collectives,
+    check_unordered_permutes,
+    collect_collectives,
+    input_specs_of,
+    propagate_partition_specs,
+    trace_jaxpr,
 )
 from deepspeed_tpu.analysis.rules import (
     RULE_IDS,
@@ -46,9 +61,13 @@ from deepspeed_tpu.analysis.audit import (
 
 __all__ = [
     "aliased_param_numbers", "collective_bytes", "collective_ops",
-    "computation_multipliers", "host_transfer_ops",
+    "computation_multipliers", "estimate_peak_memory",
+    "host_transfer_ops",
     "input_output_aliases", "ring_send_bytes", "split_computations",
     "while_loops",
+    "CollectiveSite", "ReshardEvent", "check_divergent_collectives",
+    "check_unordered_permutes", "collect_collectives", "input_specs_of",
+    "propagate_partition_specs", "trace_jaxpr",
     "RULE_IDS", "RULES", "SEV_ERROR", "SEV_INFO", "SEV_WARNING",
     "Finding", "StepContext", "run_rules",
     "STEP_FLAVORS", "AuditError", "AuditReport", "audit_compiled_step",

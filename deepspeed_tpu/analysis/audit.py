@@ -18,6 +18,7 @@ engine's step factories jit through it so the *declared*
 executable's actual ``input_output_alias`` map.
 """
 
+import dataclasses
 import json
 import time
 from dataclasses import dataclass, field
@@ -26,11 +27,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.parallel.collectives import record_collective_sites
+
 from deepspeed_tpu.analysis.hlo import (
     aliased_param_numbers,
     collective_bytes,
+    estimate_peak_memory,
     ring_send_bytes,
     while_loops,
+)
+from deepspeed_tpu.analysis.jaxpr import (
+    check_divergent_collectives,
+    check_unordered_permutes,
+    input_specs_of,
+    propagate_partition_specs,
+    trace_jaxpr,
 )
 from deepspeed_tpu.analysis.rules import (
     SEV_ERROR,
@@ -198,7 +209,56 @@ def _engine_fn_args(engine, placed, rng, lr):
     return fn, tuple(args)
 
 
-def _engine_context(engine, hlo_text, expected, pinfo):
+def _jaxpr_facts(fn, args):
+    """Trace-time facts for the rule catalog: the three jaxpr passes
+    over the step's closed jaxpr (a retrace, never a compile). Returns
+    ``{divergent, unordered, reshard_events}`` — or all-None on a trace
+    failure, which downgrades the trace-time rules to not-run rather
+    than failing the whole audit."""
+    try:
+        with record_collective_sites() as sites:
+            closed = trace_jaxpr(fn, args)
+        divergent = check_divergent_collectives(closed)
+        unordered = check_unordered_permutes(closed)
+        _, events = propagate_partition_specs(closed,
+                                              input_specs_of(args))
+    except Exception as exc:  # pragma: no cover - defensive
+        return {"divergent": None, "unordered": None,
+                "reshard_events": None, "collective_sites": None,
+                "trace_error": str(exc)}
+    return {
+        "divergent": divergent,
+        "unordered": unordered,
+        "reshard_events": [
+            {"kind": e.kind, "primitive": e.primitive,
+             "path": list(e.path), "dim": e.dim, "bytes": e.bytes,
+             "specs": [list(s) for s in e.specs]}
+            for e in events],
+        "collective_sites": [dataclasses.asdict(s) for s in sites],
+    }
+
+
+def _replicated_state_leaves(engine):
+    """Large optimizer-state leaves placed fully replicated — under
+    ZeRO >= 1 these mean the partition spec never attached (the
+    resharding rule sizes and reports them)."""
+    if engine._offload or engine.opt_state is None:
+        return []
+    leaves = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(engine.opt_state)
+    for path, leaf in flat:
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        if spec is None or any(e is not None for e in tuple(spec)):
+            continue
+        nbytes = int(getattr(leaf, "nbytes", 0) or 0)
+        leaves.append({"path": jax.tree_util.keystr(path),
+                       "bytes": nbytes,
+                       "shape": list(getattr(leaf, "shape", ()))})
+    return leaves
+
+
+def _engine_context(engine, hlo_text, expected, pinfo, jaxpr_facts=None):
     cfg = engine._config
     dtype = engine.compute_dtype
     compute = ("bf16" if dtype == jnp.bfloat16 else
@@ -217,6 +277,10 @@ def _engine_context(engine, hlo_text, expected, pinfo):
     declared = getattr(getattr(step, "inner", step),
                        "_ds_donate_argnums", None)
     tp = getattr(cfg, "tensor_parallel", None)
+    facts = jaxpr_facts or {}
+    analysis_cfg = getattr(cfg, "analysis", None)
+    budget_mb = float(getattr(analysis_cfg, "peak_memory_budget_mb", 0)
+                      or 0)
     return StepContext(
         hlo_text=hlo_text,
         flavor=flavor,
@@ -232,6 +296,13 @@ def _engine_context(engine, hlo_text, expected, pinfo):
         declared_donate_argnums=declared,
         overlap_enabled=bool(tp is not None and tp.overlap_enabled),
         overlap_chunks=int(tp.overlap_chunks) if tp is not None else 1,
+        jaxpr_divergent=facts.get("divergent"),
+        jaxpr_unordered=facts.get("unordered"),
+        reshard_events=facts.get("reshard_events"),
+        collective_sites=facts.get("collective_sites"),
+        replicated_leaves=_replicated_state_leaves(engine),
+        peak_memory=estimate_peak_memory(hlo_text),
+        peak_budget_bytes=int(budget_mb * (1 << 20)),
         skip_rules=skip)
 
 
@@ -269,8 +340,14 @@ def check_recompile(engine, baseline=1):
 # ---------------------------------------------------------------------------
 
 def audit_hlo(hlo_text, rules=None, **ctx_kwargs):
-    """Run the rule catalog over raw HLO text (no engine needed)."""
+    """Run the rule catalog over raw HLO text (no engine needed).
+
+    The trace-time rules (`deadlock`, the spec-flow half of
+    `resharding`) need a jaxpr and stay not-run here; `peak_memory`
+    works from the text alone."""
     ctx = StepContext(hlo_text=hlo_text, **ctx_kwargs)
+    if ctx.peak_memory is None:
+        ctx.peak_memory = estimate_peak_memory(hlo_text)
     report = AuditReport(flavor=ctx.flavor, findings=run_rules(ctx, rules))
     report.stats = _hlo_stats(hlo_text, ctx)
     return report
@@ -295,6 +372,21 @@ def _hlo_stats(hlo_text, ctx):
         stats["donated_expected"] = len(ctx.expected_donated_params)
         stats["donated_aliased"] = len(
             ctx.expected_donated_params & aliased)
+    if ctx.peak_memory:
+        stats["peak_memory"] = {
+            k: ctx.peak_memory.get(k, 0)
+            for k in ("peak_bytes", "temp_peak_bytes",
+                      "parameter_bytes", "output_bytes",
+                      "donated_output_bytes")}
+    if ctx.jaxpr_divergent is not None:
+        stats["jaxpr"] = {
+            "divergent_collectives": len(ctx.jaxpr_divergent),
+            "unordered_permutes": len(ctx.jaxpr_unordered or ()),
+            "reshard_conflicts": len(ctx.reshard_events or ()),
+        }
+        if ctx.collective_sites is not None:
+            stats["jaxpr"]["collective_sites"] = [
+                dict(s) for s in ctx.collective_sites]
     return stats
 
 
@@ -305,7 +397,8 @@ def audit_compiled_step(engine, placed, rng, lr, rules=None):
     opt-in ``analysis`` config block (`runtime/engine.py`)."""
     fn, args = _engine_fn_args(engine, placed, rng, lr)
     hlo_text, expected, pinfo = _lower_step(fn, args)
-    ctx = _engine_context(engine, hlo_text, expected, pinfo)
+    ctx = _engine_context(engine, hlo_text, expected, pinfo,
+                          jaxpr_facts=_jaxpr_facts(fn, args))
     report = AuditReport(flavor=ctx.flavor, findings=run_rules(ctx, rules))
     report.stats = _hlo_stats(hlo_text, ctx)
     return report
@@ -332,7 +425,8 @@ def audit_engine(engine, batch, rules=None, steps=0):
     lr = jnp.asarray(1e-3, jnp.float32)
     fn, args = _engine_fn_args(engine, placed, rng, lr)
     hlo_text, expected, pinfo = _lower_step(fn, args)
-    ctx = _engine_context(engine, hlo_text, expected, pinfo)
+    ctx = _engine_context(engine, hlo_text, expected, pinfo,
+                          jaxpr_facts=_jaxpr_facts(fn, args))
     findings = run_rules(ctx, rules)
     if (rules is None or "recompile" in rules) \
             and "recompile" not in ctx.skip_rules:

@@ -1,12 +1,18 @@
 """`ds_tpu_audit`: audit compiled train steps from the command line.
 
-Two modes:
+Three modes:
 
 - ``ds_tpu_audit --flavors dense,zero1`` (default: all six stock
   flavors) — build toy engines per flavor and audit each compiled step.
 - ``ds_tpu_audit --config my_config.json`` — build an engine from a
   user DeepSpeed-style config (with a toy GPT-2 model supplying the
   loss) and audit the step that config actually compiles to.
+- ``ds_tpu_audit --hlo dump.txt`` — run the HLO-text rule subset over a
+  saved HLO dump (no engine, no trace; the jaxpr-level rules don't run).
+
+``--memory`` appends the static peak-memory table per audited step
+(liveness peak, temp peak, parameter/output/donated bytes from
+``analysis.hlo.estimate_peak_memory``).
 
 Reports findings as text (default) or JSON (``--json``); exits non-zero
 when findings at or above ``--fail-on`` severity (default ``error``)
@@ -53,6 +59,13 @@ def main(argv=None):
     parser.add_argument("--config", default=None,
                         help="DeepSpeed-style JSON config to audit "
                              "(engine built with a toy GPT-2 model)")
+    parser.add_argument("--hlo", default=None, metavar="FILE",
+                        help="audit a saved HLO text dump instead of "
+                             "building an engine (HLO-text rules only)")
+    parser.add_argument("--memory", action="store_true",
+                        help="print the static peak-memory table per "
+                             "audited step (text mode; JSON always "
+                             "carries it in stats.peak_memory)")
     parser.add_argument("--flavors", default=None,
                         help="comma-separated stock flavors to audit "
                              "(default: all six); extra flavors like "
@@ -104,8 +117,18 @@ def main(argv=None):
                          f"known: {list(RULE_IDS)}")
 
     from deepspeed_tpu.analysis.audit import (EXTRA_FLAVORS, STEP_FLAVORS,
-                                              audit_engine, audit_flavors)
-    if args.config:
+                                              audit_engine, audit_flavors,
+                                              audit_hlo)
+    if args.hlo and args.config:
+        parser.error("--hlo and --config are mutually exclusive")
+    if args.hlo:
+        try:
+            with open(args.hlo) as f:
+                hlo_text = f.read()
+        except OSError as exc:
+            parser.error(f"cannot read --hlo file: {exc}")
+        reports = {"hlo": audit_hlo(hlo_text, rules=rules)}
+    elif args.config:
         engine, batch = _build_config_engine(args.config)
         reports = {"config": audit_engine(engine, batch, rules=rules,
                                           steps=args.steps)}
@@ -138,6 +161,20 @@ def main(argv=None):
     else:
         for rep in reports.values():
             print(rep.to_text())
+        if args.memory:
+            print("\nstatic peak memory (analysis.hlo.estimate_peak_"
+                  "memory):")
+            cols = ("peak_bytes", "temp_peak_bytes", "parameter_bytes",
+                    "output_bytes", "donated_output_bytes")
+            head = "step".ljust(12) + "".join(
+                c.replace("donated_output", "donated")
+                 .replace("_bytes", "").rjust(12) for c in cols)
+            print(head)
+            for name, rep in reports.items():
+                pm = (rep.stats or {}).get("peak_memory") or {}
+                row = name.ljust(12) + "".join(
+                    f"{pm.get(c, 0) / (1 << 20):11.2f}M" for c in cols)
+                print(row)
         print(f"\n{len(reports)} step(s) audited, {n_findings} "
               f"finding(s), {n_failing} at/above --fail-on="
               f"{args.fail_on}")

@@ -371,6 +371,221 @@ def ring_send_bytes(hlo_text, n_devices, by_dtype=False, trip_aware=True):
 
 
 # ---------------------------------------------------------------------------
+# static peak-memory estimation (buffer liveness over the schedule)
+# ---------------------------------------------------------------------------
+
+# Ops that define views or bookkeeping, not fresh device buffers.
+_ZERO_COST_OPS = {"parameter", "get-tuple-element", "tuple", "bitcast",
+                  "after-all", "add-dependency", "partition-id",
+                  "replica-id", "opt-barrier"}
+
+_PEAK_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<shape>.+?)\s+"
+    r"(?P<op>[\w\-]+)\(")
+_PEAK_USE_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _top_level_tuple_bytes(shape_text):
+    """Byte size of each top-level element of a (possibly tuple) shape."""
+    s = shape_text.strip()
+    if not s.startswith("("):
+        return [_shape_bytes(s)]
+    parts, depth, start = [], 0, 1
+    for i, ch in enumerate(s):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+            if depth == 0:
+                parts.append(s[start:i])
+                break
+        elif ch == "," and depth == 1:
+            parts.append(s[start:i])
+            start = i + 1
+    return [_shape_bytes(p) for p in parts]
+
+
+def estimate_peak_memory(hlo_text):
+    """Static peak device memory of a scheduled HLO module, by buffer
+    liveness.
+
+    jax's ``compile().as_text()`` dumps the *scheduled* module
+    (``is_scheduled=true``), so line order within a computation is
+    execution order. Each op line defines a buffer of its output shape's
+    size, alive from its definition to its last textual use; views and
+    bookkeeping (``parameter``/``get-tuple-element``/``tuple``/
+    ``bitcast``/async ``-done``) define nothing. Called computations
+    contribute their own internal peak at the call line — a ``while``
+    body's footprint lands on the ``while`` op (a loop's peak does not
+    scale with its trip count, unlike its collective *volume*, which is
+    why the two walks are separate), ``conditional`` branches contribute
+    the max across branches (one executes), and ``fusion`` bodies
+    contribute nothing (fused ops never materialize). Donation-aware:
+    root outputs aliased to entry parameters via ``input_output_alias``
+    reuse the argument's buffer and allocate nothing new.
+
+    Returns a dict::
+
+        peak_bytes            argument + liveness peak (per device —
+                              SPMD entry shapes are already local)
+        temp_peak_bytes       liveness peak alone (intermediates +
+                              un-aliased outputs)
+        parameter_bytes       entry argument footprint
+        output_bytes          entry root footprint
+        donated_output_bytes  root bytes aliased onto donated arguments
+        per_computation       {computation: internal peak}
+
+    Against XLA's own buffer assignment (``compiled.memory_analysis()``)
+    this is an *upper bound*: buffer assignment additionally reuses
+    dead buffers' allocations for same-sized successors, which pure
+    liveness does not model. The bench row reports both sides.
+    """
+    comps, entry = split_computations(hlo_text)
+    if not comps or entry is None:
+        comps = {"<flat>": [l for l in hlo_text.splitlines() if l.strip()]}
+        entry = "<flat>"
+        aliases = []
+    else:
+        aliases = input_output_aliases(hlo_text)
+
+    peak_memo = {}
+
+    def callee_contribution(op, line):
+        if op == "fusion":
+            return 0
+        subs = []
+        if op == "while":
+            for rx in (_BODY_REF_RE, _COND_REF_RE):
+                m = rx.search(line)
+                if m and m.group(1) in comps:
+                    subs.append(peak_of(m.group(1)))
+            return max(subs, default=0)
+        if op == "conditional":
+            m = _BRANCHES_RE.search(line)
+            if m:
+                for ref in m.group(1).split(","):
+                    ref = ref.strip().lstrip("%")
+                    if ref in comps:
+                        subs.append(peak_of(ref))
+            for rx in (_TRUE_REF_RE, _FALSE_REF_RE):
+                m = rx.search(line)
+                if m and m.group(1) in comps:
+                    subs.append(peak_of(m.group(1)))
+            return max(subs, default=0)
+        for rx in (_CALLS_REF_RE, _TO_APPLY_RE):
+            m = rx.search(line)
+            if m and m.group(1) in comps:
+                subs.append(peak_of(m.group(1)))
+        return max(subs, default=0)
+
+    def line_alloc(op, shape):
+        if op in _ZERO_COST_OPS or op.endswith("-done"):
+            return 0
+        if op == "while":
+            return 0   # the carry aliases the while's operand buffer
+        if op.endswith("-start") and shape.strip().startswith("("):
+            # async tuple = (operands..., results..., scratch scalars):
+            # operands alias existing buffers; only results are new.
+            elems = _element_bytes(shape, skip_scalars=True)
+            return sum(b for _, b in elems[len(elems) // 2:])
+        return _shape_bytes(shape)
+
+    def walk(name, donated_root=0, donated_defs=()):
+        """(liveness peak, parameter bytes, root bytes) of one
+        computation. ``donated_defs``: def names whose buffers are
+        written in place into donated arguments (allocate nothing)."""
+        lines = comps[name]
+        parsed = []        # (def name, alloc, callee peak)
+        param_bytes = 0
+        root_bytes = 0
+        for line in lines:
+            m = _PEAK_DEF_RE.match(line)
+            if m is None:
+                parsed.append(None)
+                continue
+            op = m.group("op")
+            shape = m.group("shape")
+            is_root = line.lstrip().startswith("ROOT")
+            alloc = line_alloc(op, shape)
+            if op == "parameter":
+                param_bytes += _shape_bytes(shape)
+            if m.group("name") in donated_defs:
+                alloc = 0
+            if is_root:
+                root_bytes = _shape_bytes(shape)
+                alloc = max(0, alloc - donated_root)
+            parsed.append((m.group("name"), alloc,
+                           callee_contribution(op, line)))
+        defined = {p[0]: i for i, p in enumerate(parsed)
+                   if p is not None}
+        last_use = dict(defined)
+        for i, line in enumerate(lines):
+            for use in _PEAK_USE_RE.findall(line):
+                if use in last_use and i > last_use[use]:
+                    last_use[use] = i
+        free_at = {}
+        for dname, i in defined.items():
+            free_at.setdefault(last_use[dname], []).append(
+                parsed[i][1])
+        live = peak = 0
+        for i, p in enumerate(parsed):
+            if p is None:
+                continue
+            live += p[1]
+            peak = max(peak, live + p[2])
+            for b in free_at.get(i, ()):
+                live -= b
+        return peak, param_bytes, root_bytes
+
+    def peak_of(name):
+        if name not in peak_memo:
+            peak_memo[name] = 0       # cycle guard
+            peak_memo[name] = walk(name)[0]
+        return peak_memo[name]
+
+    # Donated output bytes: per-aliased-entry sizes of the root tuple.
+    # When ROOT is a `tuple` view the aliased buffers are the tuple's
+    # operand defs — written in place into the donated argument, so
+    # those defs allocate nothing; otherwise subtract off the root def.
+    root_shape = None
+    root_op = None
+    root_operands = []
+    for line in comps[entry]:
+        if line.lstrip().startswith("ROOT"):
+            m = _PEAK_DEF_RE.match(line)
+            if m:
+                root_shape = m.group("shape")
+                root_op = m.group("op")
+                root_operands = _PEAK_USE_RE.findall(
+                    line[m.end():])
+    donated = 0
+    donated_defs = set()
+    if root_shape is not None and aliases:
+        elems = _top_level_tuple_bytes(root_shape)
+        for e in aliases:
+            oi = e["output_index"]
+            if not oi:
+                donated += sum(elems)
+            elif oi[0] < len(elems):
+                donated += elems[oi[0]]
+                if root_op == "tuple" and oi[0] < len(root_operands):
+                    donated_defs.add(root_operands[oi[0]])
+    entry_peak, param_bytes, root_bytes = walk(
+        entry, donated_root=0 if root_op == "tuple" else donated,
+        donated_defs=donated_defs)
+    per_comp = {entry: entry_peak}
+    per_comp.update(peak_memo)
+    return {
+        "peak_bytes": param_bytes + entry_peak,
+        "temp_peak_bytes": entry_peak,
+        "parameter_bytes": param_bytes,
+        "output_bytes": root_bytes,
+        "donated_output_bytes": donated,
+        "per_computation": per_comp,
+    }
+
+
+# ---------------------------------------------------------------------------
 # input/output aliasing (donation) and host transfers
 # ---------------------------------------------------------------------------
 

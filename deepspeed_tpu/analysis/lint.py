@@ -1,0 +1,156 @@
+"""`ds_tpu_lint`: the repo's enforced lint gate.
+
+Prefers ``ruff check`` (config in pyproject.toml's ``[tool.ruff]``
+block) when a ruff binary or module is importable; otherwise falls back
+to a dependency-free subset of the same gate so the check is *always*
+enforceable in minimal containers:
+
+- ``E9``: the file must compile (``compile(...)``) — syntax errors.
+- ``W291``/``W293``: trailing whitespace (on code / on blank lines).
+- ``W292``: missing final newline.
+
+The fallback intentionally mirrors rule ids ruff would emit so findings
+read the same either way, and ``--fix`` repairs the whitespace classes
+in place. Exit 0 clean, 1 findings, 2 usage error — the same contract
+as ``ds_tpu_audit`` so CI can treat both as gates.
+"""
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+# What the gate covers by default: the package, its tests and bench
+# driver, and the bin/ front scripts (python files without .py).
+DEFAULT_PATHS = ("deepspeed_tpu", "tests", "bench.py", "bin", "setup.py")
+
+
+def repo_root():
+    """The checkout root: the directory holding this package's parent."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def _ruff_argv():
+    """argv prefix for a usable ruff, or None. Binary first, then the
+    pip-installed module form (``python -m ruff``)."""
+    exe = shutil.which("ruff")
+    if exe:
+        return [exe]
+    try:
+        import ruff  # noqa: F401
+    except Exception:
+        return None
+    return [sys.executable, "-m", "ruff"]
+
+
+def iter_python_files(paths, root):
+    """Yield python files under ``paths`` (relative to ``root``): .py
+    files plus extensionless scripts whose shebang mentions python."""
+    for path in paths:
+        full = path if os.path.isabs(path) else os.path.join(root, path)
+        if os.path.isfile(full):
+            yield full
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git", "related")]
+            for name in sorted(filenames):
+                fp = os.path.join(dirpath, name)
+                if name.endswith(".py"):
+                    yield fp
+                elif "." not in name:
+                    try:
+                        with open(fp, "rb") as f:
+                            first = f.readline()
+                    except OSError:
+                        continue
+                    if first.startswith(b"#!") and b"python" in first:
+                        yield fp
+
+
+def check_file(path, fix=False):
+    """Fallback checks for one file → list of (line, code, message).
+    ``fix=True`` rewrites the whitespace findings in place (syntax
+    errors are only ever reported)."""
+    findings = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except (OSError, UnicodeDecodeError) as exc:
+        return [(0, "E902", f"cannot read: {exc}")]
+
+    if path.endswith(".py") or "\n" in text[:200]:
+        try:
+            compile(text, path, "exec")
+        except SyntaxError as exc:
+            findings.append((exc.lineno or 0, "E999",
+                             f"syntax error: {exc.msg}"))
+
+    lines = text.split("\n")
+    fixed = []
+    for i, line in enumerate(lines, 1):
+        stripped = line.rstrip(" \t")
+        if stripped != line:
+            code = "W293" if not stripped else "W291"
+            what = ("whitespace on blank line" if code == "W293"
+                    else "trailing whitespace")
+            findings.append((i, code, what))
+        fixed.append(stripped)
+    if text and not text.endswith("\n"):
+        findings.append((len(lines), "W292", "no newline at end of file"))
+
+    if fix:
+        new = "\n".join(fixed)
+        if new and not new.endswith("\n"):
+            new += "\n"
+        if new != text:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(new)
+    return findings
+
+
+def run_fallback(paths, root, fix=False):
+    n_files, n_findings = 0, 0
+    for fp in iter_python_files(paths, root):
+        n_files += 1
+        for line, code, msg in check_file(fp, fix=fix):
+            n_findings += 1
+            rel = os.path.relpath(fp, root)
+            print(f"{rel}:{line}: {code} {msg}")
+    tag = " (after --fix)" if fix else ""
+    print(f"ds_tpu_lint[builtin]: {n_files} file(s), "
+          f"{n_findings} finding(s){tag}")
+    return 1 if n_findings else 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ds_tpu_lint",
+        description="Repo lint gate: ruff when available, a built-in "
+                    "whitespace/syntax subset otherwise.")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help=f"files/dirs to lint (default: "
+                             f"{', '.join(DEFAULT_PATHS)})")
+    parser.add_argument("--fix", action="store_true",
+                        help="auto-fix what the active backend can "
+                             "(ruff --fix; builtin: whitespace)")
+    parser.add_argument("--builtin", action="store_true",
+                        help="force the dependency-free fallback even "
+                             "if ruff is installed")
+    args = parser.parse_args(argv)
+
+    root = repo_root()
+    paths = args.paths or list(DEFAULT_PATHS)
+
+    ruff = None if args.builtin else _ruff_argv()
+    if ruff is not None:
+        cmd = ruff + ["check"] + (["--fix"] if args.fix else []) + paths
+        proc = subprocess.run(cmd, cwd=root)
+        return proc.returncode
+    return run_fallback(paths, root, fix=args.fix)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

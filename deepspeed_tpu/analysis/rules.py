@@ -75,6 +75,27 @@ class StepContext:
     # step actually carries the chunked ppermute rings.
     overlap_enabled: bool = False
     overlap_chunks: int = 1
+    # Trace-time facts from the jaxpr front end (`analysis/jaxpr.py`);
+    # None means the pass didn't run (HLO-only audits), [] means it ran
+    # clean. The orchestrator fills these from the traced step.
+    jaxpr_divergent: list = None     # check_divergent_collectives dicts
+    jaxpr_unordered: list = None     # check_unordered_permutes dicts
+    reshard_events: list = None      # propagate_partition_specs events
+    replicated_leaves: list = None   # [{path, bytes, shape}] large fully-
+    #                                  replicated state leaves
+    collective_sites: list = None    # parallel.collectives SiteRecord
+    #                                  dicts captured while tracing —
+    #                                  source-level attribution for the
+    #                                  permute-chain findings/stats
+    # Conflicting-placement reshards below this are noise (tiny norms,
+    # scalars); full replication below it is a deliberate choice the
+    # ZeRO partitioner itself makes for small leaves.
+    min_reshard_bytes: int = 1 << 20
+    # Static peak-memory estimate (`analysis/hlo.py:estimate_peak_memory`
+    # dict) and an optional explicit budget; 0 derives the per-ZeRO-stage
+    # default from param_bytes.
+    peak_memory: dict = None
+    peak_budget_bytes: int = 0
     skip_rules: set = field(default_factory=set)
 
 
@@ -332,6 +353,138 @@ def rule_overlap(ctx):
     return findings
 
 
+def rule_deadlock(ctx):
+    """No collective may execute divergently, and concurrent permutes
+    must be dep-chained.
+
+    Both facts come from the traced jaxpr (`analysis/jaxpr.py`), i.e.
+    they are proven before the program ever runs — which matters because
+    the failure mode being detected is a hang, not an exception. A
+    collective inside control flow that branches on a device-varying
+    value (anything derived from ``lax.axis_index``) strands part of its
+    rendezvous on the other branch: fatal always for
+    ``ppermute``/collective-permute (global rendezvous — the PR 5
+    stage-divergent pipeline deadlock), fatal for grouped collectives
+    when the divergence splits their own axis. Separately, two
+    ``ppermute``s with no dataflow edge between them can be in flight
+    simultaneously and split the in-process runtime's rendezvous — the
+    invariant ``parallel.collectives.barrier_after`` exists to maintain,
+    checked here instead of assumed."""
+    findings = []
+    for d in ctx.jaxpr_divergent or ():
+        findings.append(Finding(
+            "deadlock", SEV_ERROR, d["message"],
+            {"primitive": d.get("primitive"),
+             "axes": list(d.get("axes", ())),
+             "divergent_axes": list(d.get("divergent_axes", ())),
+             "path": list(d.get("path", ()))}))
+    for d in ctx.jaxpr_unordered or ():
+        findings.append(Finding(
+            "deadlock", SEV_ERROR, d["message"],
+            {"kind": "unordered_permutes",
+             "path": list(d.get("path", ())),
+             "eqns": list(d.get("eqns", ()))}))
+    for s in ctx.collective_sites or ():
+        # source-level confession: an emitter declared it skipped the
+        # dep-chain (parallel.collectives SiteRecord.chained=False).
+        if s.get("primitive") == "ppermute" and not s.get("chained", True):
+            findings.append(Finding(
+                "deadlock", SEV_ERROR,
+                f"collective site {s.get('site')!r} emits ppermutes over "
+                f"axis {s.get('axis')!r} outside the barrier_after "
+                f"dep-chain: concurrent in-flight permutes split the "
+                f"global rendezvous",
+                {"kind": "unchained_site", "site": dict(s)}))
+    return findings
+
+
+def rule_resharding(ctx):
+    """Sharding-flow hygiene: no accidental replication, no unattributed
+    reshards.
+
+    From the PartitionSpec propagation over the traced jaxpr: operands
+    meeting with *conflicting* placements force a compiler-inserted
+    reshard (an all-gather + reslice) that no declared overlap/gather
+    site accounts for — flagged per conflict above
+    ``min_reshard_bytes``. Separately, a ZeRO run (stage >= 1) whose
+    optimizer state contains large fully-replicated leaves is paying
+    stage-0 memory while claiming otherwise — the partitioner
+    (`zero/sharding.py`) legitimately replicates only small or
+    non-divisible leaves, so big replicated ones mean the spec never
+    attached. (ZeRO-1/2's param-refresh all-gathers are GSPMD-implicit
+    sharding declarations, not jaxpr eqns, so attribution here is
+    config-driven: the refresh allowance lives in ``rule_zero_budget``'s
+    byte ceilings, while this rule polices placements.)"""
+    findings = []
+    big = [e for e in ctx.reshard_events or ()
+           if e.get("bytes", 0) >= ctx.min_reshard_bytes]
+    if big:
+        total = sum(e["bytes"] for e in big)
+        findings.append(Finding(
+            "resharding", SEV_WARNING,
+            f"{len(big)} operand join(s) with conflicting "
+            f"PartitionSpecs (largest {_fmt_bytes(max(e['bytes'] for e in big))}, "
+            f"total {_fmt_bytes(total)}) force compiler-inserted "
+            f"reshards not attributable to any declared gather site",
+            {"events": big[:8], "total_bytes": total}))
+    if ctx.zero_stage >= 1 and ctx.n_devices > 1:
+        rep = [l for l in ctx.replicated_leaves or ()
+               if l.get("bytes", 0) >= ctx.min_reshard_bytes]
+        if rep:
+            total = sum(l["bytes"] for l in rep)
+            findings.append(Finding(
+                "resharding", SEV_ERROR,
+                f"stage-{ctx.zero_stage} run holds {len(rep)} large "
+                f"fully-replicated optimizer-state leaves "
+                f"({_fmt_bytes(total)}) — the ZeRO partition spec never "
+                f"attached; every device pays stage-0 memory",
+                {"leaves": rep[:8], "total_bytes": total}))
+    return findings
+
+
+def rule_peak_memory(ctx):
+    """Static peak device memory must fit the per-ZeRO-stage budget.
+
+    The liveness estimate (`analysis/hlo.py:estimate_peak_memory`) is
+    checked against an explicit ``peak_budget_bytes`` when configured,
+    else a generous per-stage formula in units of M (fp32 master bytes):
+    params (M) + optimizer state (3M, sharded /N under ZeRO >= 1, 0 on
+    device under offload) + 3M of gradients/activations headroom. Toy
+    flavors sit near 50% of this; the rule exists to catch
+    order-of-magnitude regressions (a lost donation doubling state, a
+    replicated optimizer) on real models — exact orderings are pinned
+    by tests, not here."""
+    est = ctx.peak_memory
+    if not est or ctx.param_bytes <= 0:
+        return []
+    m_bytes = ctx.param_bytes
+    budget = ctx.peak_budget_bytes
+    if not budget:
+        n = max(ctx.n_devices, 1)
+        if ctx.offload:
+            opt_m = 0.0
+        elif ctx.zero_stage >= 1:
+            opt_m = 3.0 / n
+        else:
+            opt_m = 3.0
+        budget = int(m_bytes * (1.0 + opt_m + 3.0)) + 2 * _slack(ctx)
+    peak = est.get("peak_bytes", 0)
+    if peak <= budget:
+        return []
+    return [Finding(
+        "peak_memory", SEV_ERROR,
+        f"static peak-memory estimate {_fmt_bytes(peak)} exceeds the "
+        f"stage-{ctx.zero_stage} budget {_fmt_bytes(budget)} "
+        f"(M = {_fmt_bytes(m_bytes)}; args "
+        f"{_fmt_bytes(est.get('parameter_bytes', 0))} + liveness peak "
+        f"{_fmt_bytes(est.get('temp_peak_bytes', 0))})",
+        {"peak_bytes": peak, "budget_bytes": budget,
+         "parameter_bytes": est.get("parameter_bytes", 0),
+         "temp_peak_bytes": est.get("temp_peak_bytes", 0),
+         "donated_output_bytes": est.get("donated_output_bytes", 0),
+         "zero_stage": ctx.zero_stage, "param_bytes": m_bytes})]
+
+
 # Rule catalog: id -> rule. `recompile` is listed for config validation
 # but runs in the orchestrator (it needs live step objects, not HLO).
 RULES = {
@@ -341,6 +494,9 @@ RULES = {
     "host_transfer": rule_host_transfer,
     "trip_count": rule_trip_count,
     "overlap": rule_overlap,
+    "deadlock": rule_deadlock,
+    "resharding": rule_resharding,
+    "peak_memory": rule_peak_memory,
 }
 RULE_IDS = tuple(RULES) + ("recompile",)
 

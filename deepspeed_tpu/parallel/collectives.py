@@ -283,6 +283,55 @@ def barrier_after(x, dep):
     return _barrier_pair(x, dep)
 
 
+# ---------------------------------------------------------------------------
+# trace-time collective-site log (consumed by analysis.jaxpr / audit)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SiteRecord:
+    """One collective group emitted by an overlap/ring helper while a
+    program traced: which helper (``site``), over which mesh axis, the
+    lowered primitive family, how many chunk rings / hops it fans out to,
+    and whether its permutes ride the ``barrier_after`` dep-chain. The
+    static analyzer's unordered-permute check proves the chain invariant
+    from the jaxpr itself; these records give its findings (and the audit
+    stats) source-level attribution the flat jaxpr no longer carries."""
+    site: str
+    axis: str
+    primitive: str
+    chunks: int = 1
+    hops: int = 1
+    chained: bool = True
+
+
+_SITE_LOG = None
+
+
+@contextlib.contextmanager
+def record_collective_sites():
+    """Collect :class:`SiteRecord`\\ s while tracing. Trace the program
+    (``jax.jit(...).trace`` / ``jax.make_jaxpr``) inside this context and
+    the helpers below append one record per collective group they emit;
+    yields the list. Nestable — the innermost recorder wins."""
+    global _SITE_LOG
+    prev, log = _SITE_LOG, []
+    _SITE_LOG = log
+    try:
+        yield log
+    finally:
+        _SITE_LOG = prev
+
+
+def log_collective_site(site, axis, primitive, chunks=1, hops=1,
+                        chained=True):
+    """Append to the active :func:`record_collective_sites` log (no-op
+    when none is active). Exposed so out-of-module collective emitters
+    (the pipeline stage transfer) report through the same channel."""
+    if _SITE_LOG is not None:
+        _SITE_LOG.append(SiteRecord(site, str(axis), primitive,
+                                    int(chunks), int(hops), bool(chained)))
+
+
 def _ordered_ppermute(buf, axis_name, perm, dep):
     out = lax.ppermute(barrier_after(buf, dep), axis_name, perm)
     return out, out
@@ -309,6 +358,8 @@ def ring_psum(x, axis_name, chunks=1, bidirectional=False):
         slices = _chunk_slices(x.shape[-1], chunks)
     k = len(slices)
     hops = n - 1
+    log_collective_site("ring_psum", axis_name, "ppermute",
+                        chunks=k, hops=hops)
     state = [None] * k
     dep = None
     for step in range(k + hops):
@@ -349,10 +400,14 @@ def _matmul_psum_overlap(a, b, axis_name, chunks, bidirectional):
     n = lax.psum(1, axis_name)
     if chunks <= 1 or n == 1 or b.shape[-1] < 2:
         # monolithic path: bit-identical to psum_combine(a @ b)
+        if n > 1:
+            log_collective_site("matmul_psum_overlap", axis_name, "psum")
         return lax.psum(jnp.matmul(a, b), axis_name)
     slices = _chunk_slices(b.shape[-1], chunks)
     k = len(slices)
     hops = n - 1
+    log_collective_site("matmul_psum_overlap", axis_name, "ppermute",
+                        chunks=k, hops=hops)
     state = [None] * k
     dep = None
     # Wavefront: at trace step s the matmul of chunk s issues alongside
@@ -419,8 +474,12 @@ def _matmul_reduce_scatter(a, b, axis_name, chunks, bidirectional):
     m_loc = M // n
     if chunks <= 1 or m_loc < 2:
         y = jnp.matmul(a, b)
+        log_collective_site("matmul_reduce_scatter", axis_name,
+                            "reduce_scatter")
         return lax.psum_scatter(y, axis_name,
                                 scatter_dimension=y.ndim - 1, tiled=True)
+    log_collective_site("matmul_reduce_scatter", axis_name, "ppermute",
+                        chunks=chunks, hops=n - 1)
     r = lax.axis_index(axis_name)
     outs = []
     dep = None
@@ -515,8 +574,11 @@ def _all_gather_matmul(x, w, axis_name, chunks, bidirectional):
         f"all_gather_matmul_overlap: w contraction dim {w.shape[-2]} != "
         f"axis size {n} x local width {k_loc}")
     if chunks <= 1 or k_loc < 2:
+        log_collective_site("all_gather_matmul", axis_name, "all_gather")
         xhat = lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
         return jnp.matmul(xhat, w)
+    log_collective_site("all_gather_matmul", axis_name, "ppermute",
+                        chunks=chunks, hops=n - 1)
     r = lax.axis_index(axis_name)
     out = None
     dep = None
@@ -614,8 +676,11 @@ def all_to_all_overlap(x, axis_name, split_axis, concat_axis, chunks=1):
     if n == 1:
         return x
     if chunks <= 1:
+        log_collective_site("all_to_all_overlap", axis_name, "all_to_all")
         return lax.all_to_all(x, axis_name, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
+    log_collective_site("all_to_all_overlap", axis_name, "ppermute",
+                        hops=n - 1)
     size = x.shape[split_axis]
     assert size % n == 0, (
         f"all_to_all_overlap: split dim {size} not divisible by axis "

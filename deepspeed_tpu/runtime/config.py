@@ -415,12 +415,16 @@ class AnalysisConfig:
         self.check_recompile = get_scalar_param(
             sub, ANALYSIS_CHECK_RECOMPILE,
             ANALYSIS_CHECK_RECOMPILE_DEFAULT)
+        self.peak_memory_budget_mb = get_scalar_param(
+            sub, ANALYSIS_PEAK_MEMORY_BUDGET_MB,
+            ANALYSIS_PEAK_MEMORY_BUDGET_MB_DEFAULT)
 
     def __repr__(self):
         return (f"AnalysisConfig(enabled={self.enabled}, "
                 f"fail_on_findings={self.fail_on_findings}, "
                 f"rules={self.rules!r}, "
-                f"check_recompile={self.check_recompile})")
+                f"check_recompile={self.check_recompile}, "
+                f"peak_memory_budget_mb={self.peak_memory_budget_mb})")
 
 
 class TensorParallelConfig:
@@ -805,6 +809,13 @@ class DeepSpeedConfig:
                 raise ValueError(
                     f"analysis: unknown rule id(s) {unknown}; "
                     f"known: {list(RULE_IDS)}")
+        budget = an.peak_memory_budget_mb
+        if not isinstance(budget, (int, float)) or \
+                isinstance(budget, bool) or budget < 0:
+            raise ValueError(
+                f"analysis: peak_memory_budget_mb must be a "
+                f"non-negative number (0 = per-stage default), "
+                f"got {budget!r}")
 
     def _check_elasticity(self):
         from deepspeed_tpu.runtime.elastic.batch import LR_SCALING_RULES

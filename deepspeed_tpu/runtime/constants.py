@@ -291,6 +291,11 @@ ANALYSIS_RULES = "rules"
 ANALYSIS_RULES_DEFAULT = None  # None = the full rule catalog
 ANALYSIS_CHECK_RECOMPILE = "check_recompile"
 ANALYSIS_CHECK_RECOMPILE_DEFAULT = True
+# Explicit per-device peak-memory budget (MB) for the `peak_memory`
+# rule; 0 derives a generous per-ZeRO-stage default from the model's
+# fp32 master footprint (see analysis/rules.py:rule_peak_memory).
+ANALYSIS_PEAK_MEMORY_BUDGET_MB = "peak_memory_budget_mb"
+ANALYSIS_PEAK_MEMORY_BUDGET_MB_DEFAULT = 0
 
 # Manual tensor-parallel tuning (parallel/pipe_tp.py, parallel/sequence.py,
 # moe/expert_pipe.py). The `overlap` block enables the latency-hiding

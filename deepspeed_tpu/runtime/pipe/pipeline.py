@@ -35,6 +35,7 @@ Layer protocol: built layer objects expose ``init(rng, x) -> params`` and
 ``apply(params, x, rng=None) -> y``. Flax modules are adapted automatically.
 """
 
+import contextlib
 import dataclasses
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional
@@ -44,8 +45,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.parallel.collectives import (barrier_after, manual_axes,
-                                                overlap_scope)
+from deepspeed_tpu.parallel.collectives import (barrier_after,
+                                                log_collective_site,
+                                                manual_axes, overlap_scope)
 from deepspeed_tpu.utils.compat import axis_size, shard_map
 from deepspeed_tpu.runtime.pipe.module import LayerSpec, TiedLayerSpec
 
@@ -605,12 +607,51 @@ def _tree_ppermute(tree, perm):
     arrive at one op_id, half at the other) and deadlock. Chaining costs
     nothing — per-tick latency is bounded by the largest leaf anyway."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
+    log_collective_site("pipeline.stage_transfer", "pipe", "ppermute",
+                        chunks=len(leaves),
+                        chained=not _FIXTURE_UNCHAINED_TRANSFER)
     dep, out = None, []
     for leaf in leaves:
+        if _FIXTURE_UNCHAINED_TRANSFER:
+            dep = None
         leaf = lax.ppermute(barrier_after(leaf, dep), "pipe", perm)
         dep = leaf
         out.append(leaf)
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# trace-only regression fixtures: re-introduce the historical deadlocks
+# ---------------------------------------------------------------------------
+# Two scheduling bugs were fixed in the uniform-tick restructure (see the
+# comments inside ``tick`` below):
+#   * the stage transfer sat inside stage-divergent control flow, so some
+#     devices reached the ppermute rendezvous and others did not;
+#   * concurrent in-flight permutes were not chained, splitting the global
+#     rendezvous across op_ids.
+# These flags revert each bug THROUGH THE PRODUCTION CODE PATH so the
+# analysis.jaxpr deadlock checker can be regression-tested against the real
+# pipeline jaxpr, at trace time only. Programs traced under the fixture
+# must never be executed — they are the deadlock.
+_FIXTURE_DIVERGENT_TRANSFER = False
+_FIXTURE_UNCHAINED_TRANSFER = False
+
+
+@contextlib.contextmanager
+def pipeline_trace_fixture(divergent_transfer=False, unchained_transfer=False):
+    """TRACE-ONLY: rebuild the pre-fix divergent/unchained tick schedule.
+
+    The flags are read while ``tick`` traces, so the ``vag`` fn must be
+    built *and traced* (``jax.jit(...).trace`` / ``make_jaxpr``) inside this
+    context. Never run the resulting program."""
+    global _FIXTURE_DIVERGENT_TRANSFER, _FIXTURE_UNCHAINED_TRANSFER
+    prev = (_FIXTURE_DIVERGENT_TRANSFER, _FIXTURE_UNCHAINED_TRANSFER)
+    _FIXTURE_DIVERGENT_TRANSFER = divergent_transfer
+    _FIXTURE_UNCHAINED_TRANSFER = unchained_transfer
+    try:
+        yield
+    finally:
+        _FIXTURE_DIVERGENT_TRANSFER, _FIXTURE_UNCHAINED_TRANSFER = prev
 
 
 # ---------------------------------------------------------------------------
@@ -782,8 +823,19 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
             # rendezvous is per replica group). Bubble ticks and the last
             # stage compute on zeros and the result is discarded.
             y = stage_fwd(body_local, x_in, mb_rng(mf_c, 1))
-            x_next = _tree_ppermute(
-                y, [(i, (i + 1) % S) for i in range(S)])
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            if _FIXTURE_DIVERGENT_TRANSFER:
+                # pre-fix schedule: the transfer only fires on "useful"
+                # ticks — valid_f depends on s (= axis_index("pipe")), so
+                # stages disagree about entering the branch and the
+                # ppermute's global rendezvous deadlocks. Kept compilable
+                # but never executed; exists for the deadlock-rule tests.
+                x_next = lax.cond(
+                    valid_f,
+                    lambda: _tree_ppermute(y, fwd_perm),
+                    lambda: y)
+            else:
+                x_next = _tree_ppermute(y, fwd_perm)
 
             # ---- backward half: microbatch mb = t - (2S-2-s) ---------
             mb_ = t - (2 * S - 2 - s)
@@ -798,8 +850,14 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
             # whole backward half after the forward stage transfer by
             # barriering its inputs — the tick's collectives then form
             # one chain: fwd TP → x_next → bwd TP → g_next.
-            (x_b, g_in), _ = lax.optimization_barrier(
-                ((x_b, g_recv), x_next))
+            if _FIXTURE_UNCHAINED_TRANSFER:
+                # pre-fix schedule: backward half issues with no dataflow
+                # edge on x_next, so its g_next ppermute races the
+                # forward transfer on the global rendezvous. Trace-only.
+                g_in = g_recv
+            else:
+                (x_b, g_in), _ = lax.optimization_barrier(
+                    ((x_b, g_recv), x_next))
 
             # The stage vjp — the piece holding model-axis collectives —
             # runs UNCONDITIONALLY and uniformly across stages (same SPMD

@@ -18,6 +18,8 @@ pipeline's per-tick ``collective-permute`` volume is finally
 expressible. Pass ``trip_aware=False`` for the old flat behavior.
 """
 
+import warnings
+
 from deepspeed_tpu.analysis.hlo import (  # noqa: F401
     _COLLECTIVES,
     _DTYPE_BYTES,
@@ -29,5 +31,10 @@ from deepspeed_tpu.analysis.hlo import (  # noqa: F401
     collective_bytes,
     ring_send_bytes,
 )
+
+warnings.warn(
+    "deepspeed_tpu.utils.hlo_analysis is deprecated; import from "
+    "deepspeed_tpu.analysis.hlo (or deepspeed_tpu.analysis) instead",
+    DeprecationWarning, stacklevel=2)
 
 __all__ = ["collective_bytes", "ring_send_bytes"]

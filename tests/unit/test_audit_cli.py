@@ -106,3 +106,77 @@ def test_pipeline_tp_flavor_cli_clean():
     rep = payload["reports"]["pipeline_tp"]
     assert rep["findings"] == []
     assert rep["stats"]["collective_bytes"].get("collective-permute", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# --hlo mode + JSON exit-code contract
+# ---------------------------------------------------------------------------
+
+BAD_HLO = """\
+HloModule bad_step, is_scheduled=true
+
+ENTRY %main (p0: f32[1024,1024]) -> f32[1024,1024] {
+  %p0 = f32[1024,1024] parameter(0)
+  %tok = token[] after-all()
+  %inf = (f32[1024,1024], token[]) infeed(%tok)
+  %val = f32[1024,1024] get-tuple-element(%inf), index=0
+  ROOT %add = f32[1024,1024] add(%p0, %val)
+}
+"""
+
+CLEAN_HLO = """\
+HloModule clean_step, is_scheduled=true
+
+ENTRY %main (p0: f32[256,256]) -> f32[256,256] {
+  %p0 = f32[256,256] parameter(0)
+  ROOT %add = f32[256,256] add(%p0, %p0)
+}
+"""
+
+
+def test_hlo_mode_json_failing_exit_code_and_schema(tmp_path):
+    """--json mode must still gate the exit code on --fail-on, and the
+    finding schema (rule id / severity / flavor) is pinned here so
+    downstream CI parsers can rely on it."""
+    hlo = tmp_path / "bad.txt"
+    hlo.write_text(BAD_HLO)
+    proc = run_cli("--hlo", str(hlo), "--json", check=False)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = _json_payload(proc.stdout)
+    assert payload["ok"] is False
+    assert payload["fail_on"] == "error"
+    assert payload["failing_findings"] >= 1
+    rep = payload["reports"]["hlo"]
+    assert rep["flavor"] == "custom"
+    finding = rep["findings"][0]
+    assert set(finding) == {"rule", "severity", "message", "details"}
+    assert finding["rule"] == "host_transfer"
+    assert finding["severity"] == "error"
+    # the static peak-memory stats ride every report
+    assert rep["stats"]["peak_memory"]["peak_bytes"] > 0
+
+
+def test_hlo_mode_clean_exit_zero(tmp_path):
+    hlo = tmp_path / "clean.txt"
+    hlo.write_text(CLEAN_HLO)
+    proc = run_cli("--hlo", str(hlo), "--json", "--fail-on", "warning")
+    payload = _json_payload(proc.stdout)
+    assert proc.returncode == 0
+    assert payload["ok"] is True
+    assert payload["findings_total"] == 0
+
+
+def test_memory_table_text_mode(tmp_path):
+    hlo = tmp_path / "clean.txt"
+    hlo.write_text(CLEAN_HLO)
+    proc = run_cli("--hlo", str(hlo), "--memory")
+    assert "static peak memory" in proc.stdout
+    assert "peak" in proc.stdout and "donated" in proc.stdout
+
+
+def test_hlo_and_config_mutually_exclusive(tmp_path):
+    hlo = tmp_path / "clean.txt"
+    hlo.write_text(CLEAN_HLO)
+    proc = run_cli("--hlo", str(hlo), "--config", "x.json", check=False)
+    assert proc.returncode == 2
+    assert "mutually exclusive" in proc.stderr

@@ -30,7 +30,10 @@ from deepspeed_tpu.analysis import (
 from deepspeed_tpu.analysis.audit import STEP_FLAVORS, _lower_step
 from deepspeed_tpu.analysis.rules import (
     SEV_ERROR,
+    SEV_WARNING,
     rule_donation,
+    rule_peak_memory,
+    rule_resharding,
     rule_trip_count,
 )
 
@@ -54,6 +57,11 @@ def test_stock_flavor_audits_clean(flavor):
     assert report.stats["donated_aliased"] == \
         report.stats["donated_expected"]
     assert report.stats["compile_cache_size"] == 1
+    # the trace-time passes ran (not merely skipped) and came back clean
+    assert report.stats["jaxpr"]["divergent_collectives"] == 0
+    assert report.stats["jaxpr"]["unordered_permutes"] == 0
+    # and the static peak estimate is populated for the memory rule
+    assert report.stats["peak_memory"]["peak_bytes"] > 0
     if flavor == "pipeline":
         # the executed-1F1B loops must be statically accountable — this
         # is what makes the collective-permute volume pinnable at all.
@@ -178,6 +186,74 @@ def test_recompile_detected_and_raises_when_configured():
     assert [f.rule for f in check_recompile(engine)] == ["recompile"]
     with pytest.raises(AuditError, match="recompile"):
         engine.train_batch(batch)
+
+
+def test_peak_memory_budget_violation_reported():
+    """The per-stage budget formula: dense (stage 0) allows params +
+    3M optimizer + 3M headroom; ZeRO-1 shards the optimizer term by N.
+    An estimate past the budget is an error; under it, silence."""
+    M = 10 << 20
+    est = {"peak_bytes": 12 * M, "temp_peak_bytes": 11 * M,
+           "parameter_bytes": M, "output_bytes": M,
+           "donated_output_bytes": M}
+    # stage 0 budget = M * (1 + 3 + 3) + slack = ~7M -> 12M violates
+    findings = rule_peak_memory(StepContext(
+        hlo_text="", param_bytes=M, zero_stage=0, peak_memory=est))
+    assert len(findings) == 1 and findings[0].severity == SEV_ERROR
+    assert findings[0].details["budget_bytes"] < 12 * M
+
+    # same estimate under an explicit generous budget: clean
+    assert rule_peak_memory(StepContext(
+        hlo_text="", param_bytes=M, peak_memory=est,
+        peak_budget_bytes=16 * M)) == []
+
+    # ZeRO-1 over 8 devices tightens the optimizer term: a peak that
+    # fits the stage-0 budget can still violate the stage-1 one.
+    est_ok0 = dict(est, peak_bytes=5 * M, temp_peak_bytes=4 * M)
+    assert rule_peak_memory(StepContext(
+        hlo_text="", param_bytes=M, zero_stage=0,
+        peak_memory=est_ok0)) == []
+    assert rule_peak_memory(StepContext(
+        hlo_text="", param_bytes=M, zero_stage=1, n_devices=8,
+        peak_memory=est_ok0))
+
+    # no estimate / no param baseline: rule not applicable
+    assert rule_peak_memory(StepContext(hlo_text="", param_bytes=M)) == []
+    assert rule_peak_memory(StepContext(hlo_text="",
+                                        peak_memory=est)) == []
+
+
+def test_replicated_optimizer_state_reported_under_zero():
+    """A ZeRO run whose optimizer state holds large fully-replicated
+    leaves is paying stage-0 memory while claiming otherwise."""
+    leaves = [{"path": ".m.w", "bytes": 4 << 20, "shape": [1024, 1024]}]
+    findings = rule_resharding(StepContext(
+        hlo_text="", zero_stage=2, n_devices=8,
+        replicated_leaves=leaves))
+    assert len(findings) == 1 and findings[0].severity == SEV_ERROR
+    assert findings[0].details["total_bytes"] == 4 << 20
+    # same leaves are legitimate on a single device or at stage 0
+    assert rule_resharding(StepContext(
+        hlo_text="", zero_stage=0, n_devices=8,
+        replicated_leaves=leaves)) == []
+    assert rule_resharding(StepContext(
+        hlo_text="", zero_stage=2, n_devices=1,
+        replicated_leaves=leaves)) == []
+    # and small replicated leaves are the partitioner's own choice
+    assert rule_resharding(StepContext(
+        hlo_text="", zero_stage=2, n_devices=8,
+        replicated_leaves=[{"path": ".m.b", "bytes": 4096,
+                            "shape": [1024]}])) == []
+
+
+def test_reshard_conflicts_below_threshold_are_noise():
+    events = [{"kind": "conflict", "bytes": 4096, "path": [],
+               "primitive": "add", "dim": 0, "specs": []}]
+    assert rule_resharding(StepContext(
+        hlo_text="", reshard_events=events)) == []
+    findings = rule_resharding(StepContext(
+        hlo_text="", reshard_events=[dict(events[0], bytes=2 << 20)]))
+    assert findings and findings[0].severity == SEV_WARNING
 
 
 def test_unknown_rule_id_rejected_by_config():

@@ -1,0 +1,85 @@
+"""Lint-gate pins (`deepspeed_tpu/analysis/lint.py` / ``ds_tpu_lint``).
+
+The gate prefers ruff (config in pyproject) but must work in
+environments without it — the built-in fallback covers the
+severity-floor codes (syntax errors, trailing whitespace, missing final
+newline) so CI can enforce them anywhere. These tests pin the fallback
+checker and the exit-code contract; the repo itself must pass its own
+gate.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.analysis import lint
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return p
+
+
+def test_clean_file_has_no_findings(tmp_path):
+    p = _write(tmp_path, "ok.py", "x = 1\n")
+    assert lint.check_file(str(p)) == []
+
+
+def test_trailing_whitespace_detected(tmp_path):
+    p = _write(tmp_path, "w.py", "x = 1 \n   \ny = 2\n")
+    codes = [(line, code) for line, code, _ in lint.check_file(str(p))]
+    assert (1, "W291") in codes      # trailing after code
+    assert (2, "W293") in codes      # whitespace-only line
+
+
+def test_missing_final_newline_detected(tmp_path):
+    p = _write(tmp_path, "n.py", "x = 1")
+    codes = [code for _, code, _ in lint.check_file(str(p))]
+    assert codes == ["W292"]
+
+
+def test_syntax_error_detected(tmp_path):
+    p = _write(tmp_path, "s.py", "def f(:\n")
+    codes = [code for _, code, _ in lint.check_file(str(p))]
+    assert "E999" in codes
+
+
+def test_fix_rewrites_whitespace_in_place(tmp_path):
+    p = _write(tmp_path, "f.py", "x = 1 \n   \ny = 2")
+    findings = lint.check_file(str(p), fix=True)
+    assert findings  # reported AND fixed
+    assert p.read_text() == "x = 1\n\ny = 2\n"
+    assert lint.check_file(str(p)) == []
+
+
+def test_iter_python_files_picks_up_shebang_scripts(tmp_path):
+    _write(tmp_path, "mod.py", "x = 1\n")
+    sub = tmp_path / "__pycache__"
+    sub.mkdir()
+    _write(sub, "skip.py", "x = 1\n")
+    script = tmp_path / "tool"
+    script.write_text("#!/usr/bin/env python3\nx = 1\n")
+    names = sorted(f.split("/")[-1]
+                   for f in lint.iter_python_files([str(tmp_path)],
+                                                   str(tmp_path)))
+    assert names == ["mod.py", "tool"]
+
+
+def test_main_builtin_exit_codes(tmp_path):
+    clean = _write(tmp_path, "c.py", "x = 1\n")
+    dirty = _write(tmp_path, "d.py", "x = 1 \n")
+    assert lint.main(["--builtin", str(clean)]) == 0
+    assert lint.main(["--builtin", str(dirty)]) == 1
+
+
+@pytest.mark.slow
+def test_repo_passes_its_own_gate():
+    """The enforced gate: the tree must lint clean (builtin floor; ruff
+    runs the full pyproject config where installed)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.analysis.lint",
+         "--builtin"],
+        cwd=lint.repo_root(), capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

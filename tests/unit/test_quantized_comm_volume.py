@@ -30,7 +30,7 @@ import pytest
 import deepspeed_tpu
 from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2LMHead,
                                        init_gpt2_params, make_gpt2_loss_fn)
-from deepspeed_tpu.utils.hlo_analysis import ring_send_bytes
+from deepspeed_tpu.analysis.hlo import ring_send_bytes
 
 N_DEVICES = 8
 CHUNK = 512

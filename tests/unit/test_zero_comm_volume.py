@@ -28,7 +28,7 @@ param bytes):
 
 import pytest
 
-from deepspeed_tpu.utils.hlo_analysis import collective_bytes, ring_send_bytes
+from deepspeed_tpu.analysis.hlo import collective_bytes, ring_send_bytes
 from tests.unit.zero_fixtures import PARAM_BYTES, lowered_train_step
 
 N_DEVICES = 8
