@@ -50,8 +50,8 @@ from deepspeed_tpu.analysis.rules import (
     run_rules,
 )
 
-# The engine's six stock compiled-step flavors, auditable end-to-end.
-STEP_FLAVORS = ("dense", "zero1", "zero2", "offload", "quantized",
+# The engine's seven stock compiled-step flavors, auditable end-to-end.
+STEP_FLAVORS = ("dense", "zero1", "zero2", "zero3", "offload", "quantized",
                 "pipeline")
 # Extra toy flavors the CLI accepts but the default sweep (and the
 # un-slow flavor test matrix) skips — heavier compiles exercising
@@ -281,6 +281,7 @@ def _engine_context(engine, hlo_text, expected, pinfo, jaxpr_facts=None):
     analysis_cfg = getattr(cfg, "analysis", None)
     budget_mb = float(getattr(analysis_cfg, "peak_memory_budget_mb", 0)
                       or 0)
+    plan = getattr(engine, "_zero3_plan", None)
     return StepContext(
         hlo_text=hlo_text,
         flavor=flavor,
@@ -300,6 +301,9 @@ def _engine_context(engine, hlo_text, expected, pinfo, jaxpr_facts=None):
         jaxpr_unordered=facts.get("unordered"),
         reshard_events=facts.get("reshard_events"),
         collective_sites=facts.get("collective_sites"),
+        zero3_gather_leaves=int(plan.gather_leaves) if plan else 0,
+        zero3_gather_chunks=int(plan.gather_chunks) if plan else 1,
+        zero3_max_gather_bytes=int(plan.max_gather_bytes) if plan else 0,
         replicated_leaves=_replicated_state_leaves(engine),
         peak_memory=estimate_peak_memory(hlo_text),
         peak_budget_bytes=int(budget_mb * (1 << 20)),
@@ -485,6 +489,11 @@ def _dense_family_config(flavor):
     elif flavor in ("zero1", "zero2"):
         cfg["bf16"] = {"enabled": True}
         cfg["zero_optimization"] = {"stage": int(flavor[-1])}
+    elif flavor == "zero3":
+        # Explicit gather-on-use path with ring chunking so the audit
+        # exercises the stage-3 overlap/budget rules end-to-end.
+        cfg["bf16"] = {"enabled": True}
+        cfg["zero_optimization"] = {"stage": 3, "gather_chunks": 2}
     elif flavor == "offload":
         cfg["bf16"] = {"enabled": True}
         cfg["zero_optimization"] = {"stage": 2, "cpu_offload": True}
@@ -500,7 +509,7 @@ def _dense_family_config(flavor):
 
 def build_flavor_engine(flavor, config_overrides=None):
     """``(engine, batch)`` for one stock step flavor, toy-sized so all
-    six compile inside a CPU test budget."""
+    seven compile inside a CPU test budget."""
     import deepspeed_tpu
 
     if flavor == "pipeline":

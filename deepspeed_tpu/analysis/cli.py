@@ -2,7 +2,7 @@
 
 Three modes:
 
-- ``ds_tpu_audit --flavors dense,zero1`` (default: all six stock
+- ``ds_tpu_audit --flavors dense,zero1`` (default: all seven stock
   flavors) — build toy engines per flavor and audit each compiled step.
 - ``ds_tpu_audit --config my_config.json`` — build an engine from a
   user DeepSpeed-style config (with a toy GPT-2 model supplying the
@@ -68,7 +68,7 @@ def main(argv=None):
                              "carries it in stats.peak_memory)")
     parser.add_argument("--flavors", default=None,
                         help="comma-separated stock flavors to audit "
-                             "(default: all six); extra flavors like "
+                             "(default: all seven); extra flavors like "
                              "pipeline_tp (TP overlap) must be named "
                              "explicitly; ignored with --config")
     parser.add_argument("--rules", default=None,

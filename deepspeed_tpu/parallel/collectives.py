@@ -379,6 +379,51 @@ def ring_psum(x, axis_name, chunks=1, bidirectional=False):
     return jnp.concatenate([acc for acc, _ in state], axis=-1)
 
 
+def ring_all_gather(x, axis_name, axis=0, chunks=1, bidirectional=False,
+                    dep=None, site="ring_all_gather"):
+    """Gather every rank's shard of ``x`` along ``axis``, returning
+    ``(gathered, dep)`` where ``dep`` threads the :func:`barrier_after`
+    chain to the caller (pass it into the next gather so consecutive
+    rings issue in a fixed order — the ZeRO-3 prefetch schedule and the
+    CPU-rendezvous safety invariant at once).
+
+    ``chunks <= 1`` is a single tiled ``lax.all_gather`` — bit-identical
+    to the spec-sharded baseline's gather. ``chunks > 1`` splits the
+    local shard into stripes, each rotated around the ring by n-1
+    dep-chained ``ppermute`` hops and placed into the output at its
+    owner's offset, so stripe transfers interleave with the consuming
+    compute instead of blocking on one monolithic collective.
+    ``bidirectional`` alternates ring direction per stripe."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return x, dep
+    k_loc = x.shape[axis]
+    if chunks <= 1 or k_loc < 2:
+        log_collective_site(site, axis_name, "all_gather")
+        out = lax.all_gather(barrier_after(x, dep), axis_name,
+                             axis=axis, tiled=True)
+        return out, out
+    slices = _chunk_slices(k_loc, chunks)
+    log_collective_site(site, axis_name, "ppermute",
+                        chunks=len(slices), hops=n - 1)
+    r = lax.axis_index(axis_name)
+    out_shape = list(x.shape)
+    out_shape[axis] = n * k_loc
+    out = jnp.zeros(out_shape, x.dtype)
+    for j, (st, sz) in enumerate(slices):
+        rev = bidirectional and j % 2 == 1
+        shift = -1 if rev else 1
+        perm = _ring_perm(n, rev)
+        buf = lax.slice_in_dim(x, st, st + sz, axis=axis)
+        for h in range(n):
+            if h:
+                buf, dep = _ordered_ppermute(buf, axis_name, perm, dep)
+            src = jnp.mod(r - shift * h, n)   # owner of the stripe in buf
+            out = lax.dynamic_update_slice_in_dim(
+                out, buf, src * k_loc + st, axis=axis)
+    return out, dep
+
+
 # ---------------------------------------------------------------------------
 # collective matmul: psum / reduce-scatter / all-gather forms
 # ---------------------------------------------------------------------------

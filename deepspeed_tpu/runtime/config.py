@@ -740,6 +740,37 @@ class DeepSpeedConfig:
         self._check_elasticity()
         self._check_analysis()
         self._check_tensor_parallel()
+        self._check_zero3()
+
+    def _check_zero3(self):
+        zc = self.zero_config
+
+        def _bool(name, v):
+            if not isinstance(v, bool):
+                raise ValueError(
+                    f"zero_optimization: {name} must be a bool, got {v!r}")
+
+        _bool("gather_on_use", zc.gather_on_use)
+        _bool("prefetch", zc.prefetch)
+        _bool("bidirectional", zc.bidirectional)
+        chunks = zc.gather_chunks
+        if isinstance(chunks, bool) or not isinstance(chunks, int) or \
+                chunks < 1:
+            raise ValueError(
+                f"zero_optimization: gather_chunks must be an int >= 1, "
+                f"got {chunks!r}")
+        if chunks > 1 and not zc.prefetch:
+            # The prefetch dep-chain doubles as the rendezvous-safety
+            # invariant for the ppermute rings: with it off, two stripes'
+            # rings could be in flight concurrently.
+            raise ValueError(
+                "zero_optimization: gather_chunks > 1 requires "
+                "prefetch=true (the dep-chain orders the ppermute rings)")
+        if chunks > 1 and not zc.gather_on_use:
+            raise ValueError(
+                "zero_optimization: gather_chunks > 1 requires "
+                "gather_on_use=true (the legacy spec-sharded path has no "
+                "ring schedule to chunk)")
 
     def _check_tensor_parallel(self):
         from deepspeed_tpu.parallel.collectives import OVERLAP_SITES

@@ -9,6 +9,14 @@ from deepspeed_tpu.runtime.config_utils import get_scalar_param
 from deepspeed_tpu.runtime.zero.constants import (
     ZERO_FORMAT,
     ZERO_OPTIMIZATION,
+    ZERO_OPTIMIZATION_BIDIRECTIONAL,
+    ZERO_OPTIMIZATION_BIDIRECTIONAL_DEFAULT,
+    ZERO_OPTIMIZATION_GATHER_CHUNKS,
+    ZERO_OPTIMIZATION_GATHER_CHUNKS_DEFAULT,
+    ZERO_OPTIMIZATION_GATHER_ON_USE,
+    ZERO_OPTIMIZATION_GATHER_ON_USE_DEFAULT,
+    ZERO_OPTIMIZATION_PREFETCH,
+    ZERO_OPTIMIZATION_PREFETCH_DEFAULT,
     ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE,
     ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT,
     ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED,
@@ -52,6 +60,10 @@ class DeepSpeedZeroConfig:
         self.offload_16bit_grads = None
         self.offload_chunk_mb = None
         self.elastic_checkpoint = None
+        self.gather_on_use = None
+        self.gather_chunks = None
+        self.prefetch = None
+        self.bidirectional = None
 
         if ZERO_OPTIMIZATION in param_dict:
             zero_config_dict = param_dict[ZERO_OPTIMIZATION]
@@ -121,6 +133,22 @@ class DeepSpeedZeroConfig:
             zero_config_dict,
             ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,
             ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT)
+        self.gather_on_use = get_scalar_param(
+            zero_config_dict,
+            ZERO_OPTIMIZATION_GATHER_ON_USE,
+            ZERO_OPTIMIZATION_GATHER_ON_USE_DEFAULT)
+        self.gather_chunks = get_scalar_param(
+            zero_config_dict,
+            ZERO_OPTIMIZATION_GATHER_CHUNKS,
+            ZERO_OPTIMIZATION_GATHER_CHUNKS_DEFAULT)
+        self.prefetch = get_scalar_param(
+            zero_config_dict,
+            ZERO_OPTIMIZATION_PREFETCH,
+            ZERO_OPTIMIZATION_PREFETCH_DEFAULT)
+        self.bidirectional = get_scalar_param(
+            zero_config_dict,
+            ZERO_OPTIMIZATION_BIDIRECTIONAL,
+            ZERO_OPTIMIZATION_BIDIRECTIONAL_DEFAULT)
 
     def repr(self):
         return self.__dict__

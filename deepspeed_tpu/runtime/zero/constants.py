@@ -16,7 +16,11 @@ ZeRO optimization should be enabled as:
   "overlap_comm": [true|false],
   "reduce_bucket_size": 500000000,
   "load_from_fp32_weights": [true|false],
-  "cpu_offload": [true|false]
+  "cpu_offload": [true|false],
+  "gather_on_use": [true|false],
+  "gather_chunks": 1,
+  "prefetch": [true|false],
+  "bidirectional": [true|false]
 }
 """
 
@@ -75,6 +79,33 @@ ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT = True
 ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB = "offload_chunk_mb"
 ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB_DEFAULT = 64
 
+# --- stage-3 gather-on-use schedule ---------------------------------------
+# When True (and stage >= 3 with a 16-bit compute dtype), parameters are
+# all-gathered explicitly at point of use through the ring primitives in
+# `parallel/collectives.py` instead of leaving gather placement to GSPMD.
+# False falls back to the legacy spec-sharded caster
+# (`zero/sharding.py:make_param_caster`) — kept as the A/B baseline.
+ZERO_OPTIMIZATION_GATHER_ON_USE = "gather_on_use"
+ZERO_OPTIMIZATION_GATHER_ON_USE_DEFAULT = True
+
+# Ring chunking of each per-leaf gather: 1 = a single tiled all-gather
+# (bit-identical to the spec-sharded baseline); k > 1 splits every leaf
+# into k stripes moved by dep-chained ppermute rings so stripe transfers
+# interleave with the consuming matmuls.
+ZERO_OPTIMIZATION_GATHER_CHUNKS = "gather_chunks"
+ZERO_OPTIMIZATION_GATHER_CHUNKS_DEFAULT = 1
+
+# Dep-chain the per-leaf gathers so leaf i+1's gather is issued behind
+# leaf i's (the prefetch schedule). Required when gather_chunks > 1: the
+# chain is also the rendezvous-safety invariant for concurrent rings.
+ZERO_OPTIMIZATION_PREFETCH = "prefetch"
+ZERO_OPTIMIZATION_PREFETCH_DEFAULT = True
+
+# Alternate ring direction per chunk so both link directions carry
+# stripes simultaneously (even stripes clockwise, odd counter-clockwise).
+ZERO_OPTIMIZATION_BIDIRECTIONAL = "bidirectional"
+ZERO_OPTIMIZATION_BIDIRECTIONAL_DEFAULT = False
+
 ZERO_OPTIMIZATION_DEFAULT = {
     ZERO_OPTIMIZATION_STAGE: ZERO_OPTIMIZATION_STAGE_DEFAULT,
     ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS:
@@ -95,4 +126,11 @@ ZERO_OPTIMIZATION_DEFAULT = {
         ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT,
     ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB:
         ZERO_OPTIMIZATION_OFFLOAD_CHUNK_MB_DEFAULT,
+    ZERO_OPTIMIZATION_GATHER_ON_USE:
+        ZERO_OPTIMIZATION_GATHER_ON_USE_DEFAULT,
+    ZERO_OPTIMIZATION_GATHER_CHUNKS:
+        ZERO_OPTIMIZATION_GATHER_CHUNKS_DEFAULT,
+    ZERO_OPTIMIZATION_PREFETCH: ZERO_OPTIMIZATION_PREFETCH_DEFAULT,
+    ZERO_OPTIMIZATION_BIDIRECTIONAL:
+        ZERO_OPTIMIZATION_BIDIRECTIONAL_DEFAULT,
 }

@@ -45,11 +45,13 @@ CONFIGS = [
     {},
     {"bf16": {"enabled": True}, "zero_optimization": {"stage": 1}},
     {"bf16": {"enabled": True}, "zero_optimization": {"stage": 2}},
+    {"bf16": {"enabled": True}, "zero_optimization": {"stage": 3}},
     {"bf16": {"enabled": True},
      "zero_optimization": {"stage": 2, "cpu_offload": True,
                            "offload_chunk_mb": 1}},
 ]
-IDS = ["fp32-dense", "bf16-zero1", "bf16-zero2", "bf16-offload"]
+IDS = ["fp32-dense", "bf16-zero1", "bf16-zero2", "bf16-zero3",
+       "bf16-offload"]
 
 
 def make_engine(world, seed=0, resilience=None, elasticity=None,

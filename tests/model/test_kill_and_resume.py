@@ -30,11 +30,15 @@ TOTAL, KILL_AT = 12, 5
 CONFIGS = [
     {},
     {"bf16": {"enabled": True}, "zero_optimization": {"stage": 2}},
+    {"bf16": {"enabled": True}, "zero_optimization": {"stage": 3}},
+    {"bf16": {"enabled": True},
+     "zero_optimization": {"stage": 3, "gather_chunks": 2}},
     {"bf16": {"enabled": True},
      "zero_optimization": {"stage": 2, "cpu_offload": True,
                            "offload_chunk_mb": 1}},
 ]
-IDS = ["fp32-dense", "bf16-zero2", "bf16-offload"]
+IDS = ["fp32-dense", "bf16-zero2", "bf16-zero3", "bf16-zero3-rings",
+       "bf16-offload"]
 
 
 def make_engine(seed=0, resilience=None, **overrides):

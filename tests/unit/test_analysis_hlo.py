@@ -266,14 +266,15 @@ def test_headerless_snippet_peak_is_flat():
 def test_peak_memory_orders_dense_above_zero_stages():
     """The ZeRO claim, statically: sharding optimizer state across the
     8-device data axis must lower the per-device static peak — dense >
-    ZeRO-1 >= ZeRO-2 — and each estimate must sit inside the tolerance
-    band of XLA's own buffer assignment (liveness is an upper bound;
-    buffer reuse can only push the real number down)."""
+    ZeRO-1 >= ZeRO-2 > ZeRO-3 (stage 3 additionally shards the fp32
+    params and gathers on use) — and each estimate must sit inside the
+    tolerance band of XLA's own buffer assignment (liveness is an upper
+    bound; buffer reuse can only push the real number down)."""
     from deepspeed_tpu.analysis.audit import (
         _engine_fn_args, build_flavor_engine)
 
     peaks, ratios = {}, {}
-    for flavor in ("dense", "zero1", "zero2"):
+    for flavor in ("dense", "zero1", "zero2", "zero3"):
         engine, batch = build_flavor_engine(flavor)
         engine.train_batch(batch)
         placed = engine._shard_batch(batch)
@@ -287,6 +288,7 @@ def test_peak_memory_orders_dense_above_zero_stages():
 
     assert peaks["dense"] > peaks["zero1"], peaks
     assert peaks["zero1"] >= peaks["zero2"], peaks
+    assert peaks["zero2"] > peaks["zero3"], peaks
     # dense-family ratios measure ~1.0 on CPU; keep a band wide enough
     # for backend drift but tight enough to catch a broken walk.
     for flavor, r in ratios.items():

@@ -40,8 +40,8 @@ from deepspeed_tpu.analysis.rules import (
 # Donated buffers per flavor: params + opt m/v (+ dstate); a floor, not
 # an exact count, so model tweaks don't churn the pin. The offload grad
 # step donates only device_state (params stay, masters live on host).
-_MIN_DONATED = {"dense": 8, "zero1": 8, "zero2": 8, "offload": 1,
-                "quantized": 8, "pipeline": 8}
+_MIN_DONATED = {"dense": 8, "zero1": 8, "zero2": 8, "zero3": 8,
+                "offload": 1, "quantized": 8, "pipeline": 8}
 
 
 @pytest.mark.parametrize("flavor", STEP_FLAVORS)
@@ -67,6 +67,39 @@ def test_stock_flavor_audits_clean(flavor):
         # is what makes the collective-permute volume pinnable at all.
         assert report.stats["while_loops"] >= 1
         assert report.stats["unknown_trip_counts"] == 0
+
+
+def test_zero3_flavor_wire_volume_pins():
+    """The gather-on-use stage-3 step (gather_chunks=2) must move params
+    as ppermute ring stripes — per-leaf, per-layer — never as a bulk
+    all-gather, and its total wire volume must stay inside the ZeRO
+    paper's envelope."""
+    engine, batch = build_flavor_engine("zero3")
+    report = audit_engine(engine, batch)
+    assert report.findings == [], report.to_text()
+    plan = engine._zero3_plan
+    assert plan is not None and plan.gather_chunks == 2
+    assert plan.gather_leaves == 8       # 4 toy layers x (kernel, bias)
+    cb = report.stats["collective_bytes"]
+    m = report.stats["param_bytes"]
+    # every gather became a ring: zero whole-leaf all-gathers remain
+    assert cb.get("all-gather", 0) == 0, cb
+    # ring volume = one param-sized pass (f32-widened worst case on the
+    # CPU partitioner, which sinks the 16-bit cast through the permute)
+    assert 0 < cb["collective-permute"] <= m + m // 4, (cb, m)
+    # ring op count: leaves x chunks x (n_devices - 1) hops, counted
+    # from a fresh lowering (report stats don't carry the HLO text)
+    from deepspeed_tpu.analysis.audit import _engine_fn_args
+    from deepspeed_tpu.analysis.hlo import collective_counts
+    placed = engine._shard_batch(batch)
+    fn, args = _engine_fn_args(engine, placed, jax.random.PRNGKey(0),
+                               jnp.asarray(1e-3, jnp.float32))
+    counts = collective_counts(fn.lower(*args).compile().as_text())
+    n = 8
+    assert counts.get("collective-permute", 0) == \
+        plan.gather_leaves * plan.gather_chunks * (n - 1), counts
+    # grand total inside the 3Psi-ish stage-3 budget the rule enforces
+    assert cb["total"] <= int(3.2 * m), (cb, m)
 
 
 def test_pipeline_permute_volume_trip_aware():
@@ -253,6 +286,100 @@ def test_reshard_conflicts_below_threshold_are_noise():
         hlo_text="", reshard_events=events)) == []
     findings = rule_resharding(StepContext(
         hlo_text="", reshard_events=[dict(events[0], bytes=2 << 20)]))
+    assert findings and findings[0].severity == SEV_WARNING
+
+
+def test_zero3_upfront_full_gather_is_reported():
+    """A stage-3 program that all-gathers the whole param tree in one op
+    (the spec-sharded regression the explicit schedule exists to
+    prevent) must trip the per-leaf gather allowance; a layer-by-layer
+    schedule of the declared shape audits clean."""
+    M = 1 << 20   # fp32 master bytes
+    leaf = 64 << 10   # largest declared per-leaf gather (compute dtype)
+    # one monolithic bf16 gather moving ~the whole tree at once
+    upfront = """
+  %ag = bf16[524288]{0} all-gather(bf16[65536]{0} %p0)
+"""
+    report = audit_hlo(upfront, rules=["zero_budget"], zero_stage=3,
+                       param_bytes=M, n_devices=8,
+                       zero3_gather_leaves=8, zero3_gather_chunks=1,
+                       zero3_max_gather_bytes=leaf)
+    assert any("up-front full-param gather" in f.message
+               and f.severity == SEV_ERROR
+               for f in report.findings), report.to_text()
+
+    # eight per-leaf gathers of the declared size: clean
+    per_leaf = "".join(
+        f"\n  %ag{i} = bf16[32768]{{0}} all-gather(bf16[4096]{{0}} %p{i})"
+        for i in range(8))
+    assert audit_hlo(per_leaf, rules=["zero_budget"], zero_stage=3,
+                     param_bytes=M, n_devices=8,
+                     zero3_gather_leaves=8, zero3_gather_chunks=1,
+                     zero3_max_gather_bytes=leaf).findings == []
+
+    # fewer gather-family ops than declared leaves: the schedule was
+    # coalesced away — reported even when each op is small enough.
+    coalesced = """
+  %ag = bf16[32768]{0} all-gather(bf16[4096]{0} %p0)
+"""
+    report = audit_hlo(coalesced, rules=["zero_budget"], zero_stage=3,
+                       param_bytes=M, n_devices=8,
+                       zero3_gather_leaves=8, zero3_gather_chunks=1,
+                       zero3_max_gather_bytes=leaf)
+    assert any(f.severity == SEV_ERROR for f in report.findings), \
+        report.to_text()
+
+
+def test_zero3_ring_chunking_must_reach_hlo():
+    """gather_chunks > 1 promises ppermute ring stripes; a lowered step
+    with no collective-permutes regressed to monolithic gathers."""
+    no_rings = """
+  %ag = bf16[32768]{0} all-gather(bf16[4096]{0} %p0)
+"""
+    report = audit_hlo(no_rings, rules=["overlap"], zero_stage=3,
+                       n_devices=8, zero3_gather_leaves=8,
+                       zero3_gather_chunks=2,
+                       zero3_max_gather_bytes=64 << 10)
+    assert any(f.rule == "overlap" and f.severity == SEV_ERROR
+               for f in report.findings), report.to_text()
+    # chunks=1 promises no rings: nothing to check
+    assert audit_hlo(no_rings, rules=["overlap"], zero_stage=3,
+                     n_devices=8, zero3_gather_leaves=8,
+                     zero3_gather_chunks=1,
+                     zero3_max_gather_bytes=64 << 10).findings == []
+
+
+def test_zero3_registered_gather_sites_exempt_resharding():
+    """Satellite contract: conflict-sized reshard events attributable to
+    the *registered* zero3 gather/re-shard schedule (SiteRecord log) are
+    exempt; the same events on a stage-3 trace that registered NO zero3
+    sites still fire — an unregistered gather is exactly the regression
+    the rule polices."""
+    leaf = 2 << 20   # declared max per-leaf gather, above the rule's
+    # 1MB conflict-noise threshold so the events are reportable at all
+    events = [{"kind": "conflict", "bytes": leaf, "path": [],
+               "primitive": "dot_general", "dim": 0, "specs": []}]
+    sites = [{"site": "zero3_gather", "axis": "data",
+              "primitive": "all_gather", "chunks": 1, "hops": 1,
+              "chained": True}]
+    # registered: attributed and exempt
+    assert rule_resharding(StepContext(
+        hlo_text="", zero_stage=3, n_devices=8,
+        zero3_max_gather_bytes=leaf,
+        collective_sites=sites, reshard_events=events)) == []
+    # same events, no zero3 sites in the trace: fires
+    findings = rule_resharding(StepContext(
+        hlo_text="", zero_stage=3, n_devices=8,
+        zero3_max_gather_bytes=leaf,
+        collective_sites=[], reshard_events=events))
+    assert findings and findings[0].severity == SEV_WARNING
+    # registered but the event is bigger than the declared schedule
+    # accounts for: still fires
+    big = [dict(events[0], bytes=4 * leaf)]
+    findings = rule_resharding(StepContext(
+        hlo_text="", zero_stage=3, n_devices=8,
+        zero3_max_gather_bytes=leaf,
+        collective_sites=sites, reshard_events=big))
     assert findings and findings[0].severity == SEV_WARNING
 
 
