@@ -71,6 +71,28 @@ def _peak_hbm(jax):
         return None
 
 
+_BENCH_SESSION = None
+
+
+def _bench_session():
+    """Telemetry session for per-step bench timings, built once when
+    ``BENCH_TELEMETRY_JSONL`` names an output path; None otherwise so the
+    timed loop stays exactly as un-instrumented as before. Installed as
+    the process default so subsystem events (e.g. reshard) land in the
+    same log."""
+    global _BENCH_SESSION
+    if _BENCH_SESSION is not None:
+        return _BENCH_SESSION
+    path = os.environ.get("BENCH_TELEMETRY_JSONL")
+    if not path:
+        return None
+    from deepspeed_tpu.telemetry import (
+        JsonlExporter, TelemetrySession, set_default_session)
+    _BENCH_SESSION = TelemetrySession(exporters=[JsonlExporter(path)])
+    set_default_session(_BENCH_SESSION, replace=False)
+    return _BENCH_SESSION
+
+
 def time_engine_steps(engine, batch, steps, warmup=2, track_host=False):
     """Warm up, then time `steps` train_batch calls. float() forces full
     materialization — on the axon relay, block_until_ready alone can
@@ -84,6 +106,8 @@ def time_engine_steps(engine, batch, steps, warmup=2, track_host=False):
         float(engine.train_batch(batch))
         hb(f"warmup step {i + 1}/{warmup} done")
     hb(f"timing {steps} steps")
+    session = _bench_session()
+    walls = [] if session is not None else None
     t0 = time.perf_counter()
     loss = None
     host_s = 0.0
@@ -92,12 +116,26 @@ def time_engine_steps(engine, batch, steps, warmup=2, track_host=False):
             # reset first: overflow-skipped steps bypass the host phase
             # and would otherwise re-count the previous step's time
             engine.last_host_phase_s = 0.0
+        it0 = time.perf_counter() if walls is not None else 0.0
         loss = engine.train_batch(batch)
+        if walls is not None:
+            walls.append(time.perf_counter() - it0)
         if track_host:
             host_s += engine.last_host_phase_s
     float(loss)
     hb("timed block done")
     dt = time.perf_counter() - t0
+    if session is not None:
+        # Emitted AFTER the timed block — the loop must not gain per-step
+        # syncs or I/O that would change the measured perf. Each wall is
+        # one train_batch call's host dispatch time (async; the device
+        # sync lands in the block total), flagged as such.
+        for i, w in enumerate(walls):
+            session.emit("bench_step", i=i, wall_s=round(w, 6),
+                         dispatch_only=True)
+        session.emit("bench_block", steps=steps, wall_s=round(dt, 6),
+                     step_mean_s=round(dt / steps, 6),
+                     host_s=round(host_s, 6) if track_host else None)
     return (dt, host_s) if track_host else dt
 
 
@@ -270,6 +308,8 @@ def init_backend_with_retry(retries=5, delay=10.0):
             # fields so the driver's BENCH_r*.json needs no string match.
             cached["live"] = False
             cached["last_live"] = last_live
+            cached["stale"] = True
+            cached["cache_timestamp"] = last_live
             emit(cached)
             raise SystemExit(0)
         os.environ["JAX_PLATFORMS"] = "cpu"

@@ -151,8 +151,12 @@ def main(argv=None):
     n_findings = sum(len(rep.findings) for rep in reports.values())
 
     if args.as_json:
+        # Same schema tag as the telemetry event log so downstream
+        # tooling can join audit output with run telemetry by version.
+        from deepspeed_tpu.telemetry.events import SCHEMA_VERSION
         print(json.dumps(
-            {"reports": {k: rep.to_dict() for k, rep in reports.items()},
+            {"schema": SCHEMA_VERSION,
+             "reports": {k: rep.to_dict() for k, rep in reports.items()},
              "findings_total": n_findings,
              "failing_findings": n_failing,
              "fail_on": args.fail_on,

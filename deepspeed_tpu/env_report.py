@@ -85,7 +85,7 @@ def main(out=sys.stdout, argv=None):
     args = parser.parse_args(argv)
     op_report(out=out)
     debug_report(out=out)
-    from deepspeed_tpu.utils.profiler import device_report
+    from deepspeed_tpu.telemetry.profiler import device_report
     device_report(out=out)
     if args.perf:
         import json

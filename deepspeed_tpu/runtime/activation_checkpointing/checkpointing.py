@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from deepspeed_tpu.runtime.activation_checkpointing.config import (
     DeepSpeedActivationCheckpointingConfig)
 from deepspeed_tpu.utils.logging import logger
-from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+from deepspeed_tpu.telemetry.timers import SynchronizedWallClockTimer
 
 __all__ = [
     "configure", "is_configured", "reset", "checkpoint",

@@ -427,6 +427,52 @@ class AnalysisConfig:
                 f"peak_memory_budget_mb={self.peak_memory_budget_mb})")
 
 
+class TelemetryConfig:
+    """Typed view of the ``telemetry`` block: the unified runtime
+    telemetry session (`deepspeed_tpu/telemetry/`) — metrics registry,
+    step-phase spans, schema-versioned JSONL event log, and the
+    JSONL/console/Prometheus-textfile exporters the ``ds_tpu_metrics``
+    CLI and scrapers read. See docs/observability.md."""
+
+    KEYS = (TELEMETRY_ENABLED, TELEMETRY_JSONL_PATH, TELEMETRY_CONSOLE,
+            TELEMETRY_PROMETHEUS_TEXTFILE, TELEMETRY_PROMETHEUS_WRITE_EVERY,
+            TELEMETRY_HISTORY, TELEMETRY_STAMP_STATIC_FACTS,
+            TELEMETRY_FLOPS_PER_TOKEN)
+
+    def __init__(self, param_dict):
+        sub = param_dict.get(TELEMETRY, {}) or {}
+        self._given_keys = tuple(sub)
+        self.enabled = get_scalar_param(sub, TELEMETRY_ENABLED,
+                                        TELEMETRY_ENABLED_DEFAULT)
+        self.jsonl_path = get_scalar_param(sub, TELEMETRY_JSONL_PATH,
+                                           TELEMETRY_JSONL_PATH_DEFAULT)
+        self.console = get_scalar_param(sub, TELEMETRY_CONSOLE,
+                                        TELEMETRY_CONSOLE_DEFAULT)
+        self.prometheus_textfile = get_scalar_param(
+            sub, TELEMETRY_PROMETHEUS_TEXTFILE,
+            TELEMETRY_PROMETHEUS_TEXTFILE_DEFAULT)
+        self.prometheus_write_every = get_scalar_param(
+            sub, TELEMETRY_PROMETHEUS_WRITE_EVERY,
+            TELEMETRY_PROMETHEUS_WRITE_EVERY_DEFAULT)
+        self.history = get_scalar_param(sub, TELEMETRY_HISTORY,
+                                        TELEMETRY_HISTORY_DEFAULT)
+        self.stamp_static_facts = get_scalar_param(
+            sub, TELEMETRY_STAMP_STATIC_FACTS,
+            TELEMETRY_STAMP_STATIC_FACTS_DEFAULT)
+        self.flops_per_token = get_scalar_param(
+            sub, TELEMETRY_FLOPS_PER_TOKEN,
+            TELEMETRY_FLOPS_PER_TOKEN_DEFAULT)
+
+    def __repr__(self):
+        return (f"TelemetryConfig(enabled={self.enabled}, "
+                f"jsonl_path={self.jsonl_path!r}, "
+                f"console={self.console}, "
+                f"prometheus_textfile={self.prometheus_textfile!r}, "
+                f"history={self.history}, "
+                f"stamp_static_facts={self.stamp_static_facts}, "
+                f"flops_per_token={self.flops_per_token})")
+
+
 class TensorParallelConfig:
     """Typed view of the ``tensor_parallel`` block. Its ``overlap``
     sub-block opts the manual-mode TP/SP/MoE layers into the
@@ -595,6 +641,7 @@ class DeepSpeedConfig:
         self.resilience = ResilienceConfig(param_dict)
         self.elasticity = ElasticityConfig(param_dict)
         self.analysis = AnalysisConfig(param_dict)
+        self.telemetry = TelemetryConfig(param_dict)
         self.tensor_parallel = TensorParallelConfig(param_dict)
         # Set by the elastic batch solver when the target batch cannot
         # factor exactly at this world size; the engine multiplies it
@@ -739,6 +786,7 @@ class DeepSpeedConfig:
         self._check_resilience()
         self._check_elasticity()
         self._check_analysis()
+        self._check_telemetry()
         self._check_tensor_parallel()
         self._check_zero3()
 
@@ -847,6 +895,39 @@ class DeepSpeedConfig:
                 f"analysis: peak_memory_budget_mb must be a "
                 f"non-negative number (0 = per-stage default), "
                 f"got {budget!r}")
+
+    def _check_telemetry(self):
+        tl = self.telemetry
+        unknown = sorted(set(tl._given_keys) - set(tl.KEYS))
+        if unknown:
+            raise ValueError(
+                f"telemetry: unknown key(s) {unknown}; "
+                f"allowed: {sorted(tl.KEYS)}")
+        for name, v in (("enabled", tl.enabled),
+                        ("console", tl.console),
+                        ("stamp_static_facts", tl.stamp_static_facts)):
+            if not isinstance(v, bool):
+                raise ValueError(
+                    f"telemetry: {name} must be a bool, got {v!r}")
+        for name, v in (("jsonl_path", tl.jsonl_path),
+                        ("prometheus_textfile", tl.prometheus_textfile)):
+            if v is not None and not isinstance(v, str):
+                raise ValueError(
+                    f"telemetry: {name} must be a path string or null, "
+                    f"got {v!r}")
+        for name, v, lo in (
+                ("history", tl.history, 1),
+                ("prometheus_write_every", tl.prometheus_write_every, 1)):
+            if isinstance(v, bool) or not isinstance(v, int) or v < lo:
+                raise ValueError(
+                    f"telemetry: {name} must be an int >= {lo}, "
+                    f"got {v!r}")
+        fpt = tl.flops_per_token
+        if isinstance(fpt, bool) or \
+                not isinstance(fpt, (int, float)) or fpt < 0:
+            raise ValueError(
+                f"telemetry: flops_per_token must be a non-negative "
+                f"number (0 = unknown), got {fpt!r}")
 
     def _check_elasticity(self):
         from deepspeed_tpu.runtime.elastic.batch import LR_SCALING_RULES

@@ -314,3 +314,32 @@ TP_OVERLAP_BIDIRECTIONAL = "bidirectional"
 TP_OVERLAP_BIDIRECTIONAL_DEFAULT = False
 TP_OVERLAP_SITES = "sites"
 TP_OVERLAP_SITES_DEFAULT = None  # None = no per-site overrides
+
+# Runtime telemetry (deepspeed_tpu/telemetry): structured metrics
+# registry, step-phase spans, and the schema-versioned JSONL event log
+# the ds_tpu_metrics CLI reads. Disabled by default — the engine's hot
+# path then pays one no-op check per phase. See docs/observability.md.
+TELEMETRY = "telemetry"
+TELEMETRY_ENABLED = "enabled"
+TELEMETRY_ENABLED_DEFAULT = False
+TELEMETRY_JSONL_PATH = "jsonl_path"
+TELEMETRY_JSONL_PATH_DEFAULT = None  # None = in-memory ring only
+TELEMETRY_CONSOLE = "console"
+TELEMETRY_CONSOLE_DEFAULT = False
+TELEMETRY_PROMETHEUS_TEXTFILE = "prometheus_textfile"
+TELEMETRY_PROMETHEUS_TEXTFILE_DEFAULT = None
+TELEMETRY_PROMETHEUS_WRITE_EVERY = "prometheus_write_every"
+TELEMETRY_PROMETHEUS_WRITE_EVERY_DEFAULT = 20
+# Bounded event ring (engine.metrics_history): last N step events kept
+# in memory so tests/health guards can assert without file I/O.
+TELEMETRY_HISTORY = "history"
+TELEMETRY_HISTORY_DEFAULT = 256
+# Stamp compile-time static facts (collective bytes/counts, static peak
+# memory) into one `compile` event. Free when the analysis block already
+# audited the step; otherwise costs one extra lowering at first compile.
+TELEMETRY_STAMP_STATIC_FACTS = "stamp_static_facts"
+TELEMETRY_STAMP_STATIC_FACTS_DEFAULT = True
+# Model flops per token for the MFU estimate (0 = unknown; the
+# ds_tpu_metrics CLI can also supply it at read time).
+TELEMETRY_FLOPS_PER_TOKEN = "flops_per_token"
+TELEMETRY_FLOPS_PER_TOKEN_DEFAULT = 0

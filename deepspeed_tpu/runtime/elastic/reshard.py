@@ -20,6 +20,7 @@ Two consumers:
 import logging
 import os
 import shutil
+import time
 
 import numpy as np
 import jax
@@ -114,6 +115,7 @@ def reshard_checkpoint(src_dir, dst_dir, target_world, tag=None,
     if target_world < 1:
         raise ValueError(f"target world size must be >= 1, "
                          f"got {target_world}")
+    t0 = time.perf_counter()
     src_mgr = CheckpointManager(save_dir=src_dir, io_retries=io_retries,
                                 io_retry_base_s=io_retry_base_s,
                                 process_index=0, process_count=1)
@@ -169,7 +171,7 @@ def reshard_checkpoint(src_dir, dst_dir, target_world, tag=None,
 
     n_bytes = sum(int(np.asarray(leaf).nbytes)
                   for leaf in jax.tree_util.tree_leaves(state))
-    return {
+    summary = {
         "tag": resolved,
         "src_path": src_path,
         "dst_path": dst_path,
@@ -177,4 +179,13 @@ def reshard_checkpoint(src_dir, dst_dir, target_world, tag=None,
         "target_world": target_world,
         "n_leaves": len(jax.tree_util.tree_leaves(state)),
         "state_bytes": n_bytes,
+        "wall_s": round(time.perf_counter() - t0, 6),
     }
+    # Offline resharding has no engine; log through the process-default
+    # telemetry session when one exists (an engine in this process, or a
+    # caller that installed one for the CLI).
+    from deepspeed_tpu.telemetry import get_default_session
+    session = get_default_session()
+    if session is not None:
+        session.emit("reshard", **summary)
+    return summary

@@ -17,6 +17,7 @@ compatibility shim; ``train_batch`` is the fast path (one XLA program per
 global batch).
 """
 
+import collections
 import os
 import json
 import signal
@@ -68,9 +69,12 @@ from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
 from deepspeed_tpu.ops.adam.fused_adam import adam_update, init_adam_state
 from deepspeed_tpu.ops.lamb.fused_lamb import init_lamb_state, lamb_update
 from deepspeed_tpu.parallel.mesh import build_mesh
+from deepspeed_tpu.telemetry import (
+    TelemetrySession, TraceProfiler, null_span, set_default_session)
+from deepspeed_tpu.telemetry.timers import (
+    SynchronizedWallClockTimer, ThroughputTimer)
 from deepspeed_tpu.utils.compat import shard_map
 from deepspeed_tpu.utils.logging import log_dist, logger
-from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
 
@@ -457,12 +461,41 @@ class DeepSpeedEngine:
             self._config.gradient_accumulation_steps,
             num_workers=self.dp_world_size,
             steps_per_output=self._config.steps_per_print)
-        from deepspeed_tpu.utils.profiler import TraceProfiler
         self.trace_profiler = TraceProfiler(
             **(self._config.profiling_params or {}))
         if self.trace_profiler.enabled:
             import atexit
             atexit.register(self.trace_profiler.close)
+
+        # --- telemetry (deepspeed_tpu/telemetry) -------------------------
+        # One session per engine: metrics registry + schema-versioned
+        # event log + the span API train_batch wraps its host phases in.
+        # Also installed as the process default (first engine wins) so
+        # engine-external emitters (elastic reshard, bench.py) land in
+        # the same log. metrics_history is the bounded step-event ring
+        # tests and health guards read without file I/O.
+        tl = self._config.telemetry
+        self.telemetry = None
+        self.metrics_history = collections.deque(maxlen=tl.history)
+        self._batch_tokens = None
+        if tl.enabled:
+            self.telemetry = TelemetrySession.from_config(tl)
+            set_default_session(self.telemetry, replace=False)
+            import atexit
+            atexit.register(self.telemetry.close)
+            self.telemetry.emit(
+                "run_start",
+                flavor=self._telemetry_flavor(),
+                train_batch_size=self._config.train_batch_size,
+                gradient_accumulation_steps=self._config
+                .gradient_accumulation_steps,
+                zero_stage=self.zero_optimization_stage(),
+                dp_world_size=self.dp_world_size,
+                mp_world_size=self.mp_world_size,
+                n_devices=len(jax.devices()),
+                fp16=self.fp16_enabled(),
+                bf16=self.bfloat16_enabled(),
+                flops_per_token=tl.flops_per_token or None)
         self.summary_writer = None
         if self._config.tensorboard_enabled and jax.process_index() == 0:
             self.summary_writer = self._get_summary_writer()
@@ -1031,6 +1064,9 @@ class DeepSpeedEngine:
             self.save_checkpoint(rz.save_dir, tag=tag)
             self._ckpt_manager.wait()   # the exit must not race the write
             path = self._ckpt_manager.ckpt_path(rz.save_dir, tag)
+        if self.telemetry is not None:
+            self.telemetry.emit("preemption", step=self.global_steps,
+                                path=str(path) if path else None)
         raise PreemptedError(
             f"preempted at step {self.global_steps}" +
             (f"; checkpoint saved to {path}" if path
@@ -1353,40 +1389,46 @@ class DeepSpeedEngine:
             *fault_extra)
         if not bool(metrics["overflow"]):   # blocks until device step done
             t0 = time.perf_counter()
-            opt = self.cpu_optimizer
-            bf16 = self.compute_dtype == jnp.bfloat16
-            lr, b1 = float(metrics["lr"]), float(metrics["beta1"])
-            if bf16:
-                # Chunked copy-back: each chunk's leaves start their H2D
-                # upload (device_put is async) as soon as its Adam +
-                # bf16 convert lands, overlapping the remaining chunks'
-                # host compute. Safe to upload views of the shared bf16
-                # buffer: it is next rewritten only after the following
-                # device step has consumed these params.
-                import ml_dtypes
-                shard_leaves = jax.tree_util.tree_leaves(
-                    self._shardings["param"])
-                uploaded = [None] * len(opt.sizes)
+            # Nested under the caller's `dispatch` span: the host-Adam
+            # phase shows up as its own range inside the step's dispatch
+            # window on both the event log and the xplane trace.
+            with (self.telemetry.span if self.telemetry is not None
+                  else null_span)("host_adam"):
+                opt = self.cpu_optimizer
+                bf16 = self.compute_dtype == jnp.bfloat16
+                lr, b1 = float(metrics["lr"]), float(metrics["beta1"])
+                if bf16:
+                    # Chunked copy-back: each chunk's leaves start their
+                    # H2D upload (device_put is async) as soon as its
+                    # Adam + bf16 convert lands, overlapping the
+                    # remaining chunks' host compute. Safe to upload
+                    # views of the shared bf16 buffer: it is next
+                    # rewritten only after the following device step has
+                    # consumed these params.
+                    import ml_dtypes
+                    shard_leaves = jax.tree_util.tree_leaves(
+                        self._shardings["param"])
+                    uploaded = [None] * len(opt.sizes)
 
-                def upload_chunk(li, lj):
-                    flat = opt._bf16_buf.view(ml_dtypes.bfloat16)
-                    for i in range(li, lj):
-                        o, sz = opt.offsets[i], opt.sizes[i]
-                        uploaded[i] = jax.device_put(
-                            flat[o:o + sz].reshape(opt.shapes[i]),
-                            shard_leaves[i])
+                    def upload_chunk(li, lj):
+                        flat = opt._bf16_buf.view(ml_dtypes.bfloat16)
+                        for i in range(li, lj):
+                            o, sz = opt.offsets[i], opt.sizes[i]
+                            uploaded[i] = jax.device_put(
+                                flat[o:o + sz].reshape(opt.shapes[i]),
+                                shard_leaves[i])
 
-                opt.step_overlapped(
-                    grads, lr=lr, beta1=b1, bf16_out=True,
-                    chunk_bytes=self._offload_chunk_bytes,
-                    on_chunk=upload_chunk)
-                self.params = jax.tree_util.tree_unflatten(
-                    opt.treedef, uploaded)
-            else:
-                opt.step_overlapped(
-                    grads, lr=lr, beta1=b1,
-                    chunk_bytes=self._offload_chunk_bytes)
-                self.params = self._upload_offload_params()
+                    opt.step_overlapped(
+                        grads, lr=lr, beta1=b1, bf16_out=True,
+                        chunk_bytes=self._offload_chunk_bytes,
+                        on_chunk=upload_chunk)
+                    self.params = jax.tree_util.tree_unflatten(
+                        opt.treedef, uploaded)
+                else:
+                    opt.step_overlapped(
+                        grads, lr=lr, beta1=b1,
+                        chunk_bytes=self._offload_chunk_bytes)
+                    self.params = self._upload_offload_params()
             self.last_host_phase_s = time.perf_counter() - t0
         return metrics
 
@@ -2117,6 +2159,87 @@ class DeepSpeedEngine:
         return jax.tree_util.tree_map(place, batch)
 
     # ------------------------------------------------------------------
+    # telemetry helpers
+    # ------------------------------------------------------------------
+    def _telemetry_flavor(self):
+        """The step flavor stamped on telemetry events (audit taxonomy:
+        dense/zero1-3/offload/quantized/pipeline/onebit/sparse)."""
+        cached = getattr(self, "_telemetry_flavor_cache", None)
+        if cached is None:
+            from deepspeed_tpu.analysis.audit import _engine_flavor
+            try:
+                cached = _engine_flavor(self)
+            except Exception:
+                cached = "unknown"
+            self._telemetry_flavor_cache = cached
+        return cached
+
+    @staticmethod
+    def _scalar_metrics(metrics):
+        """Host-scalar view of a step's metrics dict for the step event
+        (missing keys — pipeline flavor, guards off — are just absent)."""
+        out = {}
+        for key in ("loss", "lr", "grad_norm", "applied_grad_norm",
+                    "loss_scale"):
+            if key in metrics:
+                try:
+                    out[key] = float(metrics[key])
+                except Exception:
+                    pass
+        for key, cast in (("overflow", bool), ("grad_nonfinite", bool),
+                          ("skipped_steps", int),
+                          ("consecutive_skipped_steps", int)):
+            if key in metrics:
+                try:
+                    out[key] = cast(metrics[key])
+                except Exception:
+                    pass
+        return out
+
+    def _stamp_compile_facts(self, placed, step_rng, lr_in):
+        """Emit the one-shot ``compile`` event: static facts of the
+        compiled step so the run's log is self-describing. Reuses the
+        analysis block's audit stats when that ran; otherwise (with
+        ``telemetry.stamp_static_facts``) lowers the already-compiled
+        step once (a jit-cache hit on the XLA side of the same avals)
+        and extracts the collective/peak-memory accounting directly."""
+        tl = self._config.telemetry
+        facts = {"step": self.global_steps,
+                 "flavor": self._telemetry_flavor(),
+                 "flops_per_token": tl.flops_per_token or None,
+                 "batch_tokens": self._batch_tokens}
+        stats = None
+        if self.last_audit_report is not None:
+            stats = self.last_audit_report.stats
+        elif tl.stamp_static_facts:
+            try:
+                from deepspeed_tpu.analysis.audit import (
+                    _engine_fn_args, audit_hlo)
+                fn, args = _engine_fn_args(self, placed, step_rng, lr_in)
+                hlo_text = fn.lower(*args).compile().as_text()
+                stats = audit_hlo(
+                    hlo_text, rules=[],
+                    n_devices=int(self.mesh.shape.get("data", 1))).stats
+            except Exception as e:   # stamping is best-effort telemetry
+                facts["static_facts_error"] = str(e)
+        if stats:
+            cb = stats.get("collective_bytes") or {}
+            facts["collective_bytes"] = {k: int(v)
+                                         for k, v in cb.items()}
+            facts["while_loops"] = stats.get("while_loops")
+            pm = stats.get("peak_memory") or {}
+            if pm:
+                facts["static_peak_bytes"] = int(pm.get("peak_bytes", 0))
+                facts["static_temp_peak_bytes"] = int(
+                    pm.get("temp_peak_bytes", 0))
+            # engine-context audits carry the live param-tree bytes;
+            # the HLO-only path falls back to the compiled program's
+            # parameter-buffer accounting
+            facts["param_bytes"] = int(stats.get("param_bytes") or
+                                       pm.get("parameter_bytes") or 0)
+        self.telemetry.emit("compile", **facts)
+
+    # ------------------------------------------------------------------
     # public training API
     # ------------------------------------------------------------------
     def _run_compile_audit(self, placed, step_rng, lr_in):
@@ -2149,14 +2272,28 @@ class DeepSpeedEngine:
         # before this step consumes a batch (the dataloader position in
         # the checkpoint must not run ahead of the optimizer state).
         self._check_preemption()
+        # Telemetry-off fast path: `tele is None` is the only per-step
+        # cost, and `span` degrades to a shared no-op context manager
+        # (pinned by the overhead micro-benchmark test).
+        tele = self.telemetry
+        span = tele.span if tele is not None else null_span
+        step_wall_t0 = time.perf_counter() if tele is not None else 0.0
         if batch is None:
             assert self._data_iter is not None, \
                 "no training_data given; pass a batch explicitly"
-            batch = next(self._data_iter)
+            with span("data_load"):
+                batch = next(self._data_iter)
         first_compile = self._compiled_train_step is None
         if first_compile:
             self._compiled_train_step = self._make_offload_grad_step() \
                 if self._offload else self._make_train_step()
+        if tele is not None and self._batch_tokens is None:
+            # Rows x second dim of the first leaf: tokens for LM batches
+            # ([rows, seq] ids), rows x features otherwise — consistent
+            # within a run, which is what the MFU ratio needs.
+            shape = np.shape(jax.tree_util.tree_leaves(batch)[0])
+            self._batch_tokens = int(shape[0]) * (
+                int(shape[1]) if len(shape) > 1 else 1)
         # Fault harness: the compiled step takes a trailing grad multiplier
         # only when fault injection is configured on (no recompile or
         # signature change for ordinary runs).
@@ -2173,33 +2310,43 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers("train_batch").start()
         self.tput_timer.start()
-        placed = self._shard_batch(batch)
-        # Derive the step rng from the CHECKPOINTED step counter rather
-        # than an in-memory split chain: a resumed engine replays the
-        # exact dropout masks the original would have drawn, so training
-        # curves stay continuous across save/load even with dropout on.
-        # Stream id 0 keeps this disjoint from backward()'s micro stream.
-        step_rng = jax.random.fold_in(
-            jax.random.fold_in(self._rng, 0), self.global_steps)
-        lr_in = jnp.asarray(self._current_host_lr(), jnp.float32)
-        if first_compile and self._config.analysis.enabled:
-            # Compile-time audit: lowering here both triggers the one real
-            # compile (the step call below is then a jit-cache hit) and
-            # hands the audit the exact HLO that will execute.
-            self._run_compile_audit(placed, step_rng, lr_in)
-        if self._offload:
-            metrics = self._train_batch_offload(placed, step_rng, lr_in,
-                                                fault_extra)
-        else:
-            self.params, self.opt_state, self.device_state, metrics = \
-                self._compiled_train_step(self.params, self.opt_state,
-                                          self.device_state, placed,
-                                          step_rng, lr_in, *fault_extra)
-        if step_t0 is not None:
+        with span("dispatch"):
+            placed = self._shard_batch(batch)
+            # Derive the step rng from the CHECKPOINTED step counter rather
+            # than an in-memory split chain: a resumed engine replays the
+            # exact dropout masks the original would have drawn, so training
+            # curves stay continuous across save/load even with dropout on.
+            # Stream id 0 keeps this disjoint from backward()'s micro stream.
+            step_rng = jax.random.fold_in(
+                jax.random.fold_in(self._rng, 0), self.global_steps)
+            lr_in = jnp.asarray(self._current_host_lr(), jnp.float32)
+            if first_compile and self._config.analysis.enabled:
+                # Compile-time audit: lowering here both triggers the one
+                # real compile (the step call below is then a jit-cache
+                # hit) and hands the audit the exact HLO that will execute.
+                with span("compile"):
+                    self._run_compile_audit(placed, step_rng, lr_in)
+            if self._offload:
+                metrics = self._train_batch_offload(placed, step_rng,
+                                                    lr_in, fault_extra)
+            else:
+                self.params, self.opt_state, self.device_state, metrics = \
+                    self._compiled_train_step(self.params, self.opt_state,
+                                              self.device_state, placed,
+                                              step_rng, lr_in, *fault_extra)
+        if first_compile and tele is not None:
+            # One-shot static facts (overlaps the step's device execution:
+            # the compiled call above is still in flight).
+            self._stamp_compile_facts(placed, step_rng, lr_in)
+        if step_t0 is not None or tele is not None:
             # block on the step's own outputs BEFORE stopping any timer:
             # effects_barrier (inside the timers) only waits for
-            # *effectful* dispatch, not the pure compiled train step
-            jax.block_until_ready(metrics["loss"])
+            # *effectful* dispatch, not the pure compiled train step.
+            # Telemetry syncs here too — the step event's wall time must
+            # cover device execution, and device_wait IS the async-
+            # dispatch slack (host-bound runs show it near zero).
+            with span("device_wait"):
+                jax.block_until_ready(metrics["loss"])
         self.tput_timer.stop()
         if self.wall_clock_breakdown():
             self.timers("train_batch").stop()
@@ -2249,6 +2396,11 @@ class DeepSpeedEngine:
                 for f in findings:
                     log_dist(f"analysis[{f.rule}/{f.severity}]: "
                              f"{f.message}", ranks=[0])
+                if tele is not None:
+                    tele.emit("recompile", step=self.global_steps,
+                              cache_size=findings[0].details["cache_size"],
+                              expected=findings[0].details["expected"],
+                              message=findings[0].message)
                 if an.fail_on_findings:
                     raise AuditError(AuditReport(flavor="live",
                                                  findings=findings))
@@ -2273,6 +2425,11 @@ class DeepSpeedEngine:
             metrics = dict(metrics)
             metrics.update(self._health_monitor.metrics())
             for trip in trips:
+                if tele is not None:
+                    # Emit BEFORE applying: rollback/abort below may load
+                    # a checkpoint or raise, and the trip must be on
+                    # record either way.
+                    tele.emit("health_guard", **trip.as_event())
                 self._apply_guard_trip(trip)
 
         rz = self._config.resilience
@@ -2281,6 +2438,22 @@ class DeepSpeedEngine:
             self.save_checkpoint(rz.save_dir)
 
         self._last_metrics = metrics
+
+        if tele is not None:
+            # Per-step event: scalar metrics (already materialized by the
+            # device_wait sync above, so these float()s are transfers,
+            # not stalls), the drained phase spans, and the end-to-end
+            # host wall time. Ring-buffered on metrics_history for
+            # file-less assertions.
+            evt = tele.step_event(
+                step=self.global_steps,
+                flavor=self._telemetry_flavor(),
+                wall_s=time.perf_counter() - step_wall_t0,
+                phases={k: round(v, 6)
+                        for k, v in tele.drain_phases().items()},
+                tokens=self._batch_tokens,
+                **self._scalar_metrics(metrics))
+            self.metrics_history.append(evt)
 
         if self.global_steps % self._config.steps_per_print == 0:
             loss = float(metrics["loss"])
@@ -2523,16 +2696,26 @@ class DeepSpeedEngine:
         """
         if tag is None:
             tag = f"global_step{self.global_steps}"
-        state = self._checkpoint_state_tree()
-        meta = self._checkpoint_meta(client_state)
-        extra_manifest = {
-            "topology": self._topology(),
-            "arrays": self._arrays_manifest(state),
-        }
-        path = self._ckpt_manager.save(save_dir, tag, state, meta,
-                                       save_latest=save_latest,
-                                       extra_manifest=extra_manifest)
+        tele = self.telemetry
+        t0 = time.perf_counter()
+        with (tele.span if tele is not None else null_span)("checkpoint"):
+            state = self._checkpoint_state_tree()
+            meta = self._checkpoint_meta(client_state)
+            extra_manifest = {
+                "topology": self._topology(),
+                "arrays": self._arrays_manifest(state),
+            }
+            path = self._ckpt_manager.save(save_dir, tag, state, meta,
+                                           save_latest=save_latest,
+                                           extra_manifest=extra_manifest)
         log_dist(f"saved checkpoint {path}", ranks=[0])
+        if tele is not None:
+            # async_save: this is the staging duration; the publish
+            # rename happens on the manager's writer thread.
+            tele.emit("checkpoint_save", step=self.global_steps, tag=tag,
+                      path=str(path),
+                      duration_s=round(time.perf_counter() - t0, 6),
+                      async_save=bool(self._ckpt_manager.async_save))
         return True
 
     def _opt_state_to_tree(self):
@@ -2622,6 +2805,7 @@ class DeepSpeedEngine:
         raises :class:`CheckpointCorruptError` rather than silently
         loading something else.
         """
+        load_t0 = time.perf_counter()
         self._ckpt_manager.wait()  # join any in-flight async save first
         resolved = self._ckpt_manager.resolve_tag(load_dir, tag)
         if resolved is None:
@@ -2641,6 +2825,11 @@ class DeepSpeedEngine:
             log_dist(
                 f"elastic resume: checkpoint topology {check.changed} "
                 f"differs from current mesh; resharding on load", ranks=[0])
+            if self.telemetry is not None:
+                self.telemetry.emit(
+                    "elastic_resume", step=self.global_steps,
+                    changed=check.changed,
+                    dp_world_size=self.dp_world_size)
         # Restore as host numpy arrays (placement happens below on the
         # CURRENT mesh/shardings) — restoring with the saved shardings
         # trips orbax's "unsafe when restoring on a different topology"
@@ -2730,6 +2919,13 @@ class DeepSpeedEngine:
         log_dist(f"loaded checkpoint {path} (saved at dp="
                  f"{meta.get('dp_world_size')}, now dp={self.dp_world_size})",
                  ranks=[0])
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "checkpoint_load", step=self.global_steps, path=str(path),
+                duration_s=round(time.perf_counter() - load_t0, 6),
+                topology=check.kind,
+                saved_dp_world_size=meta.get("dp_world_size"),
+                dp_world_size=self.dp_world_size)
         return path, meta.get("client_state", {})
 
     def _auto_resume(self):

@@ -1,0 +1,52 @@
+"""Unified runtime telemetry (ISSUE 8): metrics registry, step-phase
+spans, schema-versioned event log, pluggable exporters.
+
+One import surface for everything the engine, the resilience/elastic
+layers, bench.py, and the ``ds_tpu_metrics`` CLI share:
+
+- :class:`MetricsRegistry` — typed counters/gauges/histograms + labels
+  (`registry.py`).
+- :class:`TelemetrySession` / :func:`get_default_session` — registry +
+  event log + span API bundled per run (`session.py`).
+- :func:`null_span` — the telemetry-off no-op fast path (`spans.py`).
+- :data:`SCHEMA_VERSION` — the event-log version tag, also embedded in
+  ``ds_tpu_audit --json`` so audits and telemetry join (`events.py`).
+- The synchronized timers and the trace-window profiler that moved here
+  from ``utils/`` (`timers.py`, `profiler.py`).
+
+See docs/observability.md for the config block and event schema.
+"""
+
+from deepspeed_tpu.telemetry.events import EventLog, SCHEMA_VERSION  # noqa: F401
+from deepspeed_tpu.telemetry.exporters import (  # noqa: F401
+    ConsoleExporter, JsonlExporter, PrometheusTextfileExporter)
+from deepspeed_tpu.telemetry.profiler import (  # noqa: F401
+    TraceProfiler, device_report)
+from deepspeed_tpu.telemetry.registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry)
+from deepspeed_tpu.telemetry.session import (  # noqa: F401
+    TelemetrySession, get_default_session, set_default_session)
+from deepspeed_tpu.telemetry.spans import Span, null_span  # noqa: F401
+from deepspeed_tpu.telemetry.timers import (  # noqa: F401
+    SynchronizedWallClockTimer, ThroughputTimer)
+
+__all__ = [
+    "ConsoleExporter",
+    "Counter",
+    "EventLog",
+    "Gauge",
+    "Histogram",
+    "JsonlExporter",
+    "MetricsRegistry",
+    "PrometheusTextfileExporter",
+    "SCHEMA_VERSION",
+    "Span",
+    "SynchronizedWallClockTimer",
+    "TelemetrySession",
+    "ThroughputTimer",
+    "TraceProfiler",
+    "device_report",
+    "get_default_session",
+    "null_span",
+    "set_default_session",
+]

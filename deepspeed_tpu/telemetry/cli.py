@@ -1,0 +1,347 @@
+"""`ds_tpu_metrics`: tail / summarize / diff telemetry JSONL logs.
+
+Three subcommands over the schema-versioned event log a run writes when
+``telemetry.jsonl_path`` is set (`telemetry/events.py`):
+
+- ``ds_tpu_metrics summary LOG`` — step count, wall time, step-time
+  stats (mean/p50/p95), per-phase breakdown with shares, tokens/sec,
+  and an MFU estimate (the ANALYSIS_MFU.md accounting: achieved TFLOPS
+  = tokens/sec x flops/token; MFU = achieved / peak, default peak 197
+  TFLOPS — one v5e chip's bf16 ceiling), plus recompile / health-guard /
+  checkpoint event counts.
+- ``ds_tpu_metrics tail LOG -n 20`` — the last N events, one line each.
+- ``ds_tpu_metrics diff A B`` — per-metric regression table between two
+  runs; ``--fail-over PCT`` exits 1 when mean step time regressed more.
+
+Exit codes: 0 ok, 1 no step events (summary) or regression past
+``--fail-over`` (diff), 2 usage errors / unreadable files.
+
+flops/token resolution for MFU (first hit wins): ``--flops-per-token``
+flag > the run's ``compile`` event > its ``run_start`` event. Without
+any, the summary reports throughput but skips MFU.
+"""
+
+import argparse
+import json
+import sys
+
+from deepspeed_tpu.telemetry.events import SCHEMA_VERSION
+
+# One v5e chip's bf16 peak (ANALYSIS_MFU.md) — override per target chip.
+DEFAULT_PEAK_TFLOPS = 197.0
+
+
+def read_events(path):
+    """Parse a JSONL log, skipping blank/corrupt lines (a live run may
+    be mid-write on the last line)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                evt = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(evt, dict):
+                events.append(evt)
+    return events
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _resolve_flops_per_token(events, flops_per_token=None):
+    if flops_per_token:
+        return float(flops_per_token)
+    for kind in ("compile", "run_start"):
+        for evt in events:
+            if evt.get("event") == kind and evt.get("flops_per_token"):
+                return float(evt["flops_per_token"])
+    return None
+
+
+def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
+    """Aggregate a run's events into the summary dict (None when the log
+    holds no step events)."""
+    steps = [e for e in events if e.get("event") == "step"]
+    if not steps:
+        return None
+    walls = sorted(float(e["wall_s"]) for e in steps
+                   if e.get("wall_s") is not None)
+    total_s = sum(walls)
+    phases = {}
+    for evt in steps:
+        for name, secs in (evt.get("phases") or {}).items():
+            phases.setdefault(name, []).append(float(secs))
+    phase_stats = {
+        name: {"total_s": sum(vals),
+               "mean_s": sum(vals) / len(vals),
+               "share": (sum(vals) / total_s) if total_s else 0.0}
+        for name, vals in sorted(phases.items())}
+    guard_actions = {}
+    for evt in events:
+        if evt.get("event") == "health_guard":
+            action = evt.get("action", "?")
+            guard_actions[action] = guard_actions.get(action, 0) + 1
+    saves = [e for e in events if e.get("event") == "checkpoint_save"]
+    save_secs = [float(e["duration_s"]) for e in saves
+                 if e.get("duration_s") is not None]
+    tokens = sum(int(e.get("tokens") or 0) for e in steps)
+    tokens_per_s = tokens / total_s if total_s and tokens else None
+    fpt = _resolve_flops_per_token(events, flops_per_token)
+    mfu = None
+    if tokens_per_s and fpt:
+        achieved_tflops = tokens_per_s * fpt / 1e12
+        mfu = {"flops_per_token": fpt,
+               "peak_tflops": float(peak_tflops),
+               "achieved_tflops": achieved_tflops,
+               "mfu": achieved_tflops / float(peak_tflops)}
+    losses = [float(e["loss"]) for e in steps
+              if e.get("loss") is not None]
+    return {
+        "schema": SCHEMA_VERSION,
+        "steps": len(steps),
+        "flavor": steps[-1].get("flavor"),
+        "wall_s": total_s,
+        "step_s": {
+            "mean": (total_s / len(walls)) if walls else None,
+            "p50": _percentile(walls, 0.50),
+            "p95": _percentile(walls, 0.95),
+            "min": walls[0] if walls else None,
+            "max": walls[-1] if walls else None,
+        },
+        "phases": phase_stats,
+        "tokens": tokens or None,
+        "tokens_per_s": tokens_per_s,
+        "mfu": mfu,
+        "last_loss": losses[-1] if losses else None,
+        "events": {
+            "recompile": sum(1 for e in events
+                             if e.get("event") == "recompile"),
+            "health_guard": guard_actions,
+            "checkpoint_save": {
+                "count": len(saves),
+                "mean_s": (sum(save_secs) / len(save_secs))
+                if save_secs else None,
+            },
+            "checkpoint_load": sum(
+                1 for e in events if e.get("event") == "checkpoint_load"),
+        },
+    }
+
+
+def _fmt_s(v):
+    if v is None:
+        return "-"
+    return f"{v * 1e3:.2f}ms" if v < 1.0 else f"{v:.3f}s"
+
+
+def print_summary(s, out=sys.stdout):
+    print(f"run summary ({s['flavor'] or 'unknown'} flavor, schema "
+          f"{s['schema']})", file=out)
+    print(f"  steps {s['steps']}, wall {s['wall_s']:.3f}s, "
+          f"step time mean {_fmt_s(s['step_s']['mean'])} "
+          f"p50 {_fmt_s(s['step_s']['p50'])} "
+          f"p95 {_fmt_s(s['step_s']['p95'])}", file=out)
+    if s["phases"]:
+        print("  phase breakdown (host wall, share of step time):",
+              file=out)
+        for name, ps in s["phases"].items():
+            print(f"    {name:<14s} mean {_fmt_s(ps['mean_s']):>10s}  "
+                  f"total {_fmt_s(ps['total_s']):>10s}  "
+                  f"{ps['share'] * 100:5.1f}%", file=out)
+    if s["tokens_per_s"]:
+        print(f"  throughput {s['tokens_per_s']:,.0f} tokens/s", file=out)
+    if s["mfu"]:
+        m = s["mfu"]
+        print(f"  MFU {m['mfu'] * 100:.1f}% "
+              f"({m['achieved_tflops']:.1f} / {m['peak_tflops']:.0f} "
+              f"TFLOPS at {m['flops_per_token']:,.0f} flops/token)",
+              file=out)
+    ev = s["events"]
+    guards = ", ".join(f"{k}={v}" for k, v in
+                       sorted(ev["health_guard"].items())) or "none"
+    save_mean = ev["checkpoint_save"]["mean_s"]
+    print(f"  events: {ev['recompile']} recompile(s), health guards "
+          f"[{guards}], {ev['checkpoint_save']['count']} checkpoint "
+          f"save(s)"
+          + (f" (mean {_fmt_s(save_mean)})" if save_mean else "")
+          + f", {ev['checkpoint_load']} load(s)", file=out)
+    if s["last_loss"] is not None:
+        print(f"  last loss {s['last_loss']:.6g}", file=out)
+
+
+# Metrics the diff table compares; (label, getter, lower_is_better).
+def _diff_rows(a, b):
+    def step_stat(s, key):
+        return s["step_s"][key]
+
+    rows = [
+        ("step_s.mean", step_stat(a, "mean"), step_stat(b, "mean"), True),
+        ("step_s.p50", step_stat(a, "p50"), step_stat(b, "p50"), True),
+        ("step_s.p95", step_stat(a, "p95"), step_stat(b, "p95"), True),
+        ("tokens_per_s", a["tokens_per_s"], b["tokens_per_s"], False),
+        ("mfu", a["mfu"]["mfu"] if a["mfu"] else None,
+         b["mfu"]["mfu"] if b["mfu"] else None, False),
+    ]
+    for name in sorted(set(a["phases"]) | set(b["phases"])):
+        rows.append((f"phase.{name}.mean_s",
+                     a["phases"].get(name, {}).get("mean_s"),
+                     b["phases"].get(name, {}).get("mean_s"), True))
+    return rows
+
+
+def diff_summaries(a, b):
+    """Regression table between run A (baseline) and run B. Returns
+    (rows, step_mean_delta_pct); each row is
+    {metric, a, b, delta_pct, regression}."""
+    out = []
+    step_mean_delta = None
+    for metric, va, vb, lower_better in _diff_rows(a, b):
+        delta = None
+        if va and vb:
+            delta = (vb - va) / va * 100.0
+        regression = None
+        if delta is not None:
+            regression = delta > 0 if lower_better else delta < 0
+        if metric == "step_s.mean":
+            step_mean_delta = delta
+        out.append({"metric": metric, "a": va, "b": vb,
+                    "delta_pct": delta, "regression": regression})
+    return out, step_mean_delta
+
+
+def print_diff(rows, out=sys.stdout):
+    print(f"{'metric':<24s} {'A':>12s} {'B':>12s} {'delta':>9s}",
+          file=out)
+    for r in rows:
+        def fmt(v):
+            if v is None:
+                return "-"
+            return f"{v:.5g}"
+        delta = "-" if r["delta_pct"] is None else f"{r['delta_pct']:+.1f}%"
+        mark = " <-- regression" if r["regression"] else ""
+        print(f"{r['metric']:<24s} {fmt(r['a']):>12s} "
+              f"{fmt(r['b']):>12s} {delta:>9s}{mark}", file=out)
+
+
+def print_tail(events, as_json, out=sys.stdout):
+    if as_json:
+        print(json.dumps(events, indent=2, default=str), file=out)
+        return
+    for evt in events:
+        extra = " ".join(
+            f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in evt.items()
+            if k not in ("schema", "event", "t", "phases")
+            and isinstance(v, (str, int, float, bool)))
+        print(f"{evt.get('t', 0):.3f} {evt.get('event', '?'):<16s} "
+              f"{extra}", file=out)
+
+
+def _load(parser, path):
+    try:
+        return read_events(path)
+    except OSError as exc:
+        parser.error(f"cannot read log: {exc}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ds_tpu_metrics",
+        description="Summarize, tail, and diff deepspeed_tpu telemetry "
+                    "JSONL logs (step-time breakdown, MFU estimate, "
+                    "regression diffs).")
+    sub = parser.add_subparsers(dest="cmd")
+
+    p_sum = sub.add_parser("summary", help="aggregate one run's log")
+    p_sum.add_argument("log")
+    p_sum.add_argument("--json", action="store_true", dest="as_json")
+    p_sum.add_argument("--flops-per-token", type=float, default=None,
+                       help="model flops per token for the MFU estimate "
+                            "(default: the log's compile/run_start stamp)")
+    p_sum.add_argument("--peak-tflops", type=float,
+                       default=DEFAULT_PEAK_TFLOPS,
+                       help="per-chip peak TFLOPS for MFU (default "
+                            f"{DEFAULT_PEAK_TFLOPS:.0f}, v5e bf16)")
+
+    p_tail = sub.add_parser("tail", help="print the last N events")
+    p_tail.add_argument("log")
+    p_tail.add_argument("-n", type=int, default=10)
+    p_tail.add_argument("--json", action="store_true", dest="as_json")
+    p_tail.add_argument("--event", default=None,
+                        help="only events of this type")
+
+    p_diff = sub.add_parser("diff",
+                            help="regression table between two runs")
+    p_diff.add_argument("log_a", help="baseline run log")
+    p_diff.add_argument("log_b", help="candidate run log")
+    p_diff.add_argument("--json", action="store_true", dest="as_json")
+    p_diff.add_argument("--flops-per-token", type=float, default=None)
+    p_diff.add_argument("--peak-tflops", type=float,
+                        default=DEFAULT_PEAK_TFLOPS)
+    p_diff.add_argument("--fail-over", type=float, default=None,
+                        metavar="PCT",
+                        help="exit 1 when mean step time regressed by "
+                             "more than PCT percent")
+
+    args = parser.parse_args(argv)
+    if args.cmd is None:
+        parser.error("a subcommand is required: summary, tail, or diff")
+
+    if args.cmd == "summary":
+        s = summarize(_load(parser, args.log),
+                      flops_per_token=args.flops_per_token,
+                      peak_tflops=args.peak_tflops)
+        if s is None:
+            print("no step events in log", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps(s, indent=2, sort_keys=True))
+        else:
+            print_summary(s)
+        return 0
+
+    if args.cmd == "tail":
+        events = _load(parser, args.log)
+        if args.event:
+            events = [e for e in events if e.get("event") == args.event]
+        print_tail(events[-max(0, args.n):], args.as_json)
+        return 0
+
+    # diff
+    sa = summarize(_load(parser, args.log_a),
+                   flops_per_token=args.flops_per_token,
+                   peak_tflops=args.peak_tflops)
+    sb = summarize(_load(parser, args.log_b),
+                   flops_per_token=args.flops_per_token,
+                   peak_tflops=args.peak_tflops)
+    if sa is None or sb is None:
+        which = args.log_a if sa is None else args.log_b
+        print(f"no step events in log {which}", file=sys.stderr)
+        return 1
+    rows, step_mean_delta = diff_summaries(sa, sb)
+    if args.as_json:
+        print(json.dumps({"schema": SCHEMA_VERSION, "rows": rows,
+                          "step_mean_delta_pct": step_mean_delta},
+                         indent=2, sort_keys=True))
+    else:
+        print_diff(rows)
+    if args.fail_over is not None and step_mean_delta is not None \
+            and step_mean_delta > args.fail_over:
+        print(f"FAIL: mean step time regressed "
+              f"{step_mean_delta:+.1f}% (> {args.fail_over}%)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Schema-versioned structured event log.
+
+Every telemetry record is one flat-ish JSON object ("event") with a
+fixed envelope::
+
+    {"schema": "ds-tpu-telemetry/1",   # version tag, bump on breaking
+     "event":  "step",                 # event type
+     "t":      1756000000.123,        # unix seconds (host clock)
+     ...payload fields per type...}
+
+Event types the runtime emits (see docs/observability.md for the full
+field tables): ``run_start``, ``compile`` (static facts stamped once —
+collective bytes/counts, static peak memory), ``step`` (per-step
+metrics + phase breakdown), ``recompile``, ``health_guard``,
+``checkpoint_save`` / ``checkpoint_load``, ``elastic_resume``,
+``preemption``, ``reshard``, and ``bench_step`` (bench.py).
+
+``ds_tpu_audit --json`` embeds the same ``schema`` tag so audit findings
+and telemetry events are joinable offline.
+
+The log keeps a bounded in-memory ring (the engine re-exposes the step
+slice as ``engine.metrics_history``) and fans each event out to the
+configured exporters. Exporter failures are contained: telemetry must
+never kill a training run, so a throwing exporter is disabled with one
+warning instead of propagating.
+"""
+
+import collections
+import time
+
+from deepspeed_tpu.utils.logging import logger
+
+SCHEMA_VERSION = "ds-tpu-telemetry/1"
+
+
+class EventLog:
+    """Bounded ring of events + exporter fan-out."""
+
+    def __init__(self, exporters=(), history=256):
+        self.exporters = list(exporters)
+        self._ring = collections.deque(maxlen=int(history))
+        self._dead = set()
+
+    def emit(self, event, **fields):
+        evt = {"schema": SCHEMA_VERSION, "event": event, "t": time.time()}
+        evt.update(fields)
+        self._ring.append(evt)
+        for ex in self.exporters:
+            if id(ex) in self._dead:
+                continue
+            try:
+                ex.export(evt)
+            except Exception as e:
+                self._dead.add(id(ex))
+                logger.warning(
+                    f"telemetry: exporter {type(ex).__name__} failed "
+                    f"({e}); disabling it for the rest of the run")
+        return evt
+
+    def recent(self, n=None, event=None):
+        evts = list(self._ring)
+        if event is not None:
+            evts = [e for e in evts if e.get("event") == event]
+        return evts if n is None else evts[-n:]
+
+    def close(self):
+        for ex in self.exporters:
+            try:
+                ex.close()
+            except Exception:
+                pass

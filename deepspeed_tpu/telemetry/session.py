@@ -1,0 +1,99 @@
+"""TelemetrySession: one registry + one event log + the span API.
+
+The engine owns a session when the ``telemetry`` config block enables
+it; subsystems that run outside an engine method (elastic reshard,
+bench.py) reach the *process-default* session via
+:func:`get_default_session` so their events land in the same log.
+
+Span durations accumulate per phase name between ``drain_phases()``
+calls — the engine drains once per step and stamps the result into that
+step's event, so nested/repeated spans within a step sum correctly.
+"""
+
+from deepspeed_tpu.telemetry.events import EventLog
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.spans import Span
+
+_default_session = None
+
+
+def get_default_session():
+    """The process-default session, or None when telemetry is off."""
+    return _default_session
+
+
+def set_default_session(session, replace=True):
+    """Install ``session`` as the process default. ``replace=False``
+    keeps an already-installed session (first engine wins)."""
+    global _default_session
+    if _default_session is not None and not replace:
+        return _default_session
+    _default_session = session
+    return session
+
+
+class TelemetrySession:
+    def __init__(self, registry=None, exporters=(), history=256):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.events = EventLog(exporters=exporters, history=history)
+        self._phases = {}
+
+    @classmethod
+    def from_config(cls, tcfg):
+        """Build a session from a validated ``TelemetryConfig``."""
+        from deepspeed_tpu.telemetry.exporters import (
+            ConsoleExporter, JsonlExporter, PrometheusTextfileExporter)
+        registry = MetricsRegistry()
+        exporters = []
+        if tcfg.jsonl_path:
+            exporters.append(JsonlExporter(tcfg.jsonl_path))
+        if tcfg.console:
+            exporters.append(ConsoleExporter())
+        if tcfg.prometheus_textfile:
+            exporters.append(PrometheusTextfileExporter(
+                tcfg.prometheus_textfile, registry,
+                write_every=tcfg.prometheus_write_every))
+        return cls(registry=registry, exporters=exporters,
+                   history=tcfg.history)
+
+    # -- spans ---------------------------------------------------------
+    def span(self, name):
+        return Span(name, self)
+
+    def _record_phase(self, name, path, duration_s):
+        self._phases[name] = self._phases.get(name, 0.0) + duration_s
+        self.registry.histogram(
+            "phase_seconds", labels={"phase": name},
+            help="host wall seconds per step phase").observe(duration_s)
+
+    def drain_phases(self):
+        """Per-phase seconds accumulated since the last drain (one step's
+        phase breakdown); resets the accumulator."""
+        phases, self._phases = self._phases, {}
+        return phases
+
+    # -- events --------------------------------------------------------
+    def emit(self, event, **fields):
+        self.registry.counter(
+            "events_total", labels={"event": event},
+            help="telemetry events emitted by type").inc()
+        return self.events.emit(event, **fields)
+
+    def step_event(self, **fields):
+        """Emit one per-step event and update the step-level metrics."""
+        wall = fields.get("wall_s")
+        if wall is not None:
+            self.registry.histogram(
+                "step_seconds",
+                help="end-to-end host wall seconds per optimizer step"
+            ).observe(wall)
+        if fields.get("loss") is not None:
+            self.registry.gauge("loss", help="last step loss").set(
+                fields["loss"])
+        self.registry.counter("steps_total",
+                              help="optimizer steps completed").inc()
+        return self.emit("step", **fields)
+
+    def close(self):
+        self.events.close()

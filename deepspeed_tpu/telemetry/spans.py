@@ -1,0 +1,95 @@
+"""Step-phase spans: nestable wall-time scopes correlated with xplane.
+
+``session.span("dispatch")`` is a context manager that (1) records the
+scope's wall seconds into the session's per-step phase accumulator and
+the ``phase_seconds{phase=...}`` histogram, and (2) opens a
+``jax.profiler.TraceAnnotation`` so the same scope shows up as a named
+range in an xprof/xplane trace captured by ``TraceProfiler`` — host
+phases and device timelines line up in one view.
+
+Spans nest: the engine's offload host-Adam phase runs inside the
+``dispatch`` span, and ``Span.path`` carries the full ``a/b`` nesting
+path (per-thread). Exit is exception-safe — a phase that raises still
+records its duration and closes its annotation before re-raising.
+
+The disabled fast path is :func:`null_span`: a module-level singleton
+whose ``__enter__``/``__exit__`` do nothing, so an engine with telemetry
+off pays one attribute check + one no-op context manager per phase
+(pinned by the overhead micro-benchmark test).
+"""
+
+import threading
+import time
+
+try:                                     # annotations are optional:
+    from jax.profiler import TraceAnnotation   # telemetry must work in
+except Exception:                        # jax-less tools (the CLI).
+    TraceAnnotation = None
+
+_local = threading.local()
+
+
+def _stack():
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    return stack
+
+
+class Span:
+    """One timed, annotated scope. Created via ``TelemetrySession.span``."""
+
+    __slots__ = ("name", "path", "duration_s", "_session", "_t0",
+                 "_annotation")
+
+    def __init__(self, name, session=None):
+        self.name = name
+        self.path = name
+        self.duration_s = None
+        self._session = session
+        self._t0 = None
+        self._annotation = None
+
+    def __enter__(self):
+        stack = _stack()
+        stack.append(self.name)
+        self.path = "/".join(stack)
+        if TraceAnnotation is not None:
+            self._annotation = TraceAnnotation(f"ds_tpu/{self.path}")
+            self._annotation.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.duration_s = time.perf_counter() - self._t0
+        try:
+            if self._annotation is not None:
+                self._annotation.__exit__(exc_type, exc, tb)
+        finally:
+            stack = _stack()
+            if stack and stack[-1] == self.name:
+                stack.pop()
+            if self._session is not None:
+                self._session._record_phase(self.name, self.path,
+                                            self.duration_s)
+        return False   # never swallow the phase's exception
+
+
+class _NullSpan:
+    """Singleton no-op context manager — the telemetry-off fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def null_span(name=None):
+    """Drop-in for ``session.span`` when telemetry is disabled."""
+    return _NULL_SPAN

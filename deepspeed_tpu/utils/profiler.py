@@ -1,127 +1,23 @@
-"""Device-time profiling: jax.profiler trace capture + per-step timings.
+"""DEPRECATED shim — the profiler moved to
+``deepspeed_tpu.telemetry.profiler``.
 
-The reference's tracing story is host timers around engine phases plus
-CUDA-event kernel timers (`utils/timer.py:26-104`, `csrc/includes/
-StopWatch.h`); SURVEY §5.1 names the TPU equivalents: ``jax.profiler``
-traces for xprof/tensorboard, synchronized host timers, and per-step
-device-time deltas. This module supplies the trace capture and the
-per-step record; `utils/timer.py` supplies the synchronized timers.
-
-Config surface (engine ``wall_clock_breakdown`` drives the timers; this is
-the trace window)::
-
-    "profiling": {
-        "trace_dir": "/tmp/tpu_trace",   # where xprof events go
-        "trace_start_step": 10,           # first traced optimizer step
-        "trace_num_steps": 3              # how many steps to capture
-    }
-
-The trace is viewable with tensorboard's profile plugin or xprof.
+Kept (same pattern as the `utils/hlo_analysis.py` migration) so seed-era
+imports keep working one release; new code should import from
+`deepspeed_tpu.telemetry` (or `deepspeed_tpu.telemetry.profiler`).
 """
 
-import collections
+import warnings
 
-from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.telemetry.profiler import (  # noqa: F401
+    _KNOWN_KEYS,
+    TraceProfiler,
+    device_report,
+)
 
+warnings.warn(
+    "deepspeed_tpu.utils.profiler is deprecated; import from "
+    "deepspeed_tpu.telemetry.profiler (or deepspeed_tpu.telemetry) "
+    "instead",
+    DeprecationWarning, stacklevel=2)
 
-_KNOWN_KEYS = ("trace_dir", "trace_start_step", "trace_num_steps",
-               "history")
-
-
-class TraceProfiler:
-    """Captures a ``jax.profiler`` trace for a configured step window and
-    keeps a rolling record of synchronized per-step durations."""
-
-    def __init__(self, trace_dir=None, trace_start_step=0,
-                 trace_num_steps=0, history=100, **unknown):
-        if unknown:
-            raise ValueError(
-                f"unknown 'profiling' config keys {sorted(unknown)}; "
-                f"supported: {list(_KNOWN_KEYS)}")
-        self.trace_dir = trace_dir
-        self.start_step = int(trace_start_step)
-        self.num_steps = int(trace_num_steps)
-        self._active = False
-        self.step_times = collections.deque(maxlen=history)
-
-    @property
-    def enabled(self):
-        return self.trace_dir is not None and self.num_steps > 0
-
-    def in_window(self, global_step):
-        """True only for steps inside the trace window — the engine syncs
-        per-step timing for these (plus wall_clock_breakdown runs), NOT
-        for the whole run."""
-        return self.enabled and (
-            self.start_step <= global_step <
-            self.start_step + self.num_steps)
-
-    def before_step(self, global_step):
-        if not self.enabled or self._active:
-            return
-        if self.in_window(global_step):
-            import jax
-
-            jax.profiler.start_trace(self.trace_dir)
-            self._active = True
-            log_dist(f"profiler: trace started at step {global_step} "
-                     f"-> {self.trace_dir}", ranks=[0])
-
-    def after_step(self, global_step, duration=None):
-        if duration is not None:
-            self.step_times.append(duration)
-        if self._active and \
-                global_step >= self.start_step + self.num_steps - 1:
-            self.close(global_step)
-
-    def close(self, global_step=None):
-        """Stop an in-flight trace (idempotent) — also called at interpreter
-        exit so a run ending inside the window still flushes xprof files."""
-        if not self._active:
-            return
-        import jax
-
-        jax.profiler.stop_trace()
-        self._active = False
-        log_dist(f"profiler: trace stopped"
-                 f"{f' after step {global_step}' if global_step is not None else ''}",
-                 ranks=[0])
-
-    def summary(self):
-        """(mean, min, max) of recorded synchronized step seconds."""
-        if not self.step_times:
-            return None
-        ts = list(self.step_times)
-        return sum(ts) / len(ts), min(ts), max(ts)
-
-
-def device_report(out=None):
-    """Print the device/mesh/ICI picture (`ds_tpu_report`): platform,
-    chip kind, per-device coords — the topology a mesh maps onto."""
-    import sys
-
-    out = out or sys.stdout
-    try:
-        import jax
-
-        devices = jax.devices()
-    except Exception as e:  # backend unavailable — report, don't crash
-        print(f"devices: unavailable ({e})", file=out)
-        return
-    print("-" * 64, file=out)
-    print("device / interconnect topology", file=out)
-    print("-" * 64, file=out)
-    print(f"{'platform':.<30} {devices[0].platform}", file=out)
-    print(f"{'device kind':.<30} {devices[0].device_kind}", file=out)
-    print(f"{'local devices':.<30} {len(jax.local_devices())}", file=out)
-    print(f"{'global devices':.<30} {len(devices)}", file=out)
-    print(f"{'processes':.<30} {jax.process_count()}", file=out)
-    for d in devices[:16]:
-        coords = getattr(d, "coords", None)
-        core = getattr(d, "core_on_chip", None)
-        extra = f" coords={coords}" if coords is not None else ""
-        extra += f" core={core}" if core is not None else ""
-        print(f"  device {d.id}: process={d.process_index}{extra}",
-              file=out)
-    if len(devices) > 16:
-        print(f"  ... {len(devices) - 16} more", file=out)
+__all__ = ["TraceProfiler", "device_report"]

@@ -164,6 +164,10 @@ def test_hlo_mode_clean_exit_zero(tmp_path):
     assert proc.returncode == 0
     assert payload["ok"] is True
     assert payload["findings_total"] == 0
+    # the audit JSON carries the telemetry schema tag so downstream
+    # tooling can join it with run event logs by version
+    from deepspeed_tpu.telemetry.events import SCHEMA_VERSION
+    assert payload["schema"] == SCHEMA_VERSION
 
 
 def test_memory_table_text_mode(tmp_path):

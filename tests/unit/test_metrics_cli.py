@@ -1,0 +1,157 @@
+"""Smoke tests for ``bin/ds_tpu_metrics`` (subprocess, CPU backend).
+
+The CLI is the operator-facing face of `deepspeed_tpu/telemetry/`:
+summarize a run's JSONL event log into a step-time/phase/MFU breakdown,
+tail recent events, and diff two runs with a CI-gateable regression
+threshold. Mirrors the ``ds_tpu_audit`` CLI test pattern.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.telemetry import JsonlExporter, TelemetrySession
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CLI = os.path.join(REPO, "bin", "ds_tpu_metrics")
+
+
+def run_cli(*args, check=True):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, CLI, *args],
+                          capture_output=True, text=True, env=env)
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"ds_tpu_metrics {' '.join(args)} exited "
+            f"{proc.returncode}\nstdout:\n{proc.stdout}\n"
+            f"stderr:\n{proc.stderr}")
+    return proc
+
+
+def write_log(path, step_wall=0.1, steps=4, loss=2.0,
+              flops_per_token=1000.0, tokens=512):
+    """A synthetic but schema-true run log, built through the real
+    session/exporter stack so the CLI reads exactly what a run writes."""
+    session = TelemetrySession(exporters=[JsonlExporter(str(path))])
+    session.emit("run_start", flavor="dense", zero_stage=0, n_devices=8,
+                 flops_per_token=flops_per_token)
+    session.emit("compile", step=0, flavor="dense", param_bytes=10 ** 6,
+                 static_peak_bytes=2 * 10 ** 6,
+                 flops_per_token=flops_per_token, batch_tokens=tokens)
+    for i in range(steps):
+        session.step_event(
+            step=i + 1, flavor="dense", wall_s=step_wall, loss=loss,
+            tokens=tokens,
+            phases={"dispatch": step_wall * 0.6,
+                    "device_wait": step_wall * 0.3})
+    session.emit("recompile", step=3, cache_size=2, expected=1,
+                 message="recompiled")
+    session.emit("health_guard", guard="loss_spike", action="warn",
+                 step=2, reason="spiked")
+    session.emit("checkpoint_save", step=4, tag="global_step4",
+                 path="/tmp/x", duration_s=0.5, async_save=False)
+    session.close()
+    return path
+
+
+def test_summary_text(tmp_path):
+    log = write_log(tmp_path / "run.jsonl")
+    proc = run_cli("summary", str(log))
+    out = proc.stdout
+    assert "dense flavor" in out
+    assert "schema ds-tpu-telemetry/" in out
+    assert "phase breakdown" in out
+    assert "dispatch" in out and "device_wait" in out
+    assert "mfu" in out.lower()
+    assert "1 recompile(s)" in out
+    assert "warn=1" in out   # health-guard trips grouped by action
+    assert "1 checkpoint save(s)" in out
+
+
+def test_summary_json_keys_and_mfu_math(tmp_path):
+    log = write_log(tmp_path / "run.jsonl", step_wall=0.1, steps=4,
+                    flops_per_token=1000.0, tokens=512)
+    proc = run_cli("summary", str(log), "--json", "--peak-tflops", "100")
+    s = json.loads(proc.stdout)
+    assert {"schema", "steps", "flavor", "wall_s", "step_s", "phases",
+            "tokens", "tokens_per_s", "mfu", "last_loss",
+            "events"} <= set(s)
+    assert s["steps"] == 4 and s["tokens"] == 4 * 512
+    assert s["step_s"]["mean"] == pytest.approx(0.1)
+    # tokens/s = 512 / 0.1; MFU = tps * flops_per_token / 1e12 / peak
+    tps = 512 / 0.1
+    assert s["tokens_per_s"] == pytest.approx(tps, rel=1e-6)
+    assert s["mfu"]["flops_per_token"] == 1000.0
+    assert s["mfu"]["mfu"] == pytest.approx(
+        tps * 1000.0 / 1e12 / 100.0, rel=1e-6)
+    # --flops-per-token overrides what the log stamped
+    proc = run_cli("summary", str(log), "--json",
+                   "--flops-per-token", "2000")
+    s2 = json.loads(proc.stdout)
+    assert s2["mfu"]["mfu"] == pytest.approx(2 * s["mfu"]["mfu"]
+                                             * 100.0 / 197.0, rel=1e-6)
+    assert s["events"]["recompile"] == 1
+    assert s["events"]["health_guard"] == {"warn": 1}
+    assert s["events"]["checkpoint_save"]["count"] == 1
+
+
+def test_tail(tmp_path):
+    log = write_log(tmp_path / "run.jsonl")
+    proc = run_cli("tail", str(log), "-n", "2")
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 2
+    assert "checkpoint_save" in lines[-1]
+    proc = run_cli("tail", str(log), "--event", "step", "-n", "1",
+                   "--json")
+    (evt,) = json.loads(proc.stdout.strip())
+    assert evt["event"] == "step" and evt["step"] == 4
+
+
+def test_diff_and_fail_over_gate(tmp_path):
+    base = write_log(tmp_path / "a.jsonl", step_wall=0.1)
+    cand = write_log(tmp_path / "b.jsonl", step_wall=0.15)
+    proc = run_cli("diff", str(base), str(cand))
+    assert "step_s.mean" in proc.stdout
+    assert "+50.0%" in proc.stdout
+    # 50% regression trips a 5% gate (exit 1) but not a 60% one
+    proc = run_cli("diff", str(base), str(cand), "--fail-over", "5",
+                   check=False)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    proc = run_cli("diff", str(base), str(cand), "--fail-over", "60")
+    assert proc.returncode == 0
+    # improvements never trip the gate
+    proc = run_cli("diff", str(cand), str(base), "--fail-over", "5")
+    assert proc.returncode == 0
+    proc = run_cli("diff", str(base), str(cand), "--json", check=False)
+    rows = json.loads(proc.stdout)["rows"]
+    mean = next(r for r in rows if r["metric"] == "step_s.mean")
+    assert mean["delta_pct"] == pytest.approx(50.0, abs=0.5)
+
+
+def test_missing_file_is_usage_error(tmp_path):
+    proc = run_cli("summary", str(tmp_path / "nope.jsonl"), check=False)
+    assert proc.returncode == 2
+    proc = run_cli(check=False)   # no subcommand
+    assert proc.returncode == 2
+
+
+def test_no_step_events_exits_one(tmp_path):
+    log = tmp_path / "empty.jsonl"
+    session = TelemetrySession(exporters=[JsonlExporter(str(log))])
+    session.emit("run_start", flavor="dense")
+    session.close()
+    proc = run_cli("summary", str(log), check=False)
+    assert proc.returncode == 1
+    assert "no step events" in (proc.stdout + proc.stderr).lower()
+
+
+def test_corrupt_lines_skipped(tmp_path):
+    log = write_log(tmp_path / "run.jsonl")
+    with open(log, "a") as f:
+        f.write("{truncated\n\n")
+    proc = run_cli("summary", str(log), "--json")
+    assert json.loads(proc.stdout)["steps"] == 4

@@ -7,7 +7,7 @@ import os
 import numpy as np
 
 import deepspeed_tpu
-from deepspeed_tpu.utils.profiler import TraceProfiler, device_report
+from deepspeed_tpu.telemetry.profiler import TraceProfiler, device_report
 from tests.unit.simple_model import base_config, random_batch, \
     simple_init_params, simple_loss_fn
 
