@@ -56,8 +56,10 @@ STEP_FLAVORS = ("dense", "zero1", "zero2", "zero3", "offload", "quantized",
 # Extra toy flavors the CLI accepts but the default sweep (and the
 # un-slow flavor test matrix) skips — heavier compiles exercising
 # specific subsystems. `pipeline_tp` runs pipe x model x data with
-# tensor_parallel.overlap on, driving the overlap rule end-to-end.
-EXTRA_FLAVORS = ("pipeline_tp",)
+# tensor_parallel.overlap on, driving the overlap rule end-to-end;
+# `fp8` runs GPT-2-tiny with fp8 delayed-scaling matmuls + the
+# quantized ZeRO-3 gather wire, driving the fp8 rule end-to-end.
+EXTRA_FLAVORS = ("pipeline_tp", "fp8")
 
 
 class AuditError(RuntimeError):
@@ -188,6 +190,9 @@ def _engine_flavor(engine):
         return "onebit"
     if engine.sparse_gradients_enabled():
         return "sparse"
+    fp8 = getattr(cfg, "fp8", None)
+    if fp8 is not None and (fp8.enabled or fp8.wire_enabled):
+        return "fp8"
     stage = engine.zero_optimization_stage()
     return f"zero{stage}" if stage else "dense"
 
@@ -202,7 +207,11 @@ def _engine_fn_args(engine, placed, rng, lr):
     else:
         args = [engine.params, engine.opt_state, engine.device_state,
                 placed, rng, lr]
-        if hasattr(step, "inner"):   # error-feedback residual threading
+        if getattr(step, "fp8", False):
+            # fp8 amax-state threading; discovery is idempotent, so an
+            # audit that lowers before the first step call allocates it.
+            args.append(engine._ensure_fp8_state(placed, rng))
+        elif hasattr(step, "inner"):   # error-feedback residual threading
             args.append(engine._qcomm_residuals)
     if engine._fault_arg:
         args.append(jnp.asarray(1.0))
@@ -297,6 +306,8 @@ def _engine_context(engine, hlo_text, expected, pinfo, jaxpr_facts=None):
         declared_donate_argnums=declared,
         overlap_enabled=bool(tp is not None and tp.overlap_enabled),
         overlap_chunks=int(tp.overlap_chunks) if tp is not None else 1,
+        fp8_enabled=bool(cfg.fp8.enabled),
+        fp8_wire_dtype=cfg.fp8.active_wire_dtype(),
         jaxpr_divergent=facts.get("divergent"),
         jaxpr_unordered=facts.get("unordered"),
         reshard_events=facts.get("reshard_events"),
@@ -361,6 +372,8 @@ def _hlo_stats(hlo_text, ctx):
     loops = while_loops(hlo_text)
     stats = {
         "collective_bytes": collective_bytes(hlo_text),
+        "collective_bytes_by_dtype": collective_bytes(hlo_text,
+                                                      by_dtype=True),
         "collective_bytes_flat": collective_bytes(hlo_text,
                                                   trip_aware=False),
         "ring_send_bytes": ring_send_bytes(hlo_text,
@@ -555,6 +568,31 @@ def build_flavor_engine(flavor, config_overrides=None):
         rng = np.random.default_rng(0)
         batch = {"input_ids": rng.integers(
             0, 64, (rows, seq)).astype(np.int32)}
+        return engine, batch
+
+    if flavor == "fp8":
+        # fp8 delayed-scaling matmuls on GPT-2-tiny (the model whose
+        # Dense layers route through `ops/fp8.py:fp8_dot_general`) plus
+        # the quantized ZeRO-3 gather wire — the flavor the fp8 rule
+        # audits end-to-end.
+        from deepspeed_tpu.models.gpt2 import (
+            GPT2LMHead, gpt2_tiny, init_gpt2_params, make_gpt2_loss_fn)
+        rows, seq = 8, 16
+        model = GPT2LMHead(gpt2_tiny())
+        params = init_gpt2_params(model, jax.random.PRNGKey(0))
+        cfg = {"train_batch_size": rows,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 10 ** 9,
+               "bf16": {"enabled": True},
+               "zero_optimization": {"stage": 3, "gather_chunks": 2},
+               "fp8": {"enabled": True,
+                       "wire": {"enabled": True, "dtype": "f8e4m3fn"}}}
+        cfg.update(config_overrides or {})
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=cfg, loss_fn=make_gpt2_loss_fn(model), params=params)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(
+            0, 255, (rows, seq)).astype(np.int32)}
         return engine, batch
 
     cfg = _dense_family_config(flavor)

@@ -323,6 +323,37 @@ def collective_bytes(hlo_text, by_dtype=False, trip_aware=True):
     return flat
 
 
+# Result shapes of fp8 family, e.g. "%q = f8e4m3fn[64,256] convert(...)".
+# Tuple results open with "(", so the optional paren is matched too.
+_FP8_RESULT_RE = re.compile(r"=\s*\(?\s*(f8[a-z0-9]+)\[")
+
+
+def fp8_value_counts(hlo_text, trip_aware=True):
+    """Execution counts of ops producing fp8-typed values: ``{dtype: n}``.
+
+    The fp8 qdq pair (`ops/fp8.py`) lowers each quantize to a
+    ``convert`` whose RESULT shape is an fp8 dtype — on CPU the converts
+    stay explicit next to the f32 dot, on TPU XLA fuses them into the
+    native fp8 GEMM, but either way the lowered text carries the
+    fp8-typed values. Forward operands show as ``f8e4m3fn``, backward
+    cotangents as ``f8e5m2`` — the fp8 audit rule pins both. With
+    ``trip_aware=True`` ops inside while/scan bodies count once per
+    trip (same accounting as :func:`collective_counts`)."""
+    mult = computation_multipliers(hlo_text) if trip_aware else {}
+    if mult:
+        comps, _ = split_computations(hlo_text)
+        segments = [("\n".join(lines), mult.get(name, 0))
+                    for name, lines in comps.items()]
+    else:
+        segments = [(hlo_text, 1)]
+    out = {}
+    for text, m in segments:
+        for hit in _FP8_RESULT_RE.finditer(text):
+            dt = hit.group(1)
+            out[dt] = out.get(dt, 0) + m
+    return out
+
+
 # Per-device ring-algorithm send bytes as a multiple of the op's OUTPUT
 # bytes (N = ring size): all-reduce sends 2·(N-1)/N · M; all-gather sends
 # (N-1)/N · M (output M, shard M/N moved N-1 times); reduce-scatter

@@ -24,6 +24,7 @@ from deepspeed_tpu.analysis.hlo import (
     collective_bytes,
     collective_counts,
     collective_ops,
+    fp8_value_counts,
     host_transfer_ops,
     while_loops,
 )
@@ -75,6 +76,14 @@ class StepContext:
     # step actually carries the chunked ppermute rings.
     overlap_enabled: bool = False
     overlap_chunks: int = 1
+    # fp8 (`ops/fp8.py` + the quantized collective wire): fp8_enabled
+    # promises qdq matmuls (f8e4m3fn forward operands, f8e5m2 backward
+    # cotangents in the lowered text); fp8_wire_dtype (a codec name from
+    # `runtime/comm/codecs.py`) promises quantized collective payloads —
+    # 1-byte wire buffers (the bitcast-packed u8 from `encode_wire`, or
+    # raw s8/f8 elements) moving through the gather/ring family.
+    fp8_enabled: bool = False
+    fp8_wire_dtype: str = None
     # Explicit ZeRO-3 gather-on-use schedule (`zero/stage3.py:Zero3Plan`):
     # how many sharded leaves gather per use, the ring chunking, and the
     # largest single gathered leaf in compute-dtype bytes. gather_leaves
@@ -171,7 +180,14 @@ def rule_dtype_hygiene(ctx):
     `zero/sharding.py:make_param_caster`), and under comm_quantization
     the gradient all-reduce must have been replaced by the int8 exchange
     entirely. Anything above those allowances is a silent upcast paying
-    2x wire bytes."""
+    2x wire bytes.
+
+    fp8 runs need no extra allowance: the quantized wire packs its
+    per-chunk f32 scales INSIDE the bitcast u8 buffers
+    (`runtime/comm/codecs.py:encode_wire`), and the delayed-scaling
+    amax state moves as tiny f32 max-reductions (a few histories of
+    ``amax_history_len`` floats each) that sit well inside the 4KB
+    slack floor."""
     low_precision = ctx.compute_dtype in ("bf16", "f16")
     if not low_precision and not ctx.comm_quantized:
         return []
@@ -589,6 +605,65 @@ def rule_peak_memory(ctx):
          "zero_stage": ctx.zero_stage, "param_bytes": m_bytes})]
 
 
+def rule_fp8(ctx):
+    """The promised fp8 compute and quantized wire must be in the HLO.
+
+    ``fp8_enabled`` promises qdq matmuls: the lowered step must carry
+    ``f8e4m3fn``-typed values (forward-operand quantizes — on CPU the
+    explicit converts next to the f32 dot, on TPU the operands of the
+    fused native fp8 GEMM) AND ``f8e5m2``-typed values (the backward
+    cotangent quantizes); either missing means the fp8 rewiring was
+    silently dropped — paying bf16/fp32 compute while claiming fp8.
+
+    ``fp8_wire_dtype`` promises quantized collective payloads: at least
+    one collective must move a 1-byte element type (the bitcast-packed
+    ``u8`` wire buffer from `runtime/comm/codecs.py:encode_wire`, or
+    raw ``s8``/fp8 elements). Zero 1-byte collective bytes means every
+    ring/gather still ships full precision."""
+    if not ctx.fp8_enabled and not ctx.fp8_wire_dtype:
+        return []
+    findings = []
+    if ctx.fp8_enabled:
+        counts = fp8_value_counts(ctx.hlo_text)
+        e4 = sum(n for dt, n in counts.items() if dt.startswith("f8e4m3"))
+        e5 = counts.get("f8e5m2", 0)
+        if e4 == 0:
+            findings.append(Finding(
+                "fp8", SEV_ERROR,
+                "fp8 is enabled but the lowered step carries no "
+                "f8e4m3fn-typed values — no forward operand is "
+                "quantized; the fp8 matmul rewiring did not reach the "
+                "compiled program",
+                {"fp8_value_counts": counts}))
+        if e5 == 0:
+            findings.append(Finding(
+                "fp8", SEV_ERROR,
+                "fp8 is enabled but the lowered step carries no "
+                "f8e5m2-typed values — backward cotangents are not "
+                "quantized (out_qdq missing from the backward)",
+                {"fp8_value_counts": counts}))
+    if ctx.fp8_wire_dtype:
+        cb = collective_bytes(ctx.hlo_text, by_dtype=True)
+        wire = 0
+        for op, d in cb.items():
+            if op == "total":
+                continue
+            wire += sum(b for dt, b in d.items()
+                        if dt in ("u8", "s8") or dt.startswith("f8"))
+        if wire == 0:
+            findings.append(Finding(
+                "fp8", SEV_ERROR,
+                f"fp8 wire_dtype={ctx.fp8_wire_dtype!r} promises "
+                f"quantized collective payloads but no collective moves "
+                f"a 1-byte element type — every gather/ring still ships "
+                f"full precision",
+                {"wire_dtype": ctx.fp8_wire_dtype,
+                 "collective_bytes_by_dtype":
+                     {op: dict(d) for op, d in cb.items()
+                      if op != "total"}}))
+    return findings
+
+
 # Rule catalog: id -> rule. `recompile` is listed for config validation
 # but runs in the orchestrator (it needs live step objects, not HLO).
 RULES = {
@@ -601,6 +676,7 @@ RULES = {
     "deadlock": rule_deadlock,
     "resharding": rule_resharding,
     "peak_memory": rule_peak_memory,
+    "fp8": rule_fp8,
 }
 RULE_IDS = tuple(RULES) + ("recompile",)
 

@@ -9,6 +9,7 @@ Megatron-style tensor-parallel PartitionSpecs over the ``model`` mesh axis.
 """
 
 import dataclasses
+import functools
 from typing import Any, Optional
 
 import jax
@@ -16,6 +17,15 @@ import jax.numpy as jnp
 import flax.linen as nn
 from flax.traverse_util import flatten_dict, unflatten_dict
 from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.fp8 import fp8_dot_general
+
+
+def _fp8_dot(site):
+    """Per-site ``dot_general`` hook: plain ``lax.dot_general`` unless an
+    ``fp8_scope`` is active at trace time (the head matmul and attention
+    einsums stay full precision — the standard fp8 recipe)."""
+    return functools.partial(fp8_dot_general, site=site)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,7 +104,7 @@ class CausalSelfAttention(nn.Module):
         B, T, C = x.shape
         H = cfg.n_head
         qkv = nn.Dense(3 * C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                       name="c_attn")(x)
+                       dot_general=_fp8_dot("c_attn"), name="c_attn")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, C // H)
         k = k.reshape(B, T, H, C // H)
@@ -124,7 +134,7 @@ class CausalSelfAttention(nn.Module):
             y = jnp.einsum("bhts,bshd->bthd", att, v)
         y = y.reshape(B, T, C)
         y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                     name="c_proj")(y)
+                     dot_general=_fp8_dot("c_proj"), name="c_proj")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         return y
 
@@ -137,10 +147,10 @@ class MLP(nn.Module):
         cfg = self.config
         C = x.shape[-1]
         h = nn.Dense(4 * C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                     name="c_fc")(x)
+                     dot_general=_fp8_dot("c_fc"), name="c_fc")(x)
         h = nn.gelu(h, approximate=True)
         h = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                     name="c_proj")(h)
+                     dot_general=_fp8_dot("c_proj"), name="c_proj")(h)
         h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         return h
 

@@ -1,0 +1,263 @@
+"""fp8 matmuls with per-tensor delayed scaling (Transformer-Engine
+recipe, functional-JAX form).
+
+The GEMM itself is a plain ``lax.dot_general`` over quantize-dequantized
+operands: each operand is scaled into the fp8 range, cast to
+``float8_e4m3fn`` and immediately back (``qdq``), and the dot runs on the
+dequantized values. On TPU/GPU, XLA pattern-matches the
+``convert(f8) @ convert(f8)`` pair into a native fp8 GEMM; on CPU the
+converts stay explicit — which is exactly what the audit's HLO pin
+checks for (``f8e4m3fn`` dot operands in the lowered text).
+
+Scaling state (the delayed-scaling recipe):
+
+- forward operands quantize to ``f8e4m3fn`` (qmax 448), backward
+  cotangents to ``f8e5m2`` (qmax 57344) — the standard fwd-range /
+  bwd-dynamic-range split;
+- ``scale = max(amax_history) / (qmax / 2**margin)``, with an all-zero
+  history bootstrapping to scale 1;
+- each call records the current ``|x|`` max by rolling it into the
+  history.
+
+The state plumbing uses the gradient-as-state-update trick (the flax
+``fp8_ops`` pattern): amax histories are *differentiable arguments* of
+the qdq ``custom_vjp``s, whose backward returns the UPDATED history as
+the history's "gradient". The engine differentiates the loss w.r.t.
+``(params, fp8_state)`` and the fp8-state "grads" simply ARE the next
+step's state — no trace-time mutation, no stale closures, and
+``jax.checkpoint`` replays (which re-run the traced body, not the
+Python) stay consistent.
+
+Call sites reach the machinery through :func:`fp8_dot_general`, a
+drop-in ``dot_general`` replacement (e.g. flax ``nn.Dense(dot_general=
+fp8_dot_general)``) that reads the trace-time :func:`fp8_scope` exactly
+like the overlap plan: no scope → plain ``lax.dot_general`` (zero
+overhead when fp8 is off). With a scope but no state dict (the manual
+TP/pipeline path, where per-site state threading isn't available) it
+falls back to *current scaling* — scales from the current amax, no
+history.
+"""
+
+import contextlib
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+
+def compute_scale(history, qmax, margin=0):
+    """Delayed scale from an amax history: ``max(h) / (qmax / 2**margin)``
+    with an empty (all-zero) history bootstrapping to scale 1."""
+    amax = jnp.max(history)
+    amax = jnp.where(amax > 0.0, amax, 1.0)
+    return (amax / (qmax / (2.0 ** margin))).astype(jnp.float32)
+
+
+def update_history(history, x):
+    """Roll the current ``|x|`` max into the front of the history."""
+    amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    return jnp.concatenate([amax[None], history[:-1]])
+
+
+def quantize_dequantize(x, scale, qmax, dtype):
+    """Scale into the fp8 range, saturate-cast to ``dtype`` and back —
+    the qdq pair XLA fuses into a native fp8 GEMM operand."""
+    scaled = (x.astype(jnp.float32) / scale)
+    q = jnp.clip(scaled, -qmax, qmax).astype(dtype)
+    return (q.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+# ----------------------------------------------------------------------
+# delayed scaling: history-carrying qdq pair (grad-as-state-update)
+# ----------------------------------------------------------------------
+
+@jax.custom_vjp
+def in_qdq(x, history):
+    """Forward-operand qdq (``f8e4m3fn``) against the delayed scale from
+    ``history``. Differentiating w.r.t. ``history`` yields the UPDATED
+    history — the engine treats that "gradient" as the next state."""
+    scale = compute_scale(history, E4M3_MAX, _MARGIN[0])
+    return quantize_dequantize(x, scale, E4M3_MAX, jnp.float8_e4m3fn)
+
+
+def _in_qdq_fwd(x, history):
+    scale = compute_scale(history, E4M3_MAX, _MARGIN[0])
+    y = quantize_dequantize(x, scale, E4M3_MAX, jnp.float8_e4m3fn)
+    return y, update_history(history, x)
+
+
+def _in_qdq_bwd(new_history, g):
+    # Straight-through on x (qdq is identity inside the representable
+    # range); the history's "cotangent" carries the roll-in update.
+    return g, new_history
+
+
+in_qdq.defvjp(_in_qdq_fwd, _in_qdq_bwd)
+
+
+@jax.custom_vjp
+def out_qdq(y, history):
+    """Identity forward; the BACKWARD qdq-quantizes the cotangent to
+    ``f8e5m2`` against the delayed scale from ``history`` and returns
+    the updated history (amax of the cotangent) as its "gradient"."""
+    del history
+    return y
+
+
+def _out_qdq_fwd(y, history):
+    # The margin rides in the residuals: the forward traces INSIDE the
+    # active fp8_scope, but the backward is traced by the surrounding
+    # value_and_grad AFTER the scope's contextmanager has exited — a
+    # global read there would see the restored (stale) margin.
+    return y, (history, _MARGIN[0])
+
+
+def _out_qdq_bwd(res, g):
+    history, margin = res
+    scale = compute_scale(history, E5M2_MAX, margin)
+    gq = quantize_dequantize(g, scale, E5M2_MAX, jnp.float8_e5m2)
+    return gq, update_history(history, g)
+
+
+out_qdq.defvjp(_out_qdq_fwd, _out_qdq_bwd)
+
+
+# ----------------------------------------------------------------------
+# current scaling: stateless variants for the manual TP / pipeline path
+# ----------------------------------------------------------------------
+
+def _current_scale(x, qmax, margin):
+    amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    amax = jnp.where(amax > 0.0, amax, 1.0)
+    return amax / (qmax / (2.0 ** margin))
+
+
+def in_qdq_current(x, margin=0):
+    """Stateless forward qdq: scale from the CURRENT amax (one extra
+    reduction per operand, no history to thread)."""
+    scale = _current_scale(x, E4M3_MAX, margin)
+    return quantize_dequantize(x, scale, E4M3_MAX, jnp.float8_e4m3fn)
+
+
+@jax.custom_vjp
+def out_qdq_current(y, margin):
+    return y
+
+
+def _oqc_fwd(y, margin):
+    return y, margin
+
+
+def _oqc_bwd(margin, g):
+    scale = _current_scale(g, E5M2_MAX, margin)
+    gq = quantize_dequantize(g, scale, E5M2_MAX, jnp.float8_e5m2)
+    return gq, None
+
+
+out_qdq_current.defvjp(_oqc_fwd, _oqc_bwd)
+
+
+# ----------------------------------------------------------------------
+# trace-time scope (mirrors overlap_scope) + the dot_general entry point
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Fp8Plan:
+    """The resolved ``fp8`` config block: scaling margin, history length,
+    and per-site overrides (``{site: {"enabled": bool}}``)."""
+    margin: int = 0
+    amax_history_len: int = 16
+    sites: dict = dataclasses.field(default_factory=dict)
+
+    def site_enabled(self, name):
+        ov = (self.sites or {}).get(name) or {}
+        return ov.get("enabled", True) is not False
+
+
+_FP8_PLAN = None
+_FP8_STATE = None        # {"<site>:<idx>": history} or None (current scaling)
+_FP8_DISCOVER = None     # list collecting state keys in trace order
+_FP8_COUNTS = None       # per-site call counter (reset at scope entry)
+# margin travels through a one-slot list so the module-level custom_vjps
+# above stay closure-free (their traces are cached on the fn objects;
+# the margin is read at trace time, inside the active scope).
+_MARGIN = [0]
+
+
+@contextlib.contextmanager
+def fp8_scope(plan, state=None, discover=None):
+    """Declare an :class:`Fp8Plan` active for layers traced within this
+    context (trace-time only, exactly like ``overlap_scope``).
+
+    ``state`` maps ``"<site>:<idx>"`` keys — per-site trace-order call
+    indices — to amax-history bundles ``{"in": [H], "kernel": [H],
+    "out": [H]}`` for delayed scaling; ``state=None`` selects stateless
+    current scaling. ``discover`` (a list) records the keys a trace
+    touches instead of consuming state — the engine's state-discovery
+    pass."""
+    global _FP8_PLAN, _FP8_STATE, _FP8_DISCOVER, _FP8_COUNTS
+    prev = (_FP8_PLAN, _FP8_STATE, _FP8_DISCOVER, _FP8_COUNTS, _MARGIN[0])
+    _FP8_PLAN, _FP8_STATE, _FP8_DISCOVER = plan, state, discover
+    _FP8_COUNTS = {}
+    _MARGIN[0] = int(plan.margin) if plan is not None else 0
+    try:
+        yield
+    finally:
+        (_FP8_PLAN, _FP8_STATE, _FP8_DISCOVER, _FP8_COUNTS,
+         _MARGIN[0]) = prev
+
+
+def fp8_plan():
+    """The active :class:`Fp8Plan`, or None outside any scope."""
+    return _FP8_PLAN
+
+
+def init_history(length):
+    """A fresh all-zero amax history (bootstraps to scale 1)."""
+    return jnp.zeros((int(length),), jnp.float32)
+
+
+def init_state_bundle(length):
+    """Zero state for one fp8 dot site: histories for the two forward
+    operands and the backward cotangent."""
+    return {"in": init_history(length), "kernel": init_history(length),
+            "out": init_history(length)}
+
+
+def fp8_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                    preferred_element_type=None, site="dense"):
+    """Drop-in ``lax.dot_general`` that routes through the fp8 qdq pair
+    when an :func:`fp8_scope` is active (and the site enabled). Plug it
+    into flax via ``nn.Dense(dot_general=fp8_dot_general)`` — with no
+    scope it IS ``lax.dot_general``."""
+    plan = _FP8_PLAN
+    if plan is None or not plan.site_enabled(site):
+        return lax.dot_general(
+            lhs, rhs, dimension_numbers, precision=precision,
+            preferred_element_type=preferred_element_type)
+    if _FP8_STATE is None and _FP8_DISCOVER is None:
+        # manual TP / pipeline path: stateless current scaling
+        lhs_q = in_qdq_current(lhs, plan.margin)
+        rhs_q = in_qdq_current(rhs, plan.margin)
+        y = lax.dot_general(
+            lhs_q, rhs_q, dimension_numbers, precision=precision,
+            preferred_element_type=preferred_element_type)
+        return out_qdq_current(y, plan.margin)
+    idx = _FP8_COUNTS.get(site, 0)
+    _FP8_COUNTS[site] = idx + 1
+    key = f"{site}:{idx}"
+    if _FP8_DISCOVER is not None:
+        _FP8_DISCOVER.append(key)
+        bundle = init_state_bundle(plan.amax_history_len)
+    else:
+        bundle = _FP8_STATE[key]
+    lhs_q = in_qdq(lhs, bundle["in"])
+    rhs_q = in_qdq(rhs, bundle["kernel"])
+    y = lax.dot_general(
+        lhs_q, rhs_q, dimension_numbers, precision=precision,
+        preferred_element_type=preferred_element_type)
+    return out_qdq(y, bundle["out"])

@@ -35,6 +35,23 @@ monolithic collective bit-for-bit. Layers opt in per call site through
 the trace-time :func:`overlap_scope` / :func:`overlap_plan` pair, which
 mirrors :func:`manual_axes` and is driven by the engine's
 ``tensor_parallel.overlap`` config block.
+
+Quantized wire (EQuARX, arXiv:2506.17615): every ring primitive takes a
+``wire_dtype`` hook naming a codec from the shared registry
+(``runtime/comm/codecs.py`` — ``int8`` / ``f8e4m3fn`` / ``f8e5m2``). With
+a codec set, each rotate step moves the chunk *encoded* — payload and
+per-chunk f32 scales byte-packed into one u8 buffer riding a single
+``ppermute`` — and the receiver dequantize-accumulates in fp32
+concurrently with the next chunk's matmul, so the quantize/dequantize
+work pipelines *inside* the collective instead of bracketing it. A
+rank's own contribution is always taken exactly, and reducing rings
+encode each contribution exactly once at its origin (the rotating buffer
+is forwarded unchanged); the traveling-accumulator ring of
+:func:`matmul_reduce_scatter` is the one documented exception (it
+re-encodes per hop — the EQuARX accuracy/bandwidth trade). With
+``chunks=1`` the wire routes through the bracketed
+quantize→monolithic-collective path (ascending-rank decode-sum, own
+contribution exact) — the bit-identity reference the parity tests pin.
 """
 
 import contextlib
@@ -44,6 +61,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from deepspeed_tpu.runtime.comm.codecs import (
+    decode_wire, encode_wire, get_codec)
 
 _MANUAL_AXES = ()
 
@@ -95,7 +115,8 @@ def gather_from_chunk_servers(tree, axis_name):
         lambda v: lax.all_gather(v, axis_name), tree)
 
 
-def psum_grad(x, axis_name, chunks=1, bidirectional=False):
+def psum_grad(x, axis_name, chunks=1, bidirectional=False,
+              wire_dtype=None, wire_chunk=512):
     """Identity in forward; ``psum`` of the cotangent over ``axis_name`` in
     backward. Makes grads of tensors consumed by axis-partitioned compute
     exact (each rank's backward contributes only its shard's part).
@@ -104,7 +125,8 @@ def psum_grad(x, axis_name, chunks=1, bidirectional=False):
     chunked rotate-accumulate ring (:func:`ring_psum`) so the cotangent
     exchange can overlap adjacent backward matmuls; ``chunks=1`` (the
     default) keeps ``lax.psum`` — bit-identical to the historical
-    behavior."""
+    behavior. ``wire_dtype`` quantizes the cotangent exchange through the
+    codec registry (see :func:`ring_psum`)."""
 
     @jax.custom_vjp
     def _f(y):
@@ -114,9 +136,11 @@ def psum_grad(x, axis_name, chunks=1, bidirectional=False):
         return y, None
 
     def _bwd(_, g):
-        if chunks > 1:
+        if chunks > 1 or wire_dtype is not None:
             return (ring_psum(g, axis_name, chunks=chunks,
-                              bidirectional=bidirectional),)
+                              bidirectional=bidirectional,
+                              wire_dtype=wire_dtype,
+                              wire_chunk=wire_chunk),)
         return (lax.psum(g, axis_name),)
 
     _f.defvjp(_fwd, _bwd)
@@ -168,28 +192,38 @@ OVERLAP_SITES = ("row_parallel", "column_parallel", "expert_combine",
 
 @dataclasses.dataclass(frozen=True)
 class SitePlan:
-    """Resolved overlap parameters for one call site."""
+    """Resolved overlap parameters for one call site. ``wire_dtype``
+    (a codec name, or None for full-precision wire) and ``wire_chunk``
+    (the per-scale chunk length) select the quantized-wire path."""
     chunks: int = 1
     bidirectional: bool = False
+    wire_dtype: str = None
+    wire_chunk: int = 512
 
 
 @dataclasses.dataclass(frozen=True)
 class OverlapPlan:
     """The ``tensor_parallel.overlap`` block, resolved: global chunk
-    count / ring direction plus per-site overrides
-    (``{site: {"enabled", "chunks", "bidirectional"}}``)."""
+    count / ring direction / wire codec plus per-site overrides
+    (``{site: {"enabled", "chunks", "bidirectional", "wire_dtype",
+    "wire_chunk"}}``)."""
     chunks: int = 4
     bidirectional: bool = False
     sites: dict = dataclasses.field(default_factory=dict)
+    wire_dtype: str = None
+    wire_chunk: int = 512
 
     def site(self, name):
         """SitePlan for ``name``, or None when the site is disabled."""
         ov = (self.sites or {}).get(name) or {}
         if ov.get("enabled", True) is False:
             return None
+        wd = ov.get("wire_dtype", self.wire_dtype)
         return SitePlan(
             chunks=int(ov.get("chunks", self.chunks)),
-            bidirectional=bool(ov.get("bidirectional", self.bidirectional)))
+            bidirectional=bool(ov.get("bidirectional", self.bidirectional)),
+            wire_dtype=(str(wd) if wd else None),
+            wire_chunk=int(ov.get("wire_chunk", self.wire_chunk)))
 
 
 _OVERLAP_PLAN = None
@@ -337,7 +371,93 @@ def _ordered_ppermute(buf, axis_name, perm, dep):
     return out, out
 
 
-def ring_psum(x, axis_name, chunks=1, bidirectional=False):
+# ---------------------------------------------------------------------------
+# quantized wire: bracketed monolithic references + chunked wire rings
+# ---------------------------------------------------------------------------
+
+def _wire_psum_monolithic(x, axis_name, codec, wire_chunk,
+                          dep=None, site="ring_psum"):
+    """Bracketed quantize→monolithic-collective all-reduce: encode the
+    local contribution once, ``all_gather`` the packed u8 wire buffers,
+    then decode-sum in ascending rank order with this rank's own
+    contribution taken exactly (fp32 accumulate, cast back at the end).
+    This IS the reference semantics the chunked wire rings route to at
+    ``chunks=1`` — the parity tests reproduce it literally."""
+    codec = get_codec(codec)
+    n = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+    wire = encode_wire(x, codec, wire_chunk)
+    log_collective_site(site, axis_name, "all_gather")
+    rows = lax.all_gather(barrier_after(wire, dep), axis_name, axis=0)
+    xf = x.astype(jnp.float32)
+    acc = jnp.zeros(x.shape, jnp.float32)
+    for i in range(n):
+        dec = decode_wire(rows[i], codec, x.shape, jnp.float32, wire_chunk)
+        acc = acc + jnp.where(jnp.equal(i, r), xf, dec)
+    return acc.astype(x.dtype)
+
+
+def _wire_all_gather_monolithic(x, axis_name, axis, codec, wire_chunk,
+                                dep=None, site="ring_all_gather"):
+    """Bracketed quantized all-gather: encode the local shard once,
+    ``all_gather`` the wire buffers, decode each row into its owner's
+    slot — the own row placed exactly. Returns ``(gathered, dep)``."""
+    codec = get_codec(codec)
+    n = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+    k_loc = x.shape[axis]
+    wire = encode_wire(x, codec, wire_chunk)
+    log_collective_site(site, axis_name, "all_gather")
+    rows = lax.all_gather(barrier_after(wire, dep), axis_name, axis=0)
+    out_shape = list(x.shape)
+    out_shape[axis] = n * k_loc
+    out = jnp.zeros(out_shape, x.dtype)
+    for i in range(n):
+        dec = decode_wire(rows[i], codec, x.shape, x.dtype, wire_chunk)
+        piece = jnp.where(jnp.equal(i, r), x, dec)
+        out = lax.dynamic_update_slice_in_dim(out, piece, i * k_loc,
+                                              axis=axis)
+    return out, rows
+
+
+def _ring_psum_wire(x, axis_name, chunks, bidirectional, codec,
+                    wire_chunk):
+    """Chunked quantized rotate-accumulate ring: each chunk is encoded
+    exactly once at its origin; the packed wire buffer (payload +
+    scales) rotates unchanged while every receiver decode-accumulates
+    into an fp32 accumulator seeded with its own exact piece."""
+    codec = get_codec(codec)
+    n = lax.psum(1, axis_name)
+    slices = _chunk_slices(x.shape[-1], chunks)
+    k = len(slices)
+    hops = n - 1
+    log_collective_site("ring_psum", axis_name, "ppermute",
+                        chunks=k, hops=hops)
+    state = [None] * k            # (fp32 acc, wire buf, piece shape)
+    dep = None
+    for step in range(k + hops):
+        if step < k:
+            st, sz = slices[step]
+            piece = lax.slice_in_dim(x, st, st + sz, axis=-1)
+            state[step] = (piece.astype(jnp.float32),
+                           encode_wire(piece, codec, wire_chunk),
+                           piece.shape)
+        for j in range(max(0, step - hops), min(step, k)):
+            acc, buf, shp = state[j]
+            buf, dep = _ordered_ppermute(
+                buf, axis_name,
+                _ring_perm(n, bidirectional and j % 2 == 1), dep)
+            acc = acc + decode_wire(buf, codec, shp, jnp.float32,
+                                    wire_chunk)
+            state[j] = (acc, buf, shp)
+    pieces = [acc.astype(x.dtype) for acc, _, _ in state]
+    if k == 1:
+        return pieces[0]
+    return jnp.concatenate(pieces, axis=-1)
+
+
+def ring_psum(x, axis_name, chunks=1, bidirectional=False,
+              wire_dtype=None, wire_chunk=512):
     """Rotate-accumulate ring psum: ``buf = ppermute(buf); acc += buf``
     for n-1 hops — each hop forwards the value just *received*, so after
     n-1 hops every rank holds the full sum as n-1 ``collective-permute``s
@@ -348,10 +468,24 @@ def ring_psum(x, axis_name, chunks=1, bidirectional=False):
     issue against chunk *i+1*'s slicing/adds, and XLA's scheduler can
     overlap them with adjacent compute). ``bidirectional`` sends
     even-indexed chunks one way around the ring and odd-indexed chunks
-    the other, halving the per-direction ring latency."""
+    the other, halving the per-direction ring latency.
+
+    ``wire_dtype`` names a codec from the shared registry
+    (``runtime/comm/codecs.py``): the exchange then moves quantized
+    payloads + packed per-chunk scales instead of ``x.dtype``.
+    ``chunks <= 1`` with a wire routes through the bracketed
+    quantize→monolithic-collective reference; ``chunks > 1`` runs the
+    encode-once quantized ring pipelined exactly like the full-precision
+    wavefront."""
     n = lax.psum(1, axis_name)
     if n == 1:
         return x
+    if wire_dtype is not None:
+        if x.ndim == 0 or chunks <= 1:
+            return _wire_psum_monolithic(x, axis_name, wire_dtype,
+                                         wire_chunk)
+        return _ring_psum_wire(x, axis_name, chunks, bidirectional,
+                               wire_dtype, wire_chunk)
     if x.ndim == 0 or chunks <= 1:
         slices = [None]          # one ring over the whole tensor
     else:
@@ -380,7 +514,8 @@ def ring_psum(x, axis_name, chunks=1, bidirectional=False):
 
 
 def ring_all_gather(x, axis_name, axis=0, chunks=1, bidirectional=False,
-                    dep=None, site="ring_all_gather"):
+                    dep=None, site="ring_all_gather", wire_dtype=None,
+                    wire_chunk=512):
     """Gather every rank's shard of ``x`` along ``axis``, returning
     ``(gathered, dep)`` where ``dep`` threads the :func:`barrier_after`
     chain to the caller (pass it into the next gather so consecutive
@@ -393,16 +528,25 @@ def ring_all_gather(x, axis_name, axis=0, chunks=1, bidirectional=False,
     dep-chained ``ppermute`` hops and placed into the output at its
     owner's offset, so stripe transfers interleave with the consuming
     compute instead of blocking on one monolithic collective.
-    ``bidirectional`` alternates ring direction per stripe."""
+    ``bidirectional`` alternates ring direction per stripe.
+
+    ``wire_dtype`` names a codec from the shared registry: stripes move
+    quantized (payload + packed scales in one u8 buffer) and decode on
+    arrival; the local stripe is placed exactly. ``chunks <= 1`` with a
+    wire is the bracketed encode→``all_gather``→decode reference."""
     n = lax.psum(1, axis_name)
     if n == 1:
         return x, dep
     k_loc = x.shape[axis]
+    if wire_dtype is not None and (chunks <= 1 or k_loc < 2):
+        return _wire_all_gather_monolithic(x, axis_name, axis, wire_dtype,
+                                           wire_chunk, dep=dep, site=site)
     if chunks <= 1 or k_loc < 2:
         log_collective_site(site, axis_name, "all_gather")
         out = lax.all_gather(barrier_after(x, dep), axis_name,
                              axis=axis, tiled=True)
         return out, out
+    codec = get_codec(wire_dtype)
     slices = _chunk_slices(k_loc, chunks)
     log_collective_site(site, axis_name, "ppermute",
                         chunks=len(slices), hops=n - 1)
@@ -414,13 +558,22 @@ def ring_all_gather(x, axis_name, axis=0, chunks=1, bidirectional=False,
         rev = bidirectional and j % 2 == 1
         shift = -1 if rev else 1
         perm = _ring_perm(n, rev)
-        buf = lax.slice_in_dim(x, st, st + sz, axis=axis)
+        stripe = lax.slice_in_dim(x, st, st + sz, axis=axis)
+        buf = stripe if codec is None else encode_wire(stripe, codec,
+                                                       wire_chunk)
         for h in range(n):
             if h:
                 buf, dep = _ordered_ppermute(buf, axis_name, perm, dep)
             src = jnp.mod(r - shift * h, n)   # owner of the stripe in buf
+            if codec is None:
+                piece = buf
+            elif h == 0:
+                piece = stripe            # own stripe: exact, no decode
+            else:
+                piece = decode_wire(buf, codec, stripe.shape, x.dtype,
+                                    wire_chunk)
             out = lax.dynamic_update_slice_in_dim(
-                out, buf, src * k_loc + st, axis=axis)
+                out, piece, src * k_loc + st, axis=axis)
     return out, dep
 
 
@@ -440,14 +593,22 @@ def _local_matmul_chunked(a, b, chunks):
          for st, sz in slices], axis=-1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def _matmul_psum_overlap(a, b, axis_name, chunks, bidirectional):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _matmul_psum_overlap(a, b, axis_name, chunks, bidirectional,
+                         wire_dtype, wire_chunk):
     n = lax.psum(1, axis_name)
     if chunks <= 1 or n == 1 or b.shape[-1] < 2:
+        if n > 1 and wire_dtype is not None:
+            # bracketed quantized reference: local product, then the
+            # encode→monolithic-gather→decode-sum all-reduce
+            return _wire_psum_monolithic(
+                jnp.matmul(a, b), axis_name, wire_dtype, wire_chunk,
+                site="matmul_psum_overlap")
         # monolithic path: bit-identical to psum_combine(a @ b)
         if n > 1:
             log_collective_site("matmul_psum_overlap", axis_name, "psum")
         return lax.psum(jnp.matmul(a, b), axis_name)
+    codec = get_codec(wire_dtype)
     slices = _chunk_slices(b.shape[-1], chunks)
     k = len(slices)
     hops = n - 1
@@ -459,30 +620,53 @@ def _matmul_psum_overlap(a, b, axis_name, chunks, bidirectional):
     # one ring hop for every in-flight chunk s-hops..s-1 — the literal
     # "ppermute of chunk i against the matmul of chunk i+1" interleave.
     # The matmuls are free of the permute chain; the permutes order
-    # among themselves (barrier_after) for the CPU rendezvous.
+    # among themselves (barrier_after) for the CPU rendezvous. With a
+    # wire codec, the quantize of chunk s and the dequantize-accumulate
+    # of arriving chunks sit on the same wavefront steps — the EQuARX
+    # "quantization work inside the collective" schedule.
     for step in range(k + hops):
         if step < k:
             st, sz = slices[step]
             p = jnp.matmul(a, lax.slice_in_dim(b, st, st + sz, axis=-1))
-            state[step] = (p, p)
+            if codec is None:
+                state[step] = (p, p)
+            else:
+                state[step] = (p.astype(jnp.float32),
+                               encode_wire(p, codec, wire_chunk),
+                               p.shape, p.dtype)
         for j in range(max(0, step - hops), min(step, k)):
-            acc, buf = state[j]
-            buf, dep = _ordered_ppermute(
-                buf, axis_name,
-                _ring_perm(n, bidirectional and j % 2 == 1), dep)
-            state[j] = (acc + buf, buf)
-    return jnp.concatenate([acc for acc, _ in state], axis=-1)
+            if codec is None:
+                acc, buf = state[j]
+                buf, dep = _ordered_ppermute(
+                    buf, axis_name,
+                    _ring_perm(n, bidirectional and j % 2 == 1), dep)
+                state[j] = (acc + buf, buf)
+            else:
+                acc, buf, shp, dt = state[j]
+                buf, dep = _ordered_ppermute(
+                    buf, axis_name,
+                    _ring_perm(n, bidirectional and j % 2 == 1), dep)
+                acc = acc + decode_wire(buf, codec, shp, jnp.float32,
+                                        wire_chunk)
+                state[j] = (acc, buf, shp, dt)
+    if codec is None:
+        return jnp.concatenate([s[0] for s in state], axis=-1)
+    return jnp.concatenate(
+        [s[0].astype(s[3]) for s in state], axis=-1)
 
 
-def _mpo_fwd(a, b, axis_name, chunks, bidirectional):
-    return _matmul_psum_overlap(a, b, axis_name, chunks, bidirectional), \
-        (a, b)
+def _mpo_fwd(a, b, axis_name, chunks, bidirectional, wire_dtype,
+             wire_chunk):
+    return _matmul_psum_overlap(a, b, axis_name, chunks, bidirectional,
+                                wire_dtype, wire_chunk), (a, b)
 
 
-def _mpo_bwd(axis_name, chunks, bidirectional, res, g):
+def _mpo_bwd(axis_name, chunks, bidirectional, wire_dtype, wire_chunk,
+             res, g):
     # The combine's transpose is identity (output consumed replicated —
     # same convention as psum_combine); the matmul transposes
-    # chunk-for-chunk through the vjp of the local chunked product.
+    # chunk-for-chunk through the vjp of the local chunked product. No
+    # collective here, so the wire codec doesn't appear in the backward.
     a, b = res
     _, vjp = jax.vjp(
         lambda aa, bb: _local_matmul_chunked(aa, bb, chunks), a, b)
@@ -492,7 +676,8 @@ def _mpo_bwd(axis_name, chunks, bidirectional, res, g):
 _matmul_psum_overlap.defvjp(_mpo_fwd, _mpo_bwd)
 
 
-def matmul_psum_overlap(a, b, axis_name, chunks=1, bidirectional=False):
+def matmul_psum_overlap(a, b, axis_name, chunks=1, bidirectional=False,
+                        wire_dtype=None, wire_chunk=512):
     """Overlapped ``psum_combine(a @ b)``: the row-parallel contraction
     with the output dim split into ``chunks`` pieces, each reduced by a
     rotate-accumulate ``ppermute`` ring that software-pipelines against
@@ -502,13 +687,49 @@ def matmul_psum_overlap(a, b, axis_name, chunks=1, bidirectional=False):
     [..., K, M] (this rank's shard of the contraction). Output [..., M]
     replicated across ``axis_name``. Backward: identity transpose of the
     combine + the chunk-granular transposed matmuls (no collective).
-    ``chunks=1`` is bit-identical to ``psum_combine(a @ b)``."""
-    return _matmul_psum_overlap(a, b, axis_name, int(chunks),
-                                bool(bidirectional))
+    ``chunks=1`` is bit-identical to ``psum_combine(a @ b)``.
+
+    ``wire_dtype`` quantizes each chunk's ring exchange through the
+    shared codec registry (per-chunk scales packed into the same
+    ``ppermute`` payload, fp32 accumulate, own contribution exact and
+    encoded exactly once); ``chunks=1`` with a wire is the bracketed
+    quantize→monolithic-collective reference."""
+    return _matmul_psum_overlap(
+        a, b, axis_name, int(chunks), bool(bidirectional),
+        None if wire_dtype is None else str(wire_dtype), int(wire_chunk))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def _matmul_reduce_scatter(a, b, axis_name, chunks, bidirectional):
+def _wire_reduce_scatter_monolithic(y, axis_name, codec, wire_chunk,
+                                    site="matmul_reduce_scatter"):
+    """Bracketed quantized reduce-scatter of the full local product
+    ``y`` [..., M]: per-destination shards are encoded once and exchanged
+    by a single ``all_to_all`` over the stacked wire buffers, then each
+    rank decode-sums its received shards in ascending rank order with its
+    own contribution exact (fp32 accumulate). The ``chunks=1`` reference
+    for the traveling-accumulator wire ring."""
+    codec = get_codec(codec)
+    n = lax.psum(1, axis_name)
+    r = lax.axis_index(axis_name)
+    m_loc = y.shape[-1] // n
+    shards = [lax.slice_in_dim(y, d * m_loc, (d + 1) * m_loc, axis=-1)
+              for d in range(n)]
+    wires = jnp.stack(
+        [encode_wire(s, codec, wire_chunk) for s in shards], axis=0)
+    log_collective_site(site, axis_name, "all_to_all")
+    recv = lax.all_to_all(wires, axis_name, split_axis=0, concat_axis=0)
+    own = lax.dynamic_slice_in_dim(
+        y, r * m_loc, m_loc, axis=-1).astype(jnp.float32)
+    acc = jnp.zeros(shards[0].shape, jnp.float32)
+    for i in range(n):
+        dec = decode_wire(recv[i], codec, shards[0].shape, jnp.float32,
+                          wire_chunk)
+        acc = acc + jnp.where(jnp.equal(i, r), own, dec)
+    return acc.astype(y.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _matmul_reduce_scatter(a, b, axis_name, chunks, bidirectional,
+                           wire_dtype, wire_chunk):
     n = lax.psum(1, axis_name)
     if n == 1:
         return jnp.matmul(a, b)
@@ -519,10 +740,14 @@ def _matmul_reduce_scatter(a, b, axis_name, chunks, bidirectional):
     m_loc = M // n
     if chunks <= 1 or m_loc < 2:
         y = jnp.matmul(a, b)
+        if wire_dtype is not None:
+            return _wire_reduce_scatter_monolithic(y, axis_name,
+                                                   wire_dtype, wire_chunk)
         log_collective_site("matmul_reduce_scatter", axis_name,
                             "reduce_scatter")
         return lax.psum_scatter(y, axis_name,
                                 scatter_dimension=y.ndim - 1, tiled=True)
+    codec = get_codec(wire_dtype)
     log_collective_site("matmul_reduce_scatter", axis_name, "ppermute",
                         chunks=chunks, hops=n - 1)
     r = lax.axis_index(axis_name)
@@ -537,55 +762,86 @@ def _matmul_reduce_scatter(a, b, axis_name, chunks, bidirectional):
         # step t this rank adds its contribution for destination
         # (r - shift*(1+t)) mod n. The hop of step t's accumulator is
         # independent of step t's contribution matmul — the pipeline.
+        # With a wire codec the hop moves the accumulator quantized —
+        # re-encoded per hop, since the sum-so-far is what travels (the
+        # EQuARX accuracy/bandwidth trade for reduce-scatter rings;
+        # accumulation stays fp32 between hops).
         acc = None
         for t in range(n):
             dest = jnp.mod(r - shift * (1 + t), n)
             contrib = jnp.matmul(a, lax.dynamic_slice_in_dim(
                 b, dest * m_loc + st, sz, axis=-1))
+            if codec is not None:
+                contrib = contrib.astype(jnp.float32)
             if t == 0:
                 acc = contrib
-            else:
+            elif codec is None:
                 hop, dep = _ordered_ppermute(acc, axis_name, perm, dep)
                 acc = hop + contrib
-        outs.append(acc)
+            else:
+                hop, dep = _ordered_ppermute(
+                    encode_wire(acc, codec, wire_chunk), axis_name,
+                    perm, dep)
+                acc = decode_wire(hop, codec, contrib.shape, jnp.float32,
+                                  wire_chunk) + contrib
+        outs.append(acc if codec is None else
+                    acc.astype(jnp.result_type(a, b)))
     return jnp.concatenate(outs, axis=-1)
 
 
-def _mrs_fwd(a, b, axis_name, chunks, bidirectional):
-    return _matmul_reduce_scatter(a, b, axis_name, chunks, bidirectional), \
-        (a, b)
+def _mrs_fwd(a, b, axis_name, chunks, bidirectional, wire_dtype,
+             wire_chunk):
+    return _matmul_reduce_scatter(a, b, axis_name, chunks, bidirectional,
+                                  wire_dtype, wire_chunk), (a, b)
 
 
-def _mrs_bwd(axis_name, chunks, bidirectional, res, g):
+def _mrs_bwd(axis_name, chunks, bidirectional, wire_dtype, wire_chunk,
+             res, g):
     # Transposed schedule (reduce-scatter ↔ all-gather duality): ring-
     # gather the output-shard cotangent, overlapping each arriving shard
     # with its transposed matmul piece (vjp of a @ b[:, shard_src]).
+    # With a wire codec the cotangent shards travel quantized too — the
+    # transposed quantized schedule: each shard encoded once at its
+    # origin, this rank's own shard used exactly.
     a, b = res
     n = lax.psum(1, axis_name)
+    codec = get_codec(wire_dtype)
     if n == 1:
         _, vjp = jax.vjp(jnp.matmul, a, b)
         return vjp(g)
     m_loc = g.shape[-1]
     r = lax.axis_index(axis_name)
     if chunks <= 1:
-        ghat = lax.all_gather(g, axis_name, axis=g.ndim - 1, tiled=True)
+        if codec is None:
+            ghat = lax.all_gather(g, axis_name, axis=g.ndim - 1,
+                                  tiled=True)
+        else:
+            ghat, _ = _wire_all_gather_monolithic(
+                g, axis_name, g.ndim - 1, codec, wire_chunk,
+                site="matmul_reduce_scatter")
         _, vjp = jax.vjp(jnp.matmul, a, b)
         return vjp(ghat)
     perm = _ring_perm(n)
-    buf = g
+    buf = g if codec is None else encode_wire(g, codec, wire_chunk)
     dep = None
     ga = gb = None
     for h in range(n):
         if h:
             buf, dep = _ordered_ppermute(buf, axis_name, perm, dep)
         src = jnp.mod(r - h, n)      # whose output-shard cotangent arrived
+        if codec is None:
+            shard = buf
+        elif h == 0:
+            shard = g                 # own cotangent shard: exact
+        else:
+            shard = decode_wire(buf, codec, g.shape, g.dtype, wire_chunk)
 
         def piece(aa, bb, src=src):
             return jnp.matmul(aa, lax.dynamic_slice_in_dim(
                 bb, src * m_loc, m_loc, axis=-1))
 
         _, vjp = jax.vjp(piece, a, b)
-        dga, dgb = vjp(buf)
+        dga, dgb = vjp(shard)
         ga = dga if ga is None else ga + dga
         gb = dgb if gb is None else gb + dgb
     return ga, gb
@@ -594,7 +850,8 @@ def _mrs_bwd(axis_name, chunks, bidirectional, res, g):
 _matmul_reduce_scatter.defvjp(_mrs_fwd, _mrs_bwd)
 
 
-def matmul_reduce_scatter(a, b, axis_name, chunks=1, bidirectional=False):
+def matmul_reduce_scatter(a, b, axis_name, chunks=1, bidirectional=False,
+                          wire_dtype=None, wire_chunk=512):
     """Overlapped ``psum_scatter(a @ b)``: each rank ends with its
     output-dim shard of the reduced product. ``chunks > 1`` stripes the
     local shard width and runs an overlapped ring reduce-scatter per
@@ -604,9 +861,16 @@ def matmul_reduce_scatter(a, b, axis_name, chunks=1, bidirectional=False):
     ``a``: [..., K] local input; ``b``: [K, M] / [..., K, M] local shard
     of the contraction, M divisible by the axis size. Output
     [..., M/n]. Backward ring-gathers the cotangent with the transposed
-    overlapped schedule (all-gather ↔ reduce-scatter duality)."""
-    return _matmul_reduce_scatter(a, b, axis_name, int(chunks),
-                                  bool(bidirectional))
+    overlapped schedule (all-gather ↔ reduce-scatter duality).
+
+    ``wire_dtype`` quantizes the exchange through the shared codec
+    registry: the chunked ring re-encodes the traveling accumulator per
+    hop (fp32 between hops), ``chunks=1`` routes through the bracketed
+    encode→``all_to_all``→decode-sum reference, and the backward carries
+    the transposed quantized gather."""
+    return _matmul_reduce_scatter(
+        a, b, axis_name, int(chunks), bool(bidirectional),
+        None if wire_dtype is None else str(wire_dtype), int(wire_chunk))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))

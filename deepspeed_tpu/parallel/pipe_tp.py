@@ -39,12 +39,36 @@ from deepspeed_tpu.parallel.collectives import (axis_is_manual,
                                                 matmul_psum_overlap,
                                                 overlap_plan, psum_combine,
                                                 psum_grad)
+from deepspeed_tpu.ops.fp8 import (fp8_dot_general, fp8_plan,
+                                   in_qdq_current, out_qdq_current)
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
 
 # ---------------------------------------------------------------------------
 # reusable manual-collective layer functions
 # ---------------------------------------------------------------------------
+
+def _local_dot(x, w, site):
+    """Shard-local GEMM through the fp8 entry point: under an active
+    ``fp8_scope`` (the pipeline threads its plan into the shard_map
+    trace) the operands qdq via current scaling — the manual path has no
+    per-site state threading; with no scope this IS ``lax.dot_general``."""
+    return fp8_dot_general(x, w, (((x.ndim - 1,), (0,)), ((), ())),
+                           site=site)
+
+
+def _fp8_bracket(x, w, site):
+    """Operand qdq for the overlapped primitives, which fuse the GEMM
+    with the ring (no inner dot to swap out): returns quantize-
+    dequantized operands plus an unquantizer bracketing the output so
+    the backward cotangent qdq-quantizes to ``f8e5m2`` exactly like the
+    :func:`fp8_dot_general` path."""
+    plan = fp8_plan()
+    if plan is None or not plan.site_enabled(site):
+        return x, w, lambda y: y
+    m = plan.margin
+    return (in_qdq_current(x, m), in_qdq_current(w, m),
+            lambda y: out_qdq_current(y, m))
 
 def replicated_input(h, axis_name):
     """Megatron ``f``: identity forward; in manual mode, psum of the
@@ -58,17 +82,21 @@ def replicated_input(h, axis_name):
     if not axis_is_manual(axis_name):
         return h
     plan = overlap_plan("column_parallel")
-    if plan is not None and plan.chunks > 1:
+    if plan is not None and (plan.chunks > 1 or plan.wire_dtype):
         return psum_grad(h, axis_name, chunks=plan.chunks,
-                         bidirectional=plan.bidirectional)
+                         bidirectional=plan.bidirectional,
+                         wire_dtype=plan.wire_dtype,
+                         wire_chunk=plan.wire_chunk)
     return psum_grad(h, axis_name)
 
 
 def column_parallel(h, w, b=None):
     """Column-parallel matmul: ``w`` [out_local, M] (shard dim first) →
     [B, T, out_local], no communication. The caller is responsible for
-    :func:`replicated_input` on ``h`` (once per consumed tensor)."""
-    y = h @ w.T.astype(h.dtype)
+    :func:`replicated_input` on ``h`` (once per consumed tensor). The
+    local GEMM goes through the fp8 entry point (site
+    ``column_parallel``) — a no-op without an active fp8 plan."""
+    y = _local_dot(h, w.T.astype(h.dtype), "column_parallel")
     if b is not None:
         y = y + b.astype(h.dtype)
     return y
@@ -86,14 +114,20 @@ def row_parallel(y, w, b, axis_name):
     pipeline against the next chunk's matmul."""
     if axis_is_manual(axis_name):
         plan = overlap_plan("row_parallel")
-        if plan is not None and plan.chunks > 1:
-            part = matmul_psum_overlap(y, w.astype(y.dtype), axis_name,
-                                       chunks=plan.chunks,
-                                       bidirectional=plan.bidirectional)
+        if plan is not None and (plan.chunks > 1 or plan.wire_dtype):
+            yq, wq, unq = _fp8_bracket(y, w.astype(y.dtype),
+                                       "row_parallel")
+            part = unq(matmul_psum_overlap(yq, wq, axis_name,
+                                           chunks=plan.chunks,
+                                           bidirectional=plan.bidirectional,
+                                           wire_dtype=plan.wire_dtype,
+                                           wire_chunk=plan.wire_chunk))
         else:
-            part = psum_combine(y @ w.astype(y.dtype), axis_name)
+            part = psum_combine(
+                _local_dot(y, w.astype(y.dtype), "row_parallel"),
+                axis_name)
     else:
-        part = y @ w.astype(y.dtype)
+        part = _local_dot(y, w.astype(y.dtype), "row_parallel")
     if b is not None:
         part = part + b.astype(y.dtype)
     return part

@@ -1,0 +1,153 @@
+"""Chunk codec registry shared by every quantized-communication path.
+
+One codec = one wire dtype (``int8``, ``f8e4m3fn``, ``f8e5m2``) plus the
+per-chunk absmax scaling recipe PR 1 introduced for the bracketed int8
+all-reduce. The bracketed all-reduce (:mod:`.quantized`), the overlapped
+``ppermute`` rings (:mod:`deepspeed_tpu.parallel.collectives`) and the
+stage-3 gather path all encode and decode through these functions, so the
+numerics are defined in exactly one place.
+
+``encode_chunks``/``decode_chunks`` generalize the legacy
+``quantize_chunks``/``dequantize_chunks`` pair: for the ``int8`` codec
+they are bit-for-bit the PR 1 semantics (scale = absmax/127, zero-chunk
+guard, round + clip, decode as ``q * scale``); the fp8 codecs swap the
+integer round for a saturating cast into the target float format.
+
+The ``*_wire`` helpers byte-pack payload and f32 scales into ONE 1-D u8
+buffer (``lax.ppermute`` moves arrays, not pytrees) so a ring hop moves
+chunk data and its scales in a single collective operand.
+"""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class Codec:
+    """A wire format: target dtype + largest representable magnitude."""
+
+    name: str
+    dtype: object
+    qmax: float
+    integer: bool = False
+
+    @property
+    def itemsize(self):
+        return jnp.dtype(self.dtype).itemsize
+
+
+CODECS = {
+    "int8": Codec("int8", jnp.int8, 127.0, integer=True),
+    "f8e4m3fn": Codec("f8e4m3fn", jnp.float8_e4m3fn, 448.0),
+    "f8e5m2": Codec("f8e5m2", jnp.float8_e5m2, 57344.0),
+}
+
+
+def get_codec(codec):
+    """Resolve a codec name (or pass through a Codec / None)."""
+    if codec is None or isinstance(codec, Codec):
+        return codec
+    try:
+        return CODECS[codec]
+    except KeyError:
+        raise ValueError(
+            f"unknown wire codec {codec!r}; expected one of "
+            f"{sorted(CODECS)}")
+
+
+def encode_chunks(x, chunk_size, codec="int8"):
+    """Flatten ``x`` into ``chunk_size`` chunks and quantize each with a
+    per-chunk absmax scale. Returns ``(q, scales)`` where ``q`` has shape
+    ``[n_chunks, chunk_size]`` in the codec dtype and ``scales`` is f32
+    ``[n_chunks]``. ``x.size`` must be a multiple of ``chunk_size``.
+    """
+    codec = get_codec(codec)
+    chunks = x.reshape(-1, chunk_size).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(chunks), axis=1)
+    scale = absmax / codec.qmax
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    scaled = chunks / safe[:, None]
+    if codec.integer:
+        q = jnp.clip(jnp.round(scaled), -codec.qmax, codec.qmax)
+    else:
+        q = jnp.clip(scaled, -codec.qmax, codec.qmax)
+    return q.astype(codec.dtype), scale
+
+
+def decode_chunks(q, scales, dtype=jnp.float32):
+    """Inverse of :func:`encode_chunks`: returns a flat array of
+    ``q.size`` values in ``dtype`` (legacy PR 1 semantics: the product is
+    taken directly in ``dtype``)."""
+    vals = q.astype(dtype) * scales[:, None].astype(dtype)
+    return vals.reshape(-1)
+
+
+# ----------------------------------------------------------------------
+# single-buffer wire packing: payload + scales in one 1-D u8 array
+# ----------------------------------------------------------------------
+
+def _wire_chunk_size(n, chunk_size):
+    """Effective chunk length for an ``n``-element payload."""
+    return max(1, min(int(chunk_size), int(n)))
+
+
+def wire_layout(shape, codec, chunk_size=512):
+    """Static layout of the packed wire buffer for a payload of ``shape``:
+    ``(n, c, n_chunks, payload_bytes, total_bytes)``."""
+    codec = get_codec(codec)
+    n = int(math.prod(shape)) if shape else 1
+    c = _wire_chunk_size(n, chunk_size)
+    n_chunks = -(-n // c)
+    payload_bytes = n_chunks * c * codec.itemsize
+    return n, c, n_chunks, payload_bytes, payload_bytes + 4 * n_chunks
+
+
+def wire_nbytes(shape, codec, chunk_size=512):
+    """Bytes on the wire for one encoded payload of ``shape``."""
+    return wire_layout(shape, codec, chunk_size)[-1]
+
+
+def encode_wire(x, codec, chunk_size=512):
+    """Quantize ``x`` and pack ``(q, scales)`` into one flat u8 buffer.
+
+    The payload is zero-padded up to a chunk multiple, quantized with
+    :func:`encode_chunks`, and both the codec-dtype payload and the f32
+    scales are bitcast to u8 and concatenated — so the whole thing rides
+    a single ``ppermute``/``all_gather`` operand. Layout:
+    ``[payload_bytes | 4 * n_chunks scale bytes]``.
+    """
+    codec = get_codec(codec)
+    n, c, n_chunks, _, _ = wire_layout(x.shape, codec, chunk_size)
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = n_chunks * c - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    q, scales = encode_chunks(flat, c, codec)
+    q_bytes = lax.bitcast_convert_type(q, jnp.uint8).reshape(-1)
+    s_bytes = lax.bitcast_convert_type(scales, jnp.uint8).reshape(-1)
+    return jnp.concatenate([q_bytes, s_bytes])
+
+
+def decode_wire(wire, codec, shape, dtype=jnp.float32, chunk_size=512):
+    """Inverse of :func:`encode_wire`: unpack + dequantize back to
+    ``shape`` in ``dtype``."""
+    codec = get_codec(codec)
+    n, c, n_chunks, payload_bytes, total = wire_layout(
+        shape, codec, chunk_size)
+    q_bytes = wire[:payload_bytes]
+    s_bytes = wire[payload_bytes:total]
+    if codec.itemsize == 1:
+        q = lax.bitcast_convert_type(
+            q_bytes, codec.dtype).reshape(n_chunks, c)
+    else:
+        q = lax.bitcast_convert_type(
+            q_bytes.reshape(-1, codec.itemsize),
+            codec.dtype).reshape(n_chunks, c)
+    scales = lax.bitcast_convert_type(
+        s_bytes.reshape(n_chunks, 4), jnp.float32)
+    flat = decode_chunks(q, scales, jnp.float32)[:n]
+    return flat.reshape(shape).astype(dtype)

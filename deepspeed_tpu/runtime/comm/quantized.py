@@ -42,6 +42,7 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.parallel.collectives import (
     gather_from_chunk_servers, scatter_to_chunk_servers)
+from deepspeed_tpu.runtime.comm.codecs import decode_chunks, encode_chunks
 from deepspeed_tpu.utils.compat import axis_size
 
 __all__ = [
@@ -57,19 +58,17 @@ def quantize_chunks(x, chunk_size):
 
     ``q`` is ``[n_chunks, chunk_size]`` int8 in [-127, 127]; ``scales`` is
     ``[n_chunks]`` fp32 with ``scale = absmax / 127`` (all-zero chunks get
-    scale 0, decoding back to exact zeros)."""
-    chunks = x.reshape(-1, chunk_size).astype(jnp.float32)
-    absmax = jnp.max(jnp.abs(chunks), axis=1)
-    scale = absmax / 127.0
-    safe = jnp.where(scale > 0.0, scale, 1.0)
-    q = jnp.clip(jnp.round(chunks / safe[:, None]), -127.0, 127.0)
-    return q.astype(jnp.int8), scale
+    scale 0, decoding back to exact zeros).
+
+    Thin wrapper over the ``int8`` entry of the codec registry
+    (:mod:`.codecs`) — the registry is the single source of truth for the
+    chunk numerics shared with the overlapped rings and stage-3 gathers."""
+    return encode_chunks(x, chunk_size, "int8")
 
 
 def dequantize_chunks(q, scales, dtype=jnp.float32):
     """Inverse of :func:`quantize_chunks` (up to rounding): flat array."""
-    vals = q.astype(dtype) * scales[:, None].astype(dtype)
-    return vals.reshape(-1)
+    return decode_chunks(q, scales, dtype)
 
 
 def quantized_allreduce_sizes(n, world, chunk_size):

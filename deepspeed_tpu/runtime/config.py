@@ -491,6 +491,10 @@ class TensorParallelConfig:
             ov, TP_OVERLAP_BIDIRECTIONAL, TP_OVERLAP_BIDIRECTIONAL_DEFAULT)
         self.overlap_sites = get_scalar_param(ov, TP_OVERLAP_SITES,
                                               TP_OVERLAP_SITES_DEFAULT)
+        self.overlap_wire_dtype = get_scalar_param(
+            ov, TP_OVERLAP_WIRE_DTYPE, TP_OVERLAP_WIRE_DTYPE_DEFAULT)
+        self.overlap_wire_chunk = get_scalar_param(
+            ov, TP_OVERLAP_WIRE_CHUNK, TP_OVERLAP_WIRE_CHUNK_DEFAULT)
 
     def overlap_plan(self):
         """The resolved :class:`~..parallel.collectives.OverlapPlan`, or
@@ -499,16 +503,73 @@ class TensorParallelConfig:
         if not self.overlap_enabled:
             return None
         from deepspeed_tpu.parallel.collectives import OverlapPlan
+        wd = self.overlap_wire_dtype
         return OverlapPlan(chunks=int(self.overlap_chunks),
                            bidirectional=bool(self.overlap_bidirectional),
-                           sites=dict(self.overlap_sites or {}))
+                           sites=dict(self.overlap_sites or {}),
+                           wire_dtype=(str(wd) if wd else None),
+                           wire_chunk=int(self.overlap_wire_chunk))
 
     def __repr__(self):
         return (f"TensorParallelConfig(overlap_enabled="
                 f"{self.overlap_enabled}, "
                 f"overlap_chunks={self.overlap_chunks}, "
                 f"overlap_bidirectional={self.overlap_bidirectional}, "
-                f"overlap_sites={self.overlap_sites!r})")
+                f"overlap_sites={self.overlap_sites!r}, "
+                f"overlap_wire_dtype={self.overlap_wire_dtype!r}, "
+                f"overlap_wire_chunk={self.overlap_wire_chunk})")
+
+
+class Fp8Config:
+    """Typed view of the ``fp8`` block (ops/fp8.py; docs/fp8.md).
+
+    ``enabled`` switches the model's hooked matmuls to delayed-scaling
+    fp8 GEMMs (``f8e4m3fn`` forward operands / ``f8e5m2`` backward
+    cotangents, per-tensor amax histories carried as engine state);
+    ``margin`` / ``amax_history_len`` tune the scaling recipe and
+    ``sites`` holds per-site ``{"enabled": bool}`` overrides. The
+    ``wire`` sub-block quantizes the overlapped collective rings'
+    payloads through the shared codec registry
+    (``runtime/comm/codecs.py``), including ZeRO-3 gathers."""
+
+    def __init__(self, param_dict):
+        sub = param_dict.get(FP8, {}) or {}
+        self.enabled = get_scalar_param(sub, FP8_ENABLED,
+                                        FP8_ENABLED_DEFAULT)
+        self.margin = get_scalar_param(sub, FP8_MARGIN, FP8_MARGIN_DEFAULT)
+        self.amax_history_len = get_scalar_param(
+            sub, FP8_AMAX_HISTORY_LEN, FP8_AMAX_HISTORY_LEN_DEFAULT)
+        self.sites = get_scalar_param(sub, FP8_SITES, FP8_SITES_DEFAULT)
+        wire = sub.get(FP8_WIRE, {}) or {}
+        self.wire_enabled = get_scalar_param(wire, FP8_WIRE_ENABLED,
+                                             FP8_WIRE_ENABLED_DEFAULT)
+        self.wire_dtype = get_scalar_param(wire, FP8_WIRE_DTYPE,
+                                           FP8_WIRE_DTYPE_DEFAULT)
+        self.wire_chunk_size = get_scalar_param(
+            wire, FP8_WIRE_CHUNK_SIZE, FP8_WIRE_CHUNK_SIZE_DEFAULT)
+
+    def plan(self):
+        """The resolved :class:`~..ops.fp8.Fp8Plan`, or None when fp8
+        matmuls are disabled."""
+        if not self.enabled:
+            return None
+        from deepspeed_tpu.ops.fp8 import Fp8Plan
+        return Fp8Plan(margin=int(self.margin),
+                       amax_history_len=int(self.amax_history_len),
+                       sites=dict(self.sites or {}))
+
+    def active_wire_dtype(self):
+        """The codec name for quantized collective wires, or None."""
+        return str(self.wire_dtype) if self.wire_enabled else None
+
+    def __repr__(self):
+        return (f"Fp8Config(enabled={self.enabled}, "
+                f"margin={self.margin}, "
+                f"amax_history_len={self.amax_history_len}, "
+                f"sites={self.sites!r}, "
+                f"wire_enabled={self.wire_enabled}, "
+                f"wire_dtype={self.wire_dtype!r}, "
+                f"wire_chunk_size={self.wire_chunk_size})")
 
 
 class DeepSpeedConfig:
@@ -643,6 +704,7 @@ class DeepSpeedConfig:
         self.analysis = AnalysisConfig(param_dict)
         self.telemetry = TelemetryConfig(param_dict)
         self.tensor_parallel = TensorParallelConfig(param_dict)
+        self.fp8 = Fp8Config(param_dict)
         # Set by the elastic batch solver when the target batch cannot
         # factor exactly at this world size; the engine multiplies it
         # into the lr schedule.
@@ -789,6 +851,71 @@ class DeepSpeedConfig:
         self._check_telemetry()
         self._check_tensor_parallel()
         self._check_zero3()
+        self._check_fp8()
+
+    def _check_fp8(self):
+        from deepspeed_tpu.runtime.comm.codecs import CODECS
+        f8 = self.fp8
+        if not isinstance(f8.enabled, bool):
+            raise ValueError(
+                f"fp8: enabled must be a bool, got {f8.enabled!r}")
+        if not isinstance(f8.wire_enabled, bool):
+            raise ValueError(
+                f"fp8.wire: enabled must be a bool, got "
+                f"{f8.wire_enabled!r}")
+        if isinstance(f8.margin, bool) or not isinstance(f8.margin, int) \
+                or f8.margin < 0:
+            raise ValueError(
+                f"fp8: margin must be an int >= 0, got {f8.margin!r}")
+        hl = f8.amax_history_len
+        if isinstance(hl, bool) or not isinstance(hl, int) or hl < 1:
+            raise ValueError(
+                f"fp8: amax_history_len must be an int >= 1, got {hl!r}")
+        if f8.sites is not None:
+            if not isinstance(f8.sites, dict):
+                raise ValueError(
+                    f"fp8: sites must be a dict of per-site overrides, "
+                    f"got {f8.sites!r}")
+            for site, ov in f8.sites.items():
+                if not isinstance(ov, dict):
+                    raise ValueError(
+                        f"fp8: sites[{site!r}] must be a dict, got {ov!r}")
+                for key, v in ov.items():
+                    if key != FP8_ENABLED:
+                        raise ValueError(
+                            f"fp8: unknown key {key!r} in sites[{site!r}];"
+                            f" allowed: [{FP8_ENABLED!r}]")
+                    if not isinstance(v, bool):
+                        raise ValueError(
+                            f"fp8: sites[{site!r}].{key} must be a bool, "
+                            f"got {v!r}")
+        if f8.wire_enabled:
+            if f8.wire_dtype not in CODECS:
+                raise ValueError(
+                    f"fp8.wire: dtype must be one of {sorted(CODECS)}, "
+                    f"got {f8.wire_dtype!r}")
+            wc = f8.wire_chunk_size
+            if isinstance(wc, bool) or not isinstance(wc, int) or wc < 1:
+                raise ValueError(
+                    f"fp8.wire: chunk_size must be an int >= 1, "
+                    f"got {wc!r}")
+            if self.comm_quantization.enabled:
+                raise ValueError(
+                    "fp8.wire and comm_quantization both quantize the "
+                    "gradient exchange — enable one comm compressor only")
+        if f8.enabled or f8.wire_enabled:
+            if self.optimizer_name == ONEBIT_ADAM_OPTIMIZER:
+                raise ValueError(
+                    "fp8 is incompatible with OneBitAdam (both rewrite "
+                    "the gradient exchange/state threading)")
+            if self.sparse_gradients_enabled:
+                raise ValueError(
+                    "fp8 is incompatible with sparse_gradients (the CSR "
+                    "path runs its own per-leaf exchange)")
+            if self.zero_config.cpu_offload is True:
+                raise ValueError(
+                    "fp8 requires the in-jit update path; ZeRO-Offload "
+                    "steps the optimizer on host")
 
     def _check_zero3(self):
         zc = self.zero_config
@@ -836,9 +963,20 @@ class DeepSpeedConfig:
                     f"tensor_parallel.overlap: {name} must be an int >= 1,"
                     f" got {v!r}")
 
+        def _wire(name, v):
+            if v is None:
+                return
+            from deepspeed_tpu.runtime.comm.codecs import CODECS
+            if v not in CODECS:
+                raise ValueError(
+                    f"tensor_parallel.overlap: {name} must be one of "
+                    f"{sorted(CODECS)} (or null), got {v!r}")
+
         _bool("enabled", tp.overlap_enabled)
         _bool("bidirectional", tp.overlap_bidirectional)
         _chunks("chunks", tp.overlap_chunks)
+        _wire("wire_dtype", tp.overlap_wire_dtype)
+        _chunks("wire_chunk", tp.overlap_wire_chunk)
         sites = tp.overlap_sites
         if sites is None:
             return
@@ -859,14 +997,19 @@ class DeepSpeedConfig:
                 if key == TP_OVERLAP_ENABLED or \
                         key == TP_OVERLAP_BIDIRECTIONAL:
                     _bool(f"sites[{site!r}].{key}", v)
-                elif key == TP_OVERLAP_CHUNKS:
+                elif key == TP_OVERLAP_CHUNKS or \
+                        key == TP_OVERLAP_WIRE_CHUNK:
                     _chunks(f"sites[{site!r}].{key}", v)
+                elif key == TP_OVERLAP_WIRE_DTYPE:
+                    _wire(f"sites[{site!r}].{key}", v)
                 else:
                     raise ValueError(
                         f"tensor_parallel.overlap: unknown key {key!r} in "
                         f"sites[{site!r}]; allowed: "
                         f"[{TP_OVERLAP_ENABLED!r}, {TP_OVERLAP_CHUNKS!r}, "
-                        f"{TP_OVERLAP_BIDIRECTIONAL!r}]")
+                        f"{TP_OVERLAP_BIDIRECTIONAL!r}, "
+                        f"{TP_OVERLAP_WIRE_DTYPE!r}, "
+                        f"{TP_OVERLAP_WIRE_CHUNK!r}]")
 
     def _check_analysis(self):
         from deepspeed_tpu.analysis.rules import RULE_IDS

@@ -314,6 +314,37 @@ TP_OVERLAP_BIDIRECTIONAL = "bidirectional"
 TP_OVERLAP_BIDIRECTIONAL_DEFAULT = False
 TP_OVERLAP_SITES = "sites"
 TP_OVERLAP_SITES_DEFAULT = None  # None = no per-site overrides
+# Quantized-wire codec for the overlap rings ("int8" / "f8e4m3fn" /
+# "f8e5m2"; None = full-precision wire). Chunk payloads + per-chunk f32
+# scales ride the same ppermute; chunks=1 routes through the bracketed
+# quantize→monolithic-collective reference. See docs/fp8.md.
+TP_OVERLAP_WIRE_DTYPE = "wire_dtype"
+TP_OVERLAP_WIRE_DTYPE_DEFAULT = None
+TP_OVERLAP_WIRE_CHUNK = "wire_chunk"
+TP_OVERLAP_WIRE_CHUNK_DEFAULT = 512
+
+# fp8 end-to-end training (ops/fp8.py + the quantized collective wire;
+# docs/fp8.md). `enabled` turns the GPT-2 Dense matmuls into delayed-
+# scaling fp8 GEMMs (f8e4m3fn forward operands, f8e5m2 backward
+# cotangents, amax histories carried as engine state); the `wire` block
+# quantizes the ring collectives' payloads through the codec registry
+# (runtime/comm/codecs.py) — including ZeRO-3 gathers.
+FP8 = "fp8"
+FP8_ENABLED = "enabled"
+FP8_ENABLED_DEFAULT = False
+FP8_MARGIN = "margin"
+FP8_MARGIN_DEFAULT = 0
+FP8_AMAX_HISTORY_LEN = "amax_history_len"
+FP8_AMAX_HISTORY_LEN_DEFAULT = 16
+FP8_SITES = "sites"
+FP8_SITES_DEFAULT = None         # None = no per-site overrides
+FP8_WIRE = "wire"
+FP8_WIRE_ENABLED = "enabled"
+FP8_WIRE_ENABLED_DEFAULT = False
+FP8_WIRE_DTYPE = "dtype"
+FP8_WIRE_DTYPE_DEFAULT = "f8e4m3fn"
+FP8_WIRE_CHUNK_SIZE = "chunk_size"
+FP8_WIRE_CHUNK_SIZE_DEFAULT = 512
 
 # Runtime telemetry (deepspeed_tpu/telemetry): structured metrics
 # registry, step-phase spans, and the schema-versioned JSONL event log

@@ -67,6 +67,7 @@ from deepspeed_tpu.runtime.resilience.preemption import (
     PreemptedError, PreemptionHandler)
 from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
 from deepspeed_tpu.ops.adam.fused_adam import adam_update, init_adam_state
+from deepspeed_tpu.ops.fp8 import fp8_scope, init_state_bundle
 from deepspeed_tpu.ops.lamb.fused_lamb import init_lamb_state, lamb_update
 from deepspeed_tpu.parallel.mesh import build_mesh
 from deepspeed_tpu.telemetry import (
@@ -171,7 +172,8 @@ def step_metrics(loss_sum, accum, grad_norm, applied_norm, lr, scale,
 
 
 def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None,
-                          cast_params=None, remat_policy=None):
+                          cast_params=None, remat_policy=None,
+                          fp8_plan=None):
     """Build ``accumulate(params, batch, rng, scale) -> (loss_sum, grads)``:
     scaled-loss value-and-grad over one microbatch, or a ``lax.scan`` over
     ``accum`` microbatches (batch leading dim = accum). Shared by the dense
@@ -195,7 +197,18 @@ def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None,
     `zero/stage3.py:zero3_remat_policy` so the gathered 16-bit params are
     dropped at the fwd/bwd boundary and the backward re-gathers them from
     the fp32 shards (remat re-executes the same gathers on the same
-    inputs, so numerics are bitwise-unchanged)."""
+    inputs, so numerics are bitwise-unchanged).
+
+    ``fp8_plan`` (an `ops/fp8.py:Fp8Plan`) turns on fp8 delayed-scaling
+    matmuls: ``accumulate`` then takes a trailing ``fp8_state`` dict of
+    per-site amax-history bundles and returns ``(loss_sum, grads,
+    fp8_state_out)``. The microbatch forward runs under ``fp8_scope``
+    and the loss is differentiated w.r.t. ``(params, fp8_state)`` — the
+    state's "gradients" ARE the rolled histories (the grad-as-state-
+    update trick in `ops/fp8.py`). Across an accumulation scan the
+    per-micro updates combine elementwise via ``jnp.maximum``: every
+    micro sees the same input histories, so the max over their slot-0
+    amaxes is the step's amax and the older slots agree."""
 
     user_caster = cast_params
     if cast_params is None:
@@ -218,38 +231,82 @@ def make_grad_accumulator(loss_fn, compute_dtype, accum, constrain=None,
                  "param gathers will ride at fp32", ranks=[0])
 
     def forward(p, micro_batch, rng, loss_kwargs):
-        return loss_fn(cast_params(p), micro_batch, rng, **loss_kwargs)
+        if fp8_plan is None:
+            return loss_fn(cast_params(p), micro_batch, rng, **loss_kwargs)
+        # fp8: the differentiated argument is (params, fp8_state); the
+        # scope only needs to span the forward trace — the qdq
+        # custom_vjps carry everything the backward needs in residuals.
+        p, f8 = p
+        with fp8_scope(fp8_plan, f8):
+            return loss_fn(cast_params(p), micro_batch, rng, **loss_kwargs)
 
     if remat_policy is not None:
         forward = jax.checkpoint(forward, policy=remat_policy)
 
-    def micro_grads(params, micro_batch, rng, scale, loss_kwargs):
+    def micro_grads(params, micro_batch, rng, scale, loss_kwargs,
+                    fp8_state=None):
         if direct is not None:
             return direct(params, micro_batch, rng, scale, **loss_kwargs)
+
+        arg = params if fp8_state is None else (params, fp8_state)
 
         def scaled_loss(p):
             loss = forward(p, micro_batch, rng, loss_kwargs)
             return loss * scale, loss
         (_, loss), grads = jax.value_and_grad(
-            scaled_loss, has_aux=True)(params)
-        return loss, grads
+            scaled_loss, has_aux=True)(arg)
+        if fp8_state is None:
+            return loss, grads
+        grads, f8_out = grads
+        return loss, grads, f8_out
 
     # The explicit ZeRO-3 caster exposes its SiteRecord registration as
     # a hook to be fired out here, outside the remat/shard_map trace
     # caches — inside them the log goes quiet on an audit's retrace.
     declare_sites = getattr(user_caster, "declare_sites", None)
 
-    def accumulate(params, batch, rng, scale, loss_kwargs=None):
+    def accumulate(params, batch, rng, scale, loss_kwargs=None,
+                   fp8_state=None):
         if declare_sites is not None and direct is None:
             declare_sites()
+        assert (fp8_state is not None) == (
+            fp8_plan is not None and direct is None), \
+            "fp8_state must be passed exactly when an fp8_plan is active"
         loss_kwargs = loss_kwargs or {}
         if accum == 1:
             micro = jax.tree_util.tree_map(lambda x: x[0], batch)
-            return micro_grads(params, micro, rng, scale, loss_kwargs)
+            if fp8_state is None:
+                return micro_grads(params, micro, rng, scale, loss_kwargs)
+            return micro_grads(params, micro, rng, scale, loss_kwargs,
+                               fp8_state)
         zeros = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
         if constrain is not None:
             zeros = constrain(zeros)
+
+        if fp8_state is not None:
+            # Histories are non-negative amaxes and every micro sees the
+            # same input state, so elementwise max over the per-micro
+            # updates (zero-init is the identity) is the step's update.
+            f8_zeros = jax.tree_util.tree_map(jnp.zeros_like, fp8_state)
+
+            def body_fp8(carry, micro):
+                g_acc, f8_acc, loss_acc, key = carry
+                key, sub = jax.random.split(key)
+                loss, g, f8_new = micro_grads(params, micro, sub, scale,
+                                              loss_kwargs, fp8_state)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                if constrain is not None:
+                    g_acc = constrain(g_acc)
+                f8_acc = jax.tree_util.tree_map(jnp.maximum, f8_acc,
+                                                f8_new)
+                return (g_acc, f8_acc, loss_acc + loss, key), None
+
+            (grads, f8_out, loss_sum, _), _ = jax.lax.scan(
+                body_fp8,
+                (zeros, f8_zeros, jnp.asarray(0.0, jnp.float32), rng),
+                batch)
+            return loss_sum, grads, f8_out
 
         def body(carry, micro):
             g_acc, loss_acc, key = carry
@@ -949,6 +1006,14 @@ class DeepSpeedEngine:
         # copies. `gather_on_use: false` keeps the legacy spec-sharded
         # caster (`zero/sharding.py:make_param_caster`), where gather
         # placement is XLA's — the bench A/B baseline.
+        fp8_cfg = self._config.fp8
+        fp8_plan = fp8_cfg.plan()
+        if fp8_plan is not None and \
+                getattr(loss_fn, "direct_value_and_grad", None) is not None:
+            # The executed pipeline threads fp8 itself (current scaling,
+            # pipe/pipeline.py) — the stateful delayed-scaling path only
+            # applies to AD-differentiable loss_fns.
+            fp8_plan = None
         caster = None
         remat_policy = None
         self._zero3_plan = None
@@ -960,7 +1025,9 @@ class DeepSpeedEngine:
                     self.params, param_shardings, self.mesh, compute_dtype,
                     chunks=int(zc.gather_chunks or 1),
                     prefetch=bool(zc.prefetch),
-                    bidirectional=bool(zc.bidirectional))
+                    bidirectional=bool(zc.bidirectional),
+                    wire_dtype=fp8_cfg.active_wire_dtype(),
+                    wire_chunk=int(fp8_cfg.wire_chunk_size))
                 if caster is not None:
                     self._zero3_plan = plan
                     remat_policy = zero3_remat_policy()
@@ -970,18 +1037,25 @@ class DeepSpeedEngine:
         accumulate = make_grad_accumulator(loss_fn, compute_dtype, accum,
                                            constrain=grad_constrain,
                                            cast_params=caster,
-                                           remat_policy=remat_policy)
+                                           remat_policy=remat_policy,
+                                           fp8_plan=fp8_plan)
         pld_fn = self._pld_theta_fn()
         detect, nan_skip, fault_on = self._nan_guard_flags()
         self._fault_arg = fault_on
 
         def train_step(params, opt_state, dstate, batch, rng, lr_in,
-                       grad_fault=None):
+                       fp8_state=None, grad_fault=None):
             scale = dstate.loss_scale.cur_scale if (fp16 and dynamic) \
                 else jnp.asarray(static_scale, jnp.float32)
             loss_kw = {"pld_theta": pld_fn(dstate.global_step)} \
                 if pld_fn is not None else None
-            loss_sum, grads = accumulate(params, batch, rng, scale, loss_kw)
+            if fp8_state is None:
+                loss_sum, grads = accumulate(params, batch, rng, scale,
+                                             loss_kw)
+                f8_new = None
+            else:
+                loss_sum, grads, f8_new = accumulate(
+                    params, batch, rng, scale, loss_kw, fp8_state)
             if fault_on:
                 grads = jax.tree_util.tree_map(lambda g: g * grad_fault,
                                                grads)
@@ -1017,12 +1091,81 @@ class DeepSpeedEngine:
             metrics = step_metrics(loss_sum, accum, grad_norm, applied_norm,
                                    lr, scale, overflow, dstate=dstate_out,
                                    nonfinite=nonfinite)
+            if fp8_state is not None:
+                # Overflowed steps keep the OLD amax histories: an
+                # inf/nan cotangent amax would otherwise poison the
+                # delayed scales for the next amax_history_len steps.
+                f8_out = select(fp8_state, f8_new)
+                return params_out, opt_out, dstate_out, metrics, f8_out
             return params_out, opt_out, dstate_out, metrics
 
         # Inputs arrive pre-placed (device_put with committed shardings);
         # outputs are pinned by the constrain_tree calls above, so plain jit
         # with donation suffices.
-        return donated_jit(train_step, (0, 1, 2))
+        if fp8_plan is None:
+            def train_step_plain(params, opt_state, dstate, batch, rng,
+                                 lr_in, grad_fault=None):
+                return train_step(params, opt_state, dstate, batch, rng,
+                                  lr_in, None, grad_fault)
+            return donated_jit(train_step_plain, (0, 1, 2))
+
+        # fp8: the amax-history state threads through the step exactly
+        # like the 1-bit error-feedback residuals — a trailing donated
+        # argument the host-side wrapper persists on the engine between
+        # calls. Discovery (allocating the per-site bundles) is lazy on
+        # the first batch.
+        self._fp8_state = getattr(self, "_fp8_state", None)
+        inner = donated_jit(train_step, (0, 1, 2, 6))
+        engine = self
+
+        def compiled(params, opt_state, dstate, batch, rng, lr_in, *fault):
+            state = engine._ensure_fp8_state(batch, rng)
+            (params, opt_state, dstate, metrics,
+             engine._fp8_state) = inner(params, opt_state, dstate, batch,
+                                        rng, lr_in, state, *fault)
+            return params, opt_state, dstate, metrics
+
+        compiled.inner = inner
+        compiled.fp8 = True
+        return compiled
+
+    def _ensure_fp8_state(self, batch, rng):
+        """Allocate the per-site fp8 amax-history bundles on first use.
+
+        ``jax.eval_shape`` traces the loss once under a discovery-mode
+        :func:`fp8_scope` — each :func:`fp8_dot_general` call records its
+        ``"<site>:<idx>"`` key (per-site trace-order index) instead of
+        consuming state — then one zero bundle is keyed per recorded
+        site. Zero histories bootstrap to scale 1, so the first step is
+        plain qdq at unit scale and the delayed scales warm up over the
+        next ``amax_history_len`` steps."""
+        if self._fp8_state is not None:
+            return self._fp8_state
+        plan = self._config.fp8.plan()
+        compute_dtype = self.compute_dtype
+        loss_fn = self.loss_fn
+        kw = {}
+        if self._config.pld_enabled:
+            kw["pld_theta"] = jnp.asarray(1.0, jnp.float32)
+        keys = []
+
+        def probe(p, b, r):
+            with fp8_scope(plan, None, keys):
+                return loss_fn(jax.tree_util.tree_map(
+                    lambda x: x.astype(compute_dtype), p), b, r, **kw)
+
+        micro = jax.tree_util.tree_map(lambda x: x[0], batch)
+        jax.eval_shape(probe, self.params, micro, rng)
+        # Committed-replicated placement: the step's state OUTPUTS come
+        # back committed, so an uncommitted zero-init would make the
+        # second call a recompile (sharding mismatch on the donated arg).
+        self._fp8_state = jax.device_put(
+            {k: init_state_bundle(plan.amax_history_len) for k in keys},
+            jax.sharding.NamedSharding(self.mesh,
+                                       jax.sharding.PartitionSpec()))
+        log_dist(f"fp8: delayed scaling active over {len(keys)} dot "
+                 f"site(s)", ranks=[0])
+        return self._fp8_state
 
     def _nan_guard_flags(self):
         """(detect_nonfinite, nan_skip, fault_on) for the step factories:
@@ -2226,6 +2369,15 @@ class DeepSpeedEngine:
             cb = stats.get("collective_bytes") or {}
             facts["collective_bytes"] = {k: int(v)
                                          for k, v in cb.items()}
+            bd = stats.get("collective_bytes_by_dtype") or {}
+            if bd:
+                # Per-element-dtype wire accounting: what separates an
+                # fp8/int8 quantized wire (u8/s8/f8 bytes) from full-
+                # precision traffic sharing the same op family.
+                facts["collective_bytes_by_dtype"] = {
+                    op: ({dt: int(b) for dt, b in d.items()}
+                         if isinstance(d, dict) else int(d))
+                    for op, d in bd.items()}
             facts["while_loops"] = stats.get("while_loops")
             pm = stats.get("peak_memory") or {}
             if pm:

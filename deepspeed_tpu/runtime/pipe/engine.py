@@ -19,6 +19,8 @@ precision, dynamic loss scale, checkpointing — is inherited from
 internals shard compute over ``pipe``.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 
@@ -136,10 +138,20 @@ class PipelineEngine(DeepSpeedEngine):
         # plan for manual-mode TP/SP/MoE layers, threaded to the trace-
         # time overlap_scope inside the pipeline's shard_map.
         overlap = probe.tensor_parallel.overlap_plan()
+        # fp8: route the TP blocks' local matmuls through current-scaling
+        # qdq (per-site amax threading isn't available through the
+        # hand-written 1F1B backward), and — when fp8.wire is on — carry
+        # the ring exchanges quantized by composing the wire codec into
+        # the overlap plan the TP blocks already consume.
+        fp8_plan = probe.fp8.plan()
+        if probe.fp8.wire_enabled and overlap is not None:
+            overlap = dataclasses.replace(
+                overlap, wire_dtype=probe.fp8.active_wire_dtype(),
+                wire_chunk=int(probe.fp8.wire_chunk_size))
         loss_fn = make_pipeline_loss_fn(
             self.pipeline_parts, mesh, self.micro_batches,
             remat=model.activation_checkpoint_interval > 0,
-            auto_axes=auto_axes, overlap=overlap)
+            auto_axes=auto_axes, overlap=overlap, fp8=fp8_plan)
         # Training runs the hand-scheduled 1F1B (loss, grads) program —
         # O(num_stages) activation memory independent of micro_batches;
         # the GPipe loss above remains the eval/forward-only path.
@@ -148,14 +160,14 @@ class PipelineEngine(DeepSpeedEngine):
         loss_fn.direct_value_and_grad = make_pipeline_value_and_grad_fn(
             self.pipeline_parts, mesh, self.micro_batches,
             compute_dtype=compute_dtype, auto_axes=auto_axes,
-            overlap=overlap)
+            overlap=overlap, fp8=fp8_plan)
         # 1-bit Adam composition: same 1F1B program, but gradients come
         # back data-LOCAL (stacked data axis) for the compressed
         # collective to average (engine._make_pipeline_onebit_train_step).
         loss_fn.direct_value_and_grad_local = make_pipeline_value_and_grad_fn(
             self.pipeline_parts, mesh, self.micro_batches,
             compute_dtype=compute_dtype, data_local=True,
-            auto_axes=auto_axes, overlap=overlap)
+            auto_axes=auto_axes, overlap=overlap, fp8=fp8_plan)
 
         super().__init__(args=args,
                          model=model,

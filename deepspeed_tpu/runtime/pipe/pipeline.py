@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.ops.fp8 import fp8_scope
 from deepspeed_tpu.parallel.collectives import (barrier_after,
                                                 log_collective_site,
                                                 manual_axes, overlap_scope)
@@ -374,7 +375,7 @@ def sequential_loss_fn(parts: PipelineParts, params, micro_batches, rng=None):
 # ---------------------------------------------------------------------------
 def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
                           remat: bool = True, auto_axes=None,
-                          overlap=None):
+                          overlap=None, fp8=None):
     """Build ``loss_fn(params, batch, rng)`` executing the GPipe rotation.
 
     ``batch``: pytree of ``[rows, ...]`` arrays, rows divisible by
@@ -386,6 +387,8 @@ def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
     defaults to the module's, recorded on ``parts``.
     ``overlap``: optional ``parallel.collectives.OverlapPlan`` switching
     manual-mode layers to the latency-hiding chunked collectives.
+    ``fp8``: optional ``ops.fp8.Fp8Plan`` routing the TP blocks' local
+    matmuls through current-scaling fp8 qdq (`ops/fp8.py`).
     """
     auto_axes = _resolve_auto_axes(parts, mesh, auto_axes)
     S = parts.num_stages
@@ -508,7 +511,8 @@ def make_pipeline_loss_fn(parts: PipelineParts, mesh, num_micro: int,
     def pipeline_loss(params, batch, rng):
         return _call_pipeline(mesh, M, device_fn, params, batch, rng,
                               out_specs=lambda body_specs, rest_specs: P(),
-                              auto_axes=auto_axes, overlap=overlap)
+                              auto_axes=auto_axes, overlap=overlap,
+                              fp8=fp8)
 
     return pipeline_loss
 
@@ -541,7 +545,7 @@ def _resolve_auto_axes(parts, mesh, auto_axes):
 
 
 def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
-                   out_specs=None, auto_axes=(), overlap=None):
+                   out_specs=None, auto_axes=(), overlap=None, fp8=None):
     """Shared shard_map wrapper for the pipeline programs: microbatch the
     batch rows, split off the replicated param groups, build the in/out
     specs, and invoke ``device_fn`` over the mesh. ``out_specs`` is a
@@ -585,8 +589,12 @@ def _call_pipeline(mesh, M, device_fn, params, batch, rng, extra=(),
         # collectives no-op, constraints rule). ``overlap`` (an
         # OverlapPlan or None) rides the same trace-time channel: layers
         # consult parallel.collectives.overlap_plan to swap monolithic
-        # collectives for the chunked latency-hiding form.
-        with manual_axes(manual), overlap_scope(overlap):
+        # collectives for the chunked latency-hiding form. ``fp8`` (an
+        # ops.fp8.Fp8Plan or None) rides the same way: with no state
+        # dict the scope selects stateless current scaling — per-site
+        # amax threading isn't available through the manual 1F1B
+        # program's hand-written backward.
+        with manual_axes(manual), overlap_scope(overlap), fp8_scope(fp8):
             return device_fn(*args, **kwargs)
 
     fn = shard_map(
@@ -660,7 +668,7 @@ def pipeline_trace_fixture(divergent_transfer=False, unchained_transfer=False):
 def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
                                     num_micro: int, compute_dtype=None,
                                     data_local=False, auto_axes=None,
-                                    overlap=None):
+                                    overlap=None, fp8=None):
     """Build ``vag(params, batch, rng, scale) -> (loss, grads)`` running a
     hand-scheduled 1F1B pipeline (the reference's ``TrainSchedule``
     interleave, `runtime/pipe/schedule.py:189-241`, executed rather than
@@ -989,7 +997,8 @@ def make_pipeline_value_and_grad_fn(parts: PipelineParts, mesh,
         loss, gb, gr = _call_pipeline(
             mesh, M, device_fn, params, batch, rng,
             extra=(jnp.asarray(scale, jnp.float32),),
-            out_specs=_out_specs, auto_axes=auto_axes, overlap=overlap)
+            out_specs=_out_specs, auto_axes=auto_axes, overlap=overlap,
+            fp8=fp8)
         grads = {"prologue": gr["prologue"], "body": gb,
                  "epilogue": gr["epilogue"], "tied": gr["tied"]}
         return loss, grads

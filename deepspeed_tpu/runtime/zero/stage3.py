@@ -73,6 +73,7 @@ class Zero3Plan:
     bidirectional: bool          # alternate ring direction per stripe
     max_gather_bytes: int        # largest single gathered leaf (compute dtype)
     total_gather_bytes: int      # all gathered leaves (compute dtype)
+    wire_dtype: str = None       # codec name when gathers move quantized
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -80,7 +81,8 @@ class Zero3Plan:
 
 def make_gather_on_use_caster(params, param_shardings, mesh, dtype,
                               axis="data", chunks=1, prefetch=True,
-                              bidirectional=False):
+                              bidirectional=False, wire_dtype=None,
+                              wire_chunk=512):
     """``(cast, Zero3Plan)`` for the explicit stage-3 step, or
     ``(None, None)`` when nothing is sharded over ``axis`` (callers keep
     the default cast, exactly like ``make_param_caster``).
@@ -88,6 +90,12 @@ def make_gather_on_use_caster(params, param_shardings, mesh, dtype,
     ``cast(params)`` returns the compute-dtype param tree: leaves
     sharded over ``axis`` ride the single-shard_map gather described in
     the module docstring; everything else is a plain ``astype``.
+
+    ``wire_dtype`` (a codec name from ``runtime/comm/codecs.py``) moves
+    each gather's payload quantized — per-chunk scales packed into the
+    same collective operand, the local shard placed exactly; the
+    backward reduce-scatter stays full precision (grad accumulation
+    numerics are never quantized here).
     """
     assert chunks <= 1 or prefetch, (
         "zero3: gather_chunks > 1 requires the prefetch dep-chain "
@@ -116,7 +124,8 @@ def make_gather_on_use_caster(params, param_shardings, mesh, dtype,
     plan = Zero3Plan(
         gather_leaves=len(gathered_idx), gather_chunks=int(chunks),
         prefetch=bool(prefetch), bidirectional=bool(bidirectional),
-        max_gather_bytes=max(sizes), total_gather_bytes=sum(sizes))
+        max_gather_bytes=max(sizes), total_gather_bytes=sum(sizes),
+        wire_dtype=(str(wire_dtype) if wire_dtype else None))
 
     def inner(shards):
         # Per-leaf cast-then-gather, dep-chained in leaf order: the chain
@@ -129,7 +138,8 @@ def make_gather_on_use_caster(params, param_shardings, mesh, dtype,
             full, d = ring_all_gather(
                 buf.astype(dtype), axis, axis=dim, chunks=chunks,
                 bidirectional=bidirectional,
-                dep=dep if prefetch else None, site="zero3_gather")
+                dep=dep if prefetch else None, site="zero3_gather",
+                wire_dtype=wire_dtype, wire_chunk=wire_chunk)
             if prefetch:
                 dep = d
             outs.append(full)

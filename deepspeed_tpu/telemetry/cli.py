@@ -67,6 +67,29 @@ def _resolve_flops_per_token(events, flops_per_token=None):
     return None
 
 
+def _wire_bytes_per_step(events):
+    """Per-step collective wire accounting from the run's ``compile``
+    event (`collective_bytes_by_dtype`): total bytes moved by collectives
+    per step, and how many of them travel in 1-byte quantized form
+    (u8/s8/f8 element dtypes — the int8/fp8 wire codecs)."""
+    for evt in reversed(events):
+        bd = evt.get("collective_bytes_by_dtype") \
+            if evt.get("event") == "compile" else None
+        if not bd:
+            continue
+        total = wire = 0
+        for op, per_dtype in bd.items():
+            if not isinstance(per_dtype, dict):   # the "total" rollup
+                continue
+            for dt, b in per_dtype.items():
+                total += int(b)
+                if dt in ("u8", "s8") or dt.startswith("f8"):
+                    wire += int(b)
+        return {"total_bytes": total, "quantized_bytes": wire,
+                "quantized_share": (wire / total) if total else 0.0}
+    return None
+
+
 def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
     """Aggregate a run's events into the summary dict (None when the log
     holds no step events)."""
@@ -121,6 +144,7 @@ def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
         "tokens": tokens or None,
         "tokens_per_s": tokens_per_s,
         "mfu": mfu,
+        "collective_wire": _wire_bytes_per_step(events),
         "last_loss": losses[-1] if losses else None,
         "events": {
             "recompile": sum(1 for e in events
@@ -165,6 +189,12 @@ def print_summary(s, out=sys.stdout):
               f"({m['achieved_tflops']:.1f} / {m['peak_tflops']:.0f} "
               f"TFLOPS at {m['flops_per_token']:,.0f} flops/token)",
               file=out)
+    if s.get("collective_wire"):
+        w = s["collective_wire"]
+        print(f"  collective wire {w['total_bytes'] / 1024:,.1f}KB/step, "
+              f"{w['quantized_bytes'] / 1024:,.1f}KB "
+              f"({w['quantized_share'] * 100:.1f}%) in 1-byte quantized "
+              f"form", file=out)
     ev = s["events"]
     guards = ", ".join(f"{k}={v}" for k, v in
                        sorted(ev["health_guard"].items())) or "none"

@@ -314,6 +314,121 @@ def test_psum_grad_backward_matches_psum(chunks):
 
 
 # ---------------------------------------------------------------------------
+# quantized wire: rings carrying int8/fp8 payloads + per-chunk scales
+# ---------------------------------------------------------------------------
+
+# Half a quantization step against the chunk absmax: the per-element
+# decode error of one remote contribution (own contribution is exact).
+_WIRE_REL = {"int8": 0.5 / 127.0, "f8e4m3fn": 2.0 ** -4}
+WIRE_CODECS = ["int8", "f8e4m3fn"]
+WIRE_GRID_TIERED = [(1, False),
+                    pytest.param(2, False, marks=slow),
+                    (2, True),
+                    pytest.param(4, False, marks=slow),
+                    (4, True)]
+
+
+def _wire_bound(partials, codec):
+    """Error budget of a quantized reduction: every REMOTE rank's
+    contribution decodes within ``rel * chunk_absmax``; bound with the
+    global absmax across ranks."""
+    return (partials.shape[0] - 1) * float(
+        np.abs(np.asarray(partials)).max()) * _WIRE_REL[codec] + 1e-6
+
+
+@pytest.mark.parametrize("codec", WIRE_CODECS)
+@pytest.mark.parametrize("chunks,bidirectional", WIRE_GRID_TIERED)
+def test_ring_psum_wire_error_bounded(chunks, bidirectional, codec):
+    """Quantized ring psum == exact psum within the codec's error budget
+    (own contribution exact, each remote one within rel * absmax)."""
+    mesh = _mesh()
+    x = _rand(20, (N, T, M_ODD))
+
+    def make(fn):
+        return _sharded(lambda xl: fn(xl), mesh,
+                        (P("model", None, None),), P(None, None, None))
+
+    got = np.asarray(make(lambda xl: ring_psum(
+        xl[0], "model", chunks=chunks, bidirectional=bidirectional,
+        wire_dtype=codec, wire_chunk=16))(x))
+    want = np.asarray(make(lambda xl: lax.psum(xl[0], "model"))(x))
+    assert np.abs(got - want).max() <= _wire_bound(x, codec)
+
+
+@pytest.mark.parametrize("codec", WIRE_CODECS)
+@pytest.mark.parametrize("chunks,bidirectional", WIRE_GRID_TIERED)
+def test_matmul_psum_overlap_wire_error_bounded(chunks, bidirectional,
+                                                codec):
+    """The overlapped row-parallel matmul with a quantized wire: forward
+    within the codec budget of the exact dense product, and the
+    transposed (chunk-granular, collective-free) backward still exact —
+    quantization rides the wire, not the grads."""
+    (l_c, ga_c, gb_c), (a, b, w) = _psum_matmul_run(
+        lambda al, bl: matmul_psum_overlap(
+            al, bl, "model", chunks=chunks, bidirectional=bidirectional,
+            wire_dtype=codec, wire_chunk=16))
+    l_o, ga_o, gb_o = _dense_psum_oracle(a, b, w)
+    k_loc = K // N
+    an, bn = np.asarray(a), np.asarray(b)
+    partials = np.stack(
+        [an[..., r * k_loc:(r + 1) * k_loc] @
+         bn[r * k_loc:(r + 1) * k_loc] for r in range(N)])
+    bound = _wire_bound(partials, codec)
+    assert float(np.abs(l_c - l_o)) <= bound * float(
+        np.abs(np.asarray(w)).sum())
+    # backward: the combine's transpose is identity + local transposed
+    # matmuls — independent of the wire, so grads match at fp32 parity
+    np.testing.assert_allclose(ga_c, ga_o, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(gb_c, gb_o, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("codec", WIRE_CODECS)
+def test_wire_chunks1_bit_identical_to_monolithic(codec):
+    """chunks=1 with a wire routes BOTH primitives through the same
+    bracketed quantize -> monolithic-collective reference — bit-identical
+    results, not merely close."""
+    mesh = _mesh()
+    a = _rand(21, (B, T, K))
+    b = _rand(22, (K, M_ODD))
+
+    def run(fn):
+        return np.asarray(_sharded(
+            fn, mesh, (P(None, None, "model"), P("model", None)),
+            P(None, None, None))(a, b))
+
+    overlap = run(lambda al, bl: matmul_psum_overlap(
+        al, bl, "model", chunks=1, wire_dtype=codec, wire_chunk=16))
+    monolithic = run(lambda al, bl: ring_psum(
+        al @ bl, "model", chunks=1, wire_dtype=codec, wire_chunk=16))
+    assert np.array_equal(overlap, monolithic)
+
+
+@pytest.mark.parametrize("codec", WIRE_CODECS)
+@pytest.mark.parametrize("chunks,bidirectional",
+                         [(1, False), (2, True),
+                          pytest.param(4, False, marks=slow)])
+def test_ring_all_gather_wire_error_bounded(chunks, bidirectional, codec):
+    """Quantized stripe gather (the stage-3 wire): each remote shard
+    decodes within rel * its absmax; own shard exact."""
+    from deepspeed_tpu.parallel.collectives import ring_all_gather
+    mesh = _mesh()
+    x = _rand(23, (N * T, M_ODD))     # gather dim 0, T rows per rank
+
+    def local(xl):
+        out, _dep = ring_all_gather(xl, "model", axis=0, chunks=chunks,
+                                    bidirectional=bidirectional,
+                                    wire_dtype=codec, wire_chunk=16)
+        return out
+
+    got = np.asarray(_sharded(local, mesh, (P("model", None),),
+                              P(None, None))(x))
+    want = np.asarray(x)
+    assert got.shape == want.shape
+    err = np.abs(got - want).max()
+    assert err <= float(np.abs(want).max()) * _WIRE_REL[codec] + 1e-6
+
+
+# ---------------------------------------------------------------------------
 # plan / scope plumbing
 # ---------------------------------------------------------------------------
 
