@@ -11,6 +11,12 @@ replication, implicit reshards) — all before the program ever runs.
 The declarative rule catalog lives in `analysis/rules.py`, the
 orchestrator + stock-flavor builders in `analysis/audit.py`;
 ``bin/ds_tpu_audit`` fronts it all from the command line.
+
+On top of the facts the audit extracts, `analysis/cost.py` fits a
+roofline per-step cost (compute vs interconnect with an overlap credit
+for chunked rings) and `analysis/tune.py` (``bin/ds_tpu_tune``)
+searches the discrete config space with it — every candidate compiled
+through the audit path, unsafe ones rejected with a typed reason.
 """
 
 from deepspeed_tpu.analysis.hlo import (
@@ -45,6 +51,25 @@ from deepspeed_tpu.analysis.rules import (
     StepContext,
     run_rules,
 )
+from deepspeed_tpu.analysis.cost import (
+    PLATFORMS,
+    REJECT_PEAK_MEMORY,
+    Platform,
+    StepCost,
+    dot_flops,
+    estimate_step_cost,
+    resolve_platform,
+)
+from deepspeed_tpu.analysis.tune import (
+    REJECT_BUILD_ERROR,
+    REJECT_RULE_FINDINGS,
+    Choice,
+    TuneResult,
+    default_dimensions,
+    evaluate_candidate,
+    tune,
+    write_expected_log,
+)
 from deepspeed_tpu.analysis.audit import (
     STEP_FLAVORS,
     AuditError,
@@ -74,4 +99,9 @@ __all__ = [
     "audit_engine",
     "audit_flavors", "audit_hlo", "build_flavor_engine",
     "check_recompile", "compiled_cache_size", "donated_jit",
+    "PLATFORMS", "REJECT_PEAK_MEMORY", "Platform", "StepCost",
+    "dot_flops", "estimate_step_cost", "resolve_platform",
+    "REJECT_BUILD_ERROR", "REJECT_RULE_FINDINGS", "Choice",
+    "TuneResult", "default_dimensions", "evaluate_candidate", "tune",
+    "write_expected_log",
 ]

@@ -92,6 +92,9 @@ class AuditReport:
     flavor: str
     findings: list
     stats: dict = field(default_factory=dict)
+    # compiled HLO text of the audited step — kept off to_dict()/to_json()
+    # (it can be megabytes); the autotuner's cost model reads it.
+    hlo_text: str = field(default="", repr=False)
 
     @property
     def ok(self):
@@ -418,6 +421,7 @@ def audit_compiled_step(engine, placed, rng, lr, rules=None):
                           jaxpr_facts=_jaxpr_facts(fn, args))
     report = AuditReport(flavor=ctx.flavor, findings=run_rules(ctx, rules))
     report.stats = _hlo_stats(hlo_text, ctx)
+    report.hlo_text = hlo_text
     return report
 
 
@@ -450,6 +454,7 @@ def audit_engine(engine, batch, rules=None, steps=0):
         findings.extend(check_recompile(engine))
     report = AuditReport(flavor=ctx.flavor, findings=findings)
     report.stats = _hlo_stats(hlo_text, ctx)
+    report.hlo_text = hlo_text
     report.stats["compile_cache_size"] = compiled_cache_size(engine)
     report.stats["steps_run"] = steps_run
     report.stats["audit_wall_s"] = round(time.perf_counter() - t0, 3)
@@ -603,12 +608,14 @@ def build_flavor_engine(flavor, config_overrides=None):
     return engine, _toy_batch()
 
 
-def audit_flavors(flavors=None, rules=None, steps=0):
+def audit_flavors(flavors=None, rules=None, steps=0,
+                  config_overrides=None):
     """Build + audit toy engines for the stock flavors.
 
     Returns ``{flavor: AuditReport}`` in the order requested."""
     out = {}
     for flavor in flavors or STEP_FLAVORS:
-        engine, batch = build_flavor_engine(flavor)
+        engine, batch = build_flavor_engine(
+            flavor, config_overrides=config_overrides)
         out[flavor] = audit_engine(engine, batch, rules=rules, steps=steps)
     return out

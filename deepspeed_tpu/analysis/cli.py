@@ -26,7 +26,7 @@ import os
 import sys
 
 
-def _build_config_engine(config_path):
+def _build_config_engine(config_path, compilation_cache_dir=None):
     """Engine for a user config: toy GPT-2 supplies model/loss (pipeline
     configs need a PipelineModule and aren't supported here — use
     ``--flavors pipeline`` for the stock pipeline audit)."""
@@ -39,6 +39,8 @@ def _build_config_engine(config_path):
 
     with open(config_path) as f:
         cfg = json.load(f)
+    if compilation_cache_dir:
+        cfg["compilation_cache_dir"] = compilation_cache_dir
     model = GPT2LMHead(gpt2_tiny())
     params = init_gpt2_params(model, jax.random.PRNGKey(0))
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -85,6 +87,11 @@ def main(argv=None):
                              "severity (default: error)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
+    parser.add_argument("--compilation-cache-dir", default=None,
+                        metavar="DIR",
+                        help="persistent XLA compile cache for the "
+                             "audited engines (repeat audits become "
+                             "cache hits)")
     args = parser.parse_args(argv)
 
     # Audits read compile-time artifacts; default to the CPU backend
@@ -97,6 +104,13 @@ def main(argv=None):
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8").strip()
+
+    if args.compilation_cache_dir:
+        # toy audits compile in under jax's default persistence
+        # threshold (1s); cache them anyway so reruns are hits
+        import jax
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
 
     from deepspeed_tpu.analysis.rules import RULE_IDS, SEV_ERROR
     if args.list_rules:
@@ -129,7 +143,9 @@ def main(argv=None):
             parser.error(f"cannot read --hlo file: {exc}")
         reports = {"hlo": audit_hlo(hlo_text, rules=rules)}
     elif args.config:
-        engine, batch = _build_config_engine(args.config)
+        engine, batch = _build_config_engine(
+            args.config,
+            compilation_cache_dir=args.compilation_cache_dir)
         reports = {"config": audit_engine(engine, batch, rules=rules,
                                           steps=args.steps)}
     else:
@@ -142,7 +158,12 @@ def main(argv=None):
             if unknown:
                 parser.error(f"unknown flavor(s) {unknown}; "
                              f"known: {list(known)}")
-        reports = audit_flavors(flavors, rules=rules, steps=args.steps)
+        overrides = None
+        if args.compilation_cache_dir:
+            overrides = {
+                "compilation_cache_dir": args.compilation_cache_dir}
+        reports = audit_flavors(flavors, rules=rules, steps=args.steps,
+                                config_overrides=overrides)
 
     fail_severities = {"error": (SEV_ERROR,),
                        "warning": (SEV_ERROR, "warning")}[args.fail_on]

@@ -1,0 +1,295 @@
+"""Roofline per-step cost model over audited HLO facts.
+
+The audit subsystem (`analysis/audit.py`) lowers a training step with its
+exact avals and extracts static facts: per-dtype collective bytes
+(`hlo.collective_bytes` / `hlo.ring_send_bytes`), trip-count-aware
+collective execution counts (`hlo.collective_counts`), static peak memory
+(`hlo.estimate_peak_memory`) and — new here — matmul FLOPs from ``dot``
+shapes (:func:`dot_flops`). This module turns those facts into a scalar
+per-step time estimate so the autotuner (`analysis/tune.py`) can *rank*
+candidate configs without a TPU attached.
+
+The model is a classic alpha-beta roofline, deliberately small:
+
+* compute time = dot FLOPs / peak matmul throughput (MXU-bound; the
+  elementwise tail is assumed to hide under the matmuls),
+* interconnect time per collective kind = ring send bytes / per-link ICI
+  bandwidth + executions x serialized ring hops x per-hop latency,
+* overlap credit: ``collective-permute`` traffic belonging to
+  `SiteRecord`-registered chunked rings (``chunks > 1`` — the
+  collective-matmul / quantized-ring lowerings of `parallel/collectives`)
+  interleaves per-chunk sends with per-chunk compute, so only the first
+  chunk's ring fill is exposed: its bandwidth AND latency terms are
+  divided by the chunk count. This is optimistic by construction (it
+  assumes every chunk's compute fully covers the next chunk's sends) —
+  fine for *ranking*, which is all the tuner needs; `ds_tpu_metrics diff`
+  closes the loop against measured walls when a TPU is present.
+
+Absolute numbers are only as good as the per-platform constants table
+(:data:`PLATFORMS` — datasheet-order-of-magnitude, not calibrated);
+*ratios* between two candidates lowered the same way are the contract
+the tests pin.
+
+Candidates whose static peak exceeds the budget are not scored at all:
+:func:`estimate_step_cost` marks them rejected with the typed reason
+:data:`REJECT_PEAK_MEMORY` and an infinite score, so the tuner can
+surface *why* a point left the search space.
+"""
+
+import dataclasses
+import math
+import re
+from typing import Optional
+
+from deepspeed_tpu.analysis import hlo as hlo_lib
+
+# Typed rejection reason: static peak over the configured budget.
+REJECT_PEAK_MEMORY = "peak_memory_over_budget"
+
+
+@dataclasses.dataclass(frozen=True)
+class Platform:
+    """Roofline constants for one accelerator platform.
+
+    ``flops_per_second`` is dense bf16 matmul throughput;
+    ``ici_bytes_per_second`` is per-link, per-direction interconnect
+    bandwidth; ``ici_latency_seconds`` is the per-hop launch latency
+    (the alpha in alpha-beta); ``hbm_bytes`` is device memory capacity
+    (the default peak budget when the config sets none).
+    """
+    name: str
+    flops_per_second: float
+    hbm_bytes_per_second: float
+    ici_bytes_per_second: float
+    ici_latency_seconds: float
+    hbm_bytes: int
+
+
+# Datasheet-order constants (see docs/analysis.md). The "cpu" row is a
+# deterministic stand-in so ranking tests run anywhere.
+PLATFORMS = {
+    "tpu_v5e": Platform("tpu_v5e", 197e12, 819e9, 45e9, 1e-6,
+                        16 * 2 ** 30),
+    "tpu_v5p": Platform("tpu_v5p", 459e12, 2765e9, 100e9, 1e-6,
+                        95 * 2 ** 30),
+    "tpu_v4": Platform("tpu_v4", 275e12, 1228e9, 50e9, 1e-6,
+                       32 * 2 ** 30),
+    "cpu": Platform("cpu", 1e12, 100e9, 10e9, 1e-6, 16 * 2 ** 30),
+}
+
+
+def resolve_platform(platform):
+    """str | Platform -> Platform (ValueError lists the known names)."""
+    if isinstance(platform, Platform):
+        return platform
+    try:
+        return PLATFORMS[platform]
+    except KeyError:
+        raise ValueError(
+            f"unknown platform {platform!r}; known: "
+            f"{sorted(PLATFORMS)}") from None
+
+
+# Serialized ring hops one *execution* of each collective pays at group
+# size N (latency term; the bandwidth term uses hlo._RING_SEND_FACTORS).
+_RING_HOPS = {
+    "all-reduce": lambda n: 2 * (n - 1),
+    "all-gather": lambda n: n - 1,
+    "reduce-scatter": lambda n: n - 1,
+    "all-to-all": lambda n: 1,
+    "collective-permute": lambda n: 1,
+    "collective-broadcast": lambda n: 1,
+}
+
+
+# ---------------------------------------------------------------------------
+# FLOPs from dot shapes
+# ---------------------------------------------------------------------------
+
+_DOT_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([\d,]*)\}")
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+                     r"(?P<shape>\S+\[[\d,]*\])")
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _dims(shape_text):
+    m = hlo_lib._SHAPE_RE.search(shape_text)
+    if not m:
+        return None
+    return [int(d) for d in m.group(2).split(",") if d] if m.group(2) \
+        else []
+
+
+def dot_flops(hlo_text):
+    """Total matmul FLOPs of one step, from ``dot`` op shapes.
+
+    Each ``dot`` contributes ``2 * prod(output dims) * prod(lhs
+    contracting dim sizes)`` (multiply + add per MAC), weighted by its
+    computation's execution multiplier so dots inside ``while``/``scan``
+    bodies count once per trip — the same trip-aware accounting as
+    `hlo.collective_bytes`. Works on compiled HLO (operand shapes inline
+    on the dot line) and on pre-optimization dumps (falls back to the
+    operand's definition line within the same computation).
+    """
+    comps, entry = hlo_lib.split_computations(hlo_text)
+    if not comps:
+        comps = {"__flat__": hlo_text.splitlines()}
+        mults = {"__flat__": 1}
+    else:
+        mults = hlo_lib.computation_multipliers(hlo_text)
+    total = 0
+    for cname, lines in comps.items():
+        mult = mults.get(cname, 0)
+        if not mult:
+            continue
+        defs = None
+        for line in lines:
+            if " dot(" not in line:
+                continue
+            head, _, tail = line.partition(" dot(")
+            out_dims = _dims(head.split("=", 1)[-1])
+            inner = tail.split(")", 1)[0]
+            cm = _DOT_CONTRACT_RE.search(line)
+            if out_dims is None or cm is None:
+                continue
+            contract = [int(d) for d in cm.group(1).split(",") if d]
+            lhs_dims = None
+            operand_shapes = hlo_lib._SHAPE_RE.findall(inner)
+            if operand_shapes:
+                dt, dims = operand_shapes[0]
+                lhs_dims = [int(d) for d in dims.split(",") if d]
+            else:
+                # pre-optimization text: look the lhs operand up by name
+                if defs is None:
+                    defs = {}
+                    for dl in lines:
+                        dm = _DEF_RE.match(dl)
+                        if dm:
+                            defs[dm.group("name")] = dm.group("shape")
+                names = _OPERAND_NAME_RE.findall(inner)
+                if not names:
+                    names = [t.strip() for t in inner.split(",")]
+                if names and names[0] in defs:
+                    lhs_dims = _dims(defs[names[0]])
+            if lhs_dims is None:
+                continue
+            macs = 1
+            for d in out_dims:
+                macs *= d
+            for axis in contract:
+                if axis < len(lhs_dims):
+                    macs *= lhs_dims[axis]
+            total += 2 * macs * mult
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the cost estimate
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class StepCost:
+    """One candidate's roofline estimate (see module docstring)."""
+    platform: str
+    n_devices: int
+    flops: int
+    compute_seconds: float
+    wire_bytes: int
+    wire_bytes_by_dtype: dict
+    interconnect_seconds: float          # fully blocking alpha-beta time
+    exposed_interconnect_seconds: float  # after the chunked-ring credit
+    overlap_credit_seconds: float
+    overlap_chunks: int                  # effective chunk count (1 = none)
+    peak_bytes: int
+    peak_budget_bytes: Optional[int]
+    step_seconds: float                  # compute + exposed interconnect
+    reject_reason: Optional[str] = None
+
+    @property
+    def ok(self):
+        return self.reject_reason is None
+
+    @property
+    def score(self):
+        """Ranking key: estimated step seconds (+inf when rejected)."""
+        return math.inf if self.reject_reason else self.step_seconds
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["score"] = None if math.isinf(self.score) else self.score
+        d["ok"] = self.ok
+        return d
+
+
+def _site_chunks(collective_sites):
+    """Effective overlap chunk count from `SiteRecord`s (dataclasses or
+    the dict form the audit stats carry): the smallest ``chunks > 1``
+    among registered rings — conservative when sites disagree — or 1
+    when nothing is chunked."""
+    chunked = []
+    for rec in collective_sites or ():
+        chunks = getattr(rec, "chunks", None)
+        if chunks is None and isinstance(rec, dict):
+            chunks = rec.get("chunks")
+        if chunks and chunks > 1:
+            chunked.append(int(chunks))
+    return min(chunked) if chunked else 1
+
+
+def estimate_step_cost(hlo_text, *, n_devices, platform="tpu_v5e",
+                       collective_sites=(), peak_budget_bytes=None):
+    """Roofline cost of one compiled step (see module docstring).
+
+    ``collective_sites`` is the trace-time `SiteRecord` list (the audit
+    stats' ``jaxpr.collective_sites``); chunked rings there earn the
+    overlap credit. ``peak_budget_bytes`` (None = no gate) rejects the
+    candidate with :data:`REJECT_PEAK_MEMORY` when the static peak
+    exceeds it.
+    """
+    p = resolve_platform(platform)
+    n = max(2, int(n_devices))
+
+    flops = dot_flops(hlo_text)
+    compute_s = flops / p.flops_per_second
+
+    sends = hlo_lib.ring_send_bytes(hlo_text, n, by_dtype=True)
+    counts = hlo_lib.collective_counts(hlo_text)
+    wire_by_dtype = {}
+    bw_s = {}
+    for op, per_dtype in sends.items():
+        if op == "total":
+            continue
+        for dt, b in per_dtype.items():
+            wire_by_dtype[dt] = wire_by_dtype.get(dt, 0) + b
+        bw_s[op] = sum(per_dtype.values()) / p.ici_bytes_per_second
+    lat_s = {op: counts.get(op, 0) * _RING_HOPS[op](n) *
+             p.ici_latency_seconds for op in bw_s}
+    blocking_s = sum(bw_s.values()) + sum(lat_s.values())
+
+    chunks = _site_chunks(collective_sites)
+    permute_s = bw_s.get("collective-permute", 0.0) + \
+        lat_s.get("collective-permute", 0.0)
+    credit_s = permute_s * (1.0 - 1.0 / chunks) if chunks > 1 else 0.0
+    exposed_s = blocking_s - credit_s
+
+    peak = hlo_lib.estimate_peak_memory(hlo_text)["peak_bytes"]
+    reject = None
+    if peak_budget_bytes is not None and peak > peak_budget_bytes:
+        reject = REJECT_PEAK_MEMORY
+
+    return StepCost(
+        platform=p.name,
+        n_devices=n,
+        flops=flops,
+        compute_seconds=compute_s,
+        wire_bytes=sends.get("total", 0),
+        wire_bytes_by_dtype=wire_by_dtype,
+        interconnect_seconds=blocking_s,
+        exposed_interconnect_seconds=exposed_s,
+        overlap_credit_seconds=credit_s,
+        overlap_chunks=chunks,
+        peak_bytes=peak,
+        peak_budget_bytes=peak_budget_bytes,
+        step_seconds=compute_s + exposed_s,
+        reject_reason=reject,
+    )

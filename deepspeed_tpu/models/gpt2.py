@@ -10,6 +10,7 @@ Megatron-style tensor-parallel PartitionSpecs over the ``model`` mesh axis.
 
 import dataclasses
 import functools
+import re
 from typing import Any, Optional
 
 import jax
@@ -52,6 +53,18 @@ class GPT2Config:
     loss_chunk: int = 0              # >0: chunked cross-entropy over the
     #                                  vocab head (never materializes the
     #                                  [B, T, vocab] logits in HBM)
+    scan_layers: bool = False        # stack the Blocks into one lax.scan
+    #                                  over layer-stacked params: the HLO
+    #                                  carries ONE block body instead of
+    #                                  n_layer copies, collapsing trace +
+    #                                  compile wall and HLO size (the
+    #                                  autotuner's inner loop is a
+    #                                  compile, so this pays per
+    #                                  candidate). Params live under "h"
+    #                                  with a leading layer axis; see
+    #                                  stack_gpt2_layer_params /
+    #                                  unstack_gpt2_layer_params for
+    #                                  checkpoint conversion.
 
 
 # Sizes follow the reference perf-harness configs
@@ -170,7 +183,8 @@ class Block(nn.Module):
     n_layers: int = 1
 
     @nn.compact
-    def __call__(self, x, deterministic=True, pld_theta=None):
+    def __call__(self, x, deterministic=True, pld_theta=None,
+                 layer_idx=None):
         cfg = self.config
         attn = CausalSelfAttention(cfg, name="attn")
         mlp = MLP(cfg, name="mlp")
@@ -182,10 +196,25 @@ class Block(nn.Module):
             x = x + mlp(ln2(x), deterministic)
             return x
 
-        keep_p = 1.0 - (self.layer_idx + 1) / self.n_layers * \
+        # ``layer_idx`` as a call arg overrides the attribute so the
+        # scan_layers path can feed the (traced) loop counter into the
+        # PLD depth schedule.
+        idx = self.layer_idx if layer_idx is None else layer_idx
+        keep_p = 1.0 - (idx + 1) / self.n_layers * \
             (1.0 - pld_theta)
         coin_a = jax.random.bernoulli(self.make_rng("pld"), keep_p)
         coin_m = jax.random.bernoulli(self.make_rng("pld"), keep_p)
+        if cfg.scan_layers:
+            # flax can't build submodules inside lax.cond branches under
+            # the lifted scan trace, so the skip degrades to a
+            # multiplicative gate: same dropped-layer values, but the
+            # sublayer compute always runs (PLD's FLOP saving is the one
+            # thing scan_layers gives up).
+            x = x + jnp.where(coin_a, 1, 0).astype(x.dtype) * \
+                attn(ln1(x), deterministic)
+            x = x + jnp.where(coin_m, 1, 0).astype(x.dtype) * \
+                mlp(ln2(x), deterministic)
+            return x
         x = jax.lax.cond(coin_a,
                          lambda h: h + attn(ln1(h), deterministic),
                          lambda h: h, x)
@@ -225,9 +254,30 @@ class GPT2LMHead(nn.Module):
                     f"{sorted(policies)}")
             policy = policies[cfg.remat_policy]
             block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
-        for i in range(cfg.n_layer):
-            x = block_cls(cfg, layer_idx=i, n_layers=cfg.n_layer,
-                          name=f"h_{i}")(x, deterministic, pld_theta)
+        if cfg.scan_layers:
+            # One lax.scan over layer-stacked params instead of n_layer
+            # unrolled Block copies: the lowered HLO carries a single
+            # block body (trip-count-weighted by the audit), so trace and
+            # compile wall stop scaling with depth. Params live under
+            # "h" with a leading layer axis (variable_axes={"params": 0});
+            # per-layer rngs come from split_rngs, and the PLD depth
+            # schedule rides the scanned iota as the layer index.
+            def body(block, h, idx, det, theta):
+                return block(h, det, theta, layer_idx=idx), None
+
+            scan = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True, "pld": True},
+                in_axes=(0, nn.broadcast, nn.broadcast),
+                length=cfg.n_layer)
+            x, _ = scan(block_cls(cfg, n_layers=cfg.n_layer, name="h"),
+                        x, jnp.arange(cfg.n_layer), deterministic,
+                        pld_theta)
+        else:
+            for i in range(cfg.n_layer):
+                x = block_cls(cfg, layer_idx=i, n_layers=cfg.n_layer,
+                              name=f"h_{i}")(x, deterministic, pld_theta)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
             return x        # chunked-loss path applies the head itself
@@ -399,26 +449,79 @@ def gpt2_partition_specs(params, model_axis="model"):
     TP is first-class: column-parallel QKV/FC kernels shard their output dim,
     row-parallel projections shard their input dim, embeddings shard the
     vocab dim, and GSPMD inserts the psums that Megatron hand-codes.
+
+    ``scan_layers`` trees (stacked ``h`` subtree) get the same per-weight
+    specs with a replicated leading layer axis prepended.
     """
     flat = flatten_dict(params)
     specs = {}
     for path, leaf in flat.items():
         name = "/".join(str(p) for p in path)
         ndim = getattr(leaf, "ndim", 0)
+        stacked = bool(path) and str(path[0]) == "h"
+        if stacked:
+            ndim -= 1           # leading layer axis from scan_layers
         if ndim <= 1:
-            specs[path] = P()
+            spec = P()
         elif name.endswith("wte"):
-            specs[path] = P(model_axis, None)
+            spec = P(model_axis, None)
         elif name.endswith("wpe"):
-            specs[path] = P()
+            spec = P()
         elif "attn/c_attn" in name and name.endswith("kernel"):
-            specs[path] = P(None, model_axis)     # column parallel
+            spec = P(None, model_axis)            # column parallel
         elif "attn/c_proj" in name and name.endswith("kernel"):
-            specs[path] = P(model_axis, None)     # row parallel
+            spec = P(model_axis, None)            # row parallel
         elif "mlp/c_fc" in name and name.endswith("kernel"):
-            specs[path] = P(None, model_axis)
+            spec = P(None, model_axis)
         elif "mlp/c_proj" in name and name.endswith("kernel"):
-            specs[path] = P(model_axis, None)
+            spec = P(model_axis, None)
         else:
-            specs[path] = P()
+            spec = P()
+        if stacked:
+            spec = P(None, *spec)   # layer axis is never model-sharded
+        specs[path] = spec
     return unflatten_dict(specs)
+
+
+# ---------------------------------------------------------------------------
+# scan_layers checkpoint interop: stacked <-> per-layer param layouts
+# ---------------------------------------------------------------------------
+
+_LAYER_KEY_RE = re.compile(r"^h_(\d+)$")
+
+
+def stack_gpt2_layer_params(params):
+    """Unrolled tree (``h_0`` … ``h_{L-1}``) -> ``scan_layers`` layout.
+
+    The per-layer subtrees collapse into one ``h`` subtree whose leaves
+    gain a leading layer axis; everything else (wte/wpe/ln_f) passes
+    through untouched. Inverse of :func:`unstack_gpt2_layer_params`;
+    the round trip is bit-exact, so existing checkpoints load into
+    ``scan_layers=True`` models (and back) without loss.
+    """
+    idxs = sorted(int(m.group(1)) for k in params
+                  if (m := _LAYER_KEY_RE.match(str(k))))
+    if not idxs:
+        raise ValueError("no per-layer 'h_<i>' entries to stack")
+    if idxs != list(range(len(idxs))):
+        raise ValueError(f"non-contiguous layer indices: {idxs}")
+    out = {k: v for k, v in params.items()
+           if not _LAYER_KEY_RE.match(str(k))}
+    out["h"] = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0),
+        *[params[f"h_{i}"] for i in idxs])
+    return out
+
+
+def unstack_gpt2_layer_params(params):
+    """``scan_layers`` layout -> unrolled ``h_0`` … ``h_{L-1}`` tree (the
+    inverse of :func:`stack_gpt2_layer_params`)."""
+    if "h" not in params:
+        raise ValueError("no stacked 'h' entry to unstack")
+    out = {k: v for k, v in params.items() if str(k) != "h"}
+    stacked = params["h"]
+    n_layer = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    for i in range(n_layer):
+        out[f"h_{i}"] = jax.tree_util.tree_map(
+            lambda leaf: leaf[i], stacked)
+    return out

@@ -418,13 +418,16 @@ class AnalysisConfig:
         self.peak_memory_budget_mb = get_scalar_param(
             sub, ANALYSIS_PEAK_MEMORY_BUDGET_MB,
             ANALYSIS_PEAK_MEMORY_BUDGET_MB_DEFAULT)
+        self.platform = get_scalar_param(sub, ANALYSIS_PLATFORM,
+                                         ANALYSIS_PLATFORM_DEFAULT)
 
     def __repr__(self):
         return (f"AnalysisConfig(enabled={self.enabled}, "
                 f"fail_on_findings={self.fail_on_findings}, "
                 f"rules={self.rules!r}, "
                 f"check_recompile={self.check_recompile}, "
-                f"peak_memory_budget_mb={self.peak_memory_budget_mb})")
+                f"peak_memory_budget_mb={self.peak_memory_budget_mb}, "
+                f"platform={self.platform!r})")
 
 
 class TelemetryConfig:

@@ -296,6 +296,11 @@ ANALYSIS_CHECK_RECOMPILE_DEFAULT = True
 # fp32 master footprint (see analysis/rules.py:rule_peak_memory).
 ANALYSIS_PEAK_MEMORY_BUDGET_MB = "peak_memory_budget_mb"
 ANALYSIS_PEAK_MEMORY_BUDGET_MB_DEFAULT = 0
+# Cost-model constants table for the autotuner (`ds_tpu_tune`) and any
+# roofline estimate derived from this config; must name a row of
+# analysis.cost.PLATFORMS.
+ANALYSIS_PLATFORM = "platform"
+ANALYSIS_PLATFORM_DEFAULT = "tpu_v5e"
 
 # Manual tensor-parallel tuning (parallel/pipe_tp.py, parallel/sequence.py,
 # moe/expert_pipe.py). The `overlap` block enables the latency-hiding

@@ -47,16 +47,44 @@ RESTAGE_AXES = ("pipe",)
 HARD_AXES = ("model", "seq", "expert")
 
 
-def current_topology(mesh, zero_stage=0, offload=False, process_count=None):
-    """The live engine's topology, in the manifest's schema."""
+def current_topology(mesh, zero_stage=0, offload=False, process_count=None,
+                     param_layout=None):
+    """The live engine's topology, in the manifest's schema.
+
+    ``param_layout`` records how transformer layers are laid out in the
+    param pytree (``"stacked"`` for `scan_layers` models — one ``"h"``
+    entry with a leading layer axis — ``"per_layer"`` for unrolled
+    ``h_<i>`` entries); None omits the field, keeping pre-scan
+    manifests byte-identical.
+    """
     if process_count is None:
         process_count = jax.process_count()
-    return {
+    topo = {
         "mesh_shape": mesh_shape_dict(mesh),
         "process_count": int(process_count),
         "zero_stage": int(zero_stage),
         "offload": bool(offload),
     }
+    if param_layout is not None:
+        topo["param_layout"] = str(param_layout)
+    return topo
+
+
+def param_layout(params):
+    """Detect the layer layout of a param pytree's top level: "stacked"
+    (a ``"h"`` key — `scan_layers`), "per_layer" (``h_<i>`` keys), or
+    None for models without named transformer layers. Pure key
+    inspection, so the engine can record it without importing model
+    code."""
+    try:
+        keys = {str(k) for k in params}
+    except TypeError:
+        return None
+    if "h" in keys:
+        return "stacked"
+    if any(k.startswith("h_") and k[2:].isdigit() for k in keys):
+        return "per_layer"
+    return None
 
 
 class TopologyCheck(NamedTuple):
@@ -87,13 +115,24 @@ def check_topology(saved, current, elastic=False):
     for axis in MESH_AXES:
         if s_axes[axis] != c_axes[axis]:
             changed[axis] = (s_axes[axis], c_axes[axis])
-    for field in ("process_count", "zero_stage", "offload"):
+    for field in ("process_count", "zero_stage", "offload",
+                  "param_layout"):
         s, c = saved.get(field), current.get(field)
         if s is not None and c is not None and s != c:
             changed[field] = (s, c)
 
     if not changed:
         return TopologyCheck("same", {})
+
+    if "param_layout" in changed:
+        s, c = changed["param_layout"]
+        raise ElasticResumeError(
+            f"checkpoint stores {s} layer params but the model expects "
+            f"{c}: the pytree structures differ, not just the "
+            "placement. Convert the checkpoint first "
+            "(models.gpt2.stack_gpt2_layer_params / "
+            "unstack_gpt2_layer_params) or build the model with the "
+            "matching scan_layers setting.", saved=saved, current=current)
 
     hard = [a for a in HARD_AXES if a in changed]
     if hard or "offload" in changed:

@@ -391,6 +391,16 @@ class DeepSpeedEngine:
             # before ANY engine jit (opt-state init compiles below)
             jax.config.update("jax_compilation_cache_dir",
                               self._config.compilation_cache_dir)
+            try:
+                # jax latches "no cache" at the process's FIRST compile
+                # (param init/mesh build typically precede the engine);
+                # reset so the next compile re-reads the dir.
+                from jax._src import compilation_cache as _jax_cc
+                _jax_cc.reset_cache()
+            except Exception:  # pragma: no cover - jax internals moved
+                pass
+            from deepspeed_tpu.telemetry import compile_cache
+            compile_cache.install()
 
         # --- precision policy -------------------------------------------
         if self._config.fp16_enabled:
@@ -2339,7 +2349,8 @@ class DeepSpeedEngine:
                     pass
         return out
 
-    def _stamp_compile_facts(self, placed, step_rng, lr_in):
+    def _stamp_compile_facts(self, placed, step_rng, lr_in,
+                             compile_seconds=None):
         """Emit the one-shot ``compile`` event: static facts of the
         compiled step so the run's log is self-describing. Reuses the
         analysis block's audit stats when that ran; otherwise (with
@@ -2351,6 +2362,13 @@ class DeepSpeedEngine:
                  "flavor": self._telemetry_flavor(),
                  "flops_per_token": tl.flops_per_token or None,
                  "batch_tokens": self._batch_tokens}
+        if compile_seconds is not None:
+            facts["compile_seconds"] = round(compile_seconds, 4)
+        if self._config.compilation_cache_dir:
+            from deepspeed_tpu.telemetry import compile_cache
+            cc = compile_cache.counts()
+            facts["compile_cache_hits"] = cc["hits"]
+            facts["compile_cache_misses"] = cc["misses"]
         stats = None
         if self.last_audit_report is not None:
             stats = self.last_audit_report.stats
@@ -2472,6 +2490,11 @@ class DeepSpeedEngine:
             step_rng = jax.random.fold_in(
                 jax.random.fold_in(self._rng, 0), self.global_steps)
             lr_in = jnp.asarray(self._current_host_lr(), jnp.float32)
+            # First-call wall from here through the step dispatch is
+            # trace+compile (the device execution is async): the
+            # `compile` event's compile_seconds, which a warm persistent
+            # cache (compilation_cache_dir) should drive to near zero.
+            compile_t0 = time.perf_counter() if first_compile else None
             if first_compile and self._config.analysis.enabled:
                 # Compile-time audit: lowering here both triggers the one
                 # real compile (the step call below is then a jit-cache
@@ -2489,7 +2512,9 @@ class DeepSpeedEngine:
         if first_compile and tele is not None:
             # One-shot static facts (overlaps the step's device execution:
             # the compiled call above is still in flight).
-            self._stamp_compile_facts(placed, step_rng, lr_in)
+            self._stamp_compile_facts(
+                placed, step_rng, lr_in,
+                compile_seconds=time.perf_counter() - compile_t0)
         if step_t0 is not None or tele is not None:
             # block on the step's own outputs BEFORE stopping any timer:
             # effects_barrier (inside the timers) only waits for
@@ -2763,12 +2788,15 @@ class DeepSpeedEngine:
 
     def _topology(self):
         """This engine's topology fingerprint (manifest "topology" section):
-        mesh shape, process count, ZeRO stage, offload flag — what
+        mesh shape, process count, ZeRO stage, offload flag, and the
+        layer-param layout (stacked scan_layers vs per-layer) — what
         :func:`check_topology` compares on load to decide whether the
         checkpoint needs an elastic reshard."""
+        from deepspeed_tpu.runtime.elastic.topology import param_layout
         return current_topology(self.mesh,
                                 zero_stage=self.zero_optimization_stage(),
-                                offload=self._offload)
+                                offload=self._offload,
+                                param_layout=param_layout(self.params))
 
     def _arrays_manifest(self, state):
         """Per-leaf logical metadata (manifest "arrays" section): shape,

@@ -1,0 +1,53 @@
+"""Persistent-compilation-cache hit/miss counters.
+
+jax's compiler records ``/jax/compilation_cache/cache_hits`` /
+``cache_misses`` monitoring events whenever the persistent cache
+(``compilation_cache_dir`` in the engine config) serves or misses a
+lookup. This module installs one process-wide listener and exposes the
+running counts so the engine's ``compile`` telemetry event (and the
+tuner's rerun report) can show that a warmed cache produced near-zero
+recompilation.
+
+The listener is a no-op until :func:`install` is called — the engine
+calls it exactly when it applies ``compilation_cache_dir`` — and
+installing twice is safe.
+"""
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_counts = {"hits": 0, "misses": 0}
+_installed = False
+
+
+def _listener(event, **kwargs):
+    if event == _HIT_EVENT:
+        _counts["hits"] += 1
+    elif event == _MISS_EVENT:
+        _counts["misses"] += 1
+
+
+def install():
+    """Register the monitoring listener (idempotent). Returns True when
+    the listener is active, False when jax.monitoring is unavailable."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax import monitoring
+        monitoring.register_event_listener(_listener)
+    except Exception:
+        return False
+    _installed = True
+    return True
+
+
+def counts():
+    """``{"hits": int, "misses": int}`` accumulated since install()."""
+    return dict(_counts)
+
+
+def reset():
+    """Zero the counters (test helper)."""
+    _counts["hits"] = 0
+    _counts["misses"] = 0

@@ -26,7 +26,8 @@ from deepspeed_tpu.runtime.elastic import (
     stream_device_put,
 )
 from deepspeed_tpu.runtime.elastic.topology import (
-    spec_from_json, spec_to_json, strip_axis)
+    current_topology, param_layout, spec_from_json, spec_to_json,
+    strip_axis)
 from deepspeed_tpu.runtime.resilience.checkpoint import (
     CheckpointIOError, CheckpointManager)
 from tests.unit.simple_model import RandomDataset, base_config
@@ -130,6 +131,41 @@ def test_topology_hard_mismatch_raises_typed():
                        elastic=True)
     # every mismatch flavor is catchable as the one typed error
     assert issubclass(ElasticResumeError, CheckpointTopologyError)
+
+
+# ----------------------------------------------------------------------
+# param layout (scan_layers stacked vs unrolled pytrees)
+# ----------------------------------------------------------------------
+
+def test_param_layout_detects_stacked_and_per_layer():
+    assert param_layout({"wte": 0, "h": {"ln_1": 0}}) == "stacked"
+    assert param_layout({"wte": 0, "h_0": {}, "h_11": {}}) == "per_layer"
+    # no named transformer layers -> unknown (field omitted)
+    assert param_layout({"wte": 0, "lm_head": 0}) is None
+    assert param_layout(None) is None          # non-mapping pytrees
+    # "h_x" without a numeric suffix is not a layer entry
+    assert param_layout({"h_emb": 0}) is None
+
+
+def test_current_topology_records_param_layout_only_when_known():
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices()[:2]).reshape(2), ("data",))
+    with_layout = current_topology(mesh, process_count=1,
+                                   param_layout="stacked")
+    assert with_layout["param_layout"] == "stacked"
+    # None omits the key entirely: pre-scan manifests stay byte-identical
+    assert "param_layout" not in current_topology(mesh, process_count=1)
+
+
+def test_topology_param_layout_mismatch_raises_typed():
+    saved = dict(topo(), param_layout="per_layer")
+    current = dict(topo(), param_layout="stacked")
+    with pytest.raises(ElasticResumeError, match="Convert the checkpoint"):
+        check_topology(saved, current, elastic=True)
+    # same layout on both sides is a plain restore
+    assert check_topology(saved, dict(saved)).kind == "same"
+    # one side unrecorded (pre-scan checkpoint) never blocks the load
+    assert check_topology(topo(), current).kind == "same"
 
 
 # ----------------------------------------------------------------------
@@ -246,7 +282,7 @@ def test_config_elastic_bad_lr_scaling_rejected():
 # mid-reshard fault injection
 # ----------------------------------------------------------------------
 
-def seed_checkpoint(tmp_path, world=4):
+def seed_checkpoint(tmp_path, world=4, param_layout=None):
     """A small engine-shaped checkpoint written directly through the
     CheckpointManager (no engine boot needed for resharder tests)."""
     src = str(tmp_path / "src")
@@ -258,7 +294,9 @@ def seed_checkpoint(tmp_path, world=4):
     extra = {"topology": {"mesh_shape": {"data": world, "pipe": 1,
                                          "model": 1, "seq": 1, "expert": 1},
                           "process_count": 1, "zero_stage": 1,
-                          "offload": False},
+                          "offload": False,
+                          **({"param_layout": param_layout}
+                             if param_layout else {})},
              "arrays": {"['params']['w']": {
                  "shape": [4, 4], "dtype": "float32", "spec": ["data"]}}}
     mgr = CheckpointManager(save_dir=src, process_index=0, process_count=1,
@@ -302,6 +340,22 @@ def test_reshard_transient_fault_retries_through(tmp_path, fault_registry):
     summary = reshard_checkpoint(src, dst, target_world=2,
                                  io_retry_base_s=0.001)
     mgr.validate(summary["dst_path"])
+
+
+def test_reshard_preserves_param_layout(tmp_path):
+    """Resharding only retargets the data axis: a recorded param layout
+    (scan_layers stacked pytrees) rides through every hop unchanged, so
+    the resharded checkpoint still refuses to load into a model with
+    the other layout."""
+    src, mgr = seed_checkpoint(tmp_path, param_layout="stacked")
+    dst = str(tmp_path / "dst")
+    summary = reshard_checkpoint(src, dst, target_world=2)
+    man = mgr.validate(summary["dst_path"])
+    assert man["topology"]["param_layout"] == "stacked"
+    with pytest.raises(ElasticResumeError):
+        check_topology(man["topology"],
+                       dict(topo(data=2), param_layout="per_layer"),
+                       elastic=True)
 
 
 def test_reshard_retargets_manifest_and_meta(tmp_path):

@@ -278,11 +278,51 @@ def test_engine_step_events_and_phases(tmp_path):
     assert comp["static_peak_bytes"] > 0
     assert comp["batch_tokens"] == 16 * 10
     assert isinstance(comp["collective_bytes"], dict)
+    # ... and how long the first-step compile took; persistent-cache
+    # counters only appear when compilation_cache_dir is configured
+    assert comp["compile_seconds"] > 0
+    assert "compile_cache_hits" not in comp
     # the engine keeps a bounded in-memory history of step events
     assert len(engine.metrics_history) == 3
     assert engine.metrics_history[-1]["step"] == 3
     # and installed itself as the process-default session
     assert get_default_session() is engine.telemetry
+
+
+def test_compile_cache_counters_accumulate():
+    """The monitoring listener tallies jax's persistent-cache hit/miss
+    events; install() is idempotent and reset() zeroes the counts."""
+    from jax import monitoring
+    from deepspeed_tpu.telemetry import compile_cache
+    assert compile_cache.install() is True
+    assert compile_cache.install() is True   # second call is a no-op
+    compile_cache.reset()
+    monitoring.record_event("/jax/compilation_cache/cache_hits")
+    monitoring.record_event("/jax/compilation_cache/cache_misses")
+    monitoring.record_event("/jax/compilation_cache/cache_misses")
+    assert compile_cache.counts() == {"hits": 1, "misses": 2}
+    compile_cache.reset()
+    assert compile_cache.counts() == {"hits": 0, "misses": 0}
+
+
+def test_engine_compile_event_cache_counters(tmp_path):
+    """With compilation_cache_dir configured the compile event carries
+    the persistent-cache hit/miss counts alongside compile_seconds."""
+    from deepspeed_tpu.telemetry import compile_cache
+    compile_cache.reset()
+    path = tmp_path / "run.jsonl"
+    engine = _telemetry_engine(
+        path, compilation_cache_dir=str(tmp_path / "xla_cache"))
+    try:
+        engine.train_batch(random_batch(16))
+        engine.telemetry.close()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+    comp = next(e for e in _read_events(path)
+                if e["event"] == "compile")
+    assert comp["compile_seconds"] > 0
+    assert isinstance(comp["compile_cache_hits"], int)
+    assert isinstance(comp["compile_cache_misses"], int)
 
 
 def test_metrics_history_ring_is_bounded():
