@@ -824,6 +824,68 @@ def run_once_resilience(jax, ckpt_dir):
     return overhead_pct, base_ms, guard_ms, save_s, restore_s
 
 
+def run_once_forensics(jax, dump_dir):
+    """Forensics subsystem cost: per-step overhead of the always-on
+    flight recorder + hang watchdog (phase heartbeats on every span,
+    per-step deadline bookkeeping, the daemon poller writing heartbeat
+    files) against the same telemetry-enabled engine with the forensics
+    knobs off. Runs on any backend — every hook under test is host-side
+    Python and the row reports a ratio, not absolute seconds."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2LMHead, gpt2_tiny, init_gpt2_params, make_gpt2_loss_fn)
+
+    batch_size = int(os.environ.get("BENCH_BS", "2"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+
+    cfg = gpt2_tiny(n_positions=seq_len)
+    model = GPT2LMHead(cfg)
+    hb(f"forensics: gpt2 tiny init (bs{batch_size}, seq{seq_len})")
+    params = init_gpt2_params(model, jax.random.PRNGKey(0), seq_len=seq_len)
+    params = jax.tree_util.tree_map(np.asarray, params)
+    loss_fn = make_gpt2_loss_fn(model)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(batch_size, seq_len)).astype(np.int32)}
+
+    def build(forensics):
+        telemetry = {"enabled": True}
+        if forensics:
+            telemetry.update({
+                "crash_dump_dir": dump_dir,
+                # generous deadline: the row measures steady-state
+                # bookkeeping cost, the watchdog must never fire here
+                "watchdog": {"enabled": True, "deadline_factor": 50.0,
+                             "min_deadline_s": 600.0},
+                "anomaly_trace": {"enabled": True, "factor": 100.0}})
+        config = {
+            "train_batch_size": batch_size,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9,
+            "telemetry": telemetry,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=config, loss_fn=loss_fn, params=params)
+        return engine
+
+    hb("forensics: baseline engine (telemetry on, watchdog off)")
+    base = build(False)
+    base_dt = time_engine_steps(base, batch, steps)
+    base.telemetry.close()
+
+    hb("forensics: flight recorder + watchdog + anomaly detector on")
+    armed = build(True)
+    armed_dt = time_engine_steps(armed, batch, steps)
+    fired = list(armed.telemetry.watchdog.fired)
+    armed.telemetry.close()
+
+    base_ms = base_dt / steps * 1e3
+    armed_ms = armed_dt / steps * 1e3
+    overhead_pct = (armed_ms - base_ms) / base_ms * 100.0
+    return overhead_pct, base_ms, armed_ms, len(fired)
+
+
 def run_once_elastic(jax, work_dir):
     """Elasticity subsystem cost at GPT-2 125M: wall time of an offline
     N→N/2 checkpoint reshard (bin/ds_tpu_reshard's code path) and the
@@ -1300,6 +1362,38 @@ def main():
                   "traceback": traceback.format_exc(limit=5)})
         finally:
             shutil.rmtree(ckpt_dir, ignore_errors=True)
+        return
+    if bench_model == "forensics":
+        # Forensics PR row: what the always-on flight recorder + hang
+        # watchdog cost per train step. Host-side hooks only, so the
+        # ratio is meaningful on any backend (CPU included) — no TPU
+        # gate, mirroring the tune row's contract.
+        import shutil
+        import tempfile
+        dump_dir = tempfile.mkdtemp(prefix="bench_forensics_")
+        try:
+            overhead_pct, base_ms, armed_ms, fired = \
+                run_once_forensics(jax, dump_dir)
+            out = {"metric": "forensics overhead per step (GPT-2 tiny, "
+                             "flight recorder + hang watchdog + anomaly "
+                             "detector vs telemetry-only)",
+                   "value": round(overhead_pct, 2), "unit": "%",
+                   # no reference counterpart; the overhead is the headline
+                   "vs_baseline": 0.0,
+                   "step_ms_base": round(base_ms, 2),
+                   "step_ms_armed": round(armed_ms, 2),
+                   "watchdog_fired": fired,
+                   "live": on_tpu}
+            if on_tpu:
+                save_tpu_result(out)
+            emit(out)
+        except Exception as e:
+            emit({"metric": "forensics overhead per step", "value": 0,
+                  "unit": "%", "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {e}",
+                  "traceback": traceback.format_exc(limit=5)})
+        finally:
+            shutil.rmtree(dump_dir, ignore_errors=True)
         return
     if bench_model == "elastic":
         # Elasticity PR row: offline N->N/2 reshard wall time plus the

@@ -440,7 +440,17 @@ class TelemetryConfig:
     KEYS = (TELEMETRY_ENABLED, TELEMETRY_JSONL_PATH, TELEMETRY_CONSOLE,
             TELEMETRY_PROMETHEUS_TEXTFILE, TELEMETRY_PROMETHEUS_WRITE_EVERY,
             TELEMETRY_HISTORY, TELEMETRY_STAMP_STATIC_FACTS,
-            TELEMETRY_FLOPS_PER_TOKEN)
+            TELEMETRY_FLOPS_PER_TOKEN, TELEMETRY_CRASH_DUMP_DIR,
+            TELEMETRY_FLIGHT_HISTORY, TELEMETRY_WATCHDOG,
+            TELEMETRY_ANOMALY_TRACE)
+    WATCHDOG_KEYS = (TELEMETRY_WATCHDOG_ENABLED,
+                     TELEMETRY_WATCHDOG_DEADLINE_FACTOR,
+                     TELEMETRY_WATCHDOG_MIN_DEADLINE_S,
+                     TELEMETRY_WATCHDOG_ACTION)
+    ANOMALY_KEYS = (TELEMETRY_ANOMALY_TRACE_ENABLED,
+                    TELEMETRY_ANOMALY_TRACE_FACTOR,
+                    TELEMETRY_ANOMALY_TRACE_WINDOW,
+                    TELEMETRY_ANOMALY_TRACE_CAPTURE_STEPS)
 
     def __init__(self, param_dict):
         sub = param_dict.get(TELEMETRY, {}) or {}
@@ -465,6 +475,37 @@ class TelemetryConfig:
         self.flops_per_token = get_scalar_param(
             sub, TELEMETRY_FLOPS_PER_TOKEN,
             TELEMETRY_FLOPS_PER_TOKEN_DEFAULT)
+        self.crash_dump_dir = get_scalar_param(
+            sub, TELEMETRY_CRASH_DUMP_DIR, TELEMETRY_CRASH_DUMP_DIR_DEFAULT)
+        self.flight_history = get_scalar_param(
+            sub, TELEMETRY_FLIGHT_HISTORY, TELEMETRY_FLIGHT_HISTORY_DEFAULT)
+        wd = sub.get(TELEMETRY_WATCHDOG, {}) or {}
+        self._watchdog_given_keys = tuple(wd)
+        self.watchdog_enabled = get_scalar_param(
+            wd, TELEMETRY_WATCHDOG_ENABLED,
+            TELEMETRY_WATCHDOG_ENABLED_DEFAULT)
+        self.watchdog_deadline_factor = get_scalar_param(
+            wd, TELEMETRY_WATCHDOG_DEADLINE_FACTOR,
+            TELEMETRY_WATCHDOG_DEADLINE_FACTOR_DEFAULT)
+        self.watchdog_min_deadline_s = get_scalar_param(
+            wd, TELEMETRY_WATCHDOG_MIN_DEADLINE_S,
+            TELEMETRY_WATCHDOG_MIN_DEADLINE_S_DEFAULT)
+        self.watchdog_action = get_scalar_param(
+            wd, TELEMETRY_WATCHDOG_ACTION, TELEMETRY_WATCHDOG_ACTION_DEFAULT)
+        an = sub.get(TELEMETRY_ANOMALY_TRACE, {}) or {}
+        self._anomaly_given_keys = tuple(an)
+        self.anomaly_trace_enabled = get_scalar_param(
+            an, TELEMETRY_ANOMALY_TRACE_ENABLED,
+            TELEMETRY_ANOMALY_TRACE_ENABLED_DEFAULT)
+        self.anomaly_trace_factor = get_scalar_param(
+            an, TELEMETRY_ANOMALY_TRACE_FACTOR,
+            TELEMETRY_ANOMALY_TRACE_FACTOR_DEFAULT)
+        self.anomaly_trace_window = get_scalar_param(
+            an, TELEMETRY_ANOMALY_TRACE_WINDOW,
+            TELEMETRY_ANOMALY_TRACE_WINDOW_DEFAULT)
+        self.anomaly_trace_capture_steps = get_scalar_param(
+            an, TELEMETRY_ANOMALY_TRACE_CAPTURE_STEPS,
+            TELEMETRY_ANOMALY_TRACE_CAPTURE_STEPS_DEFAULT)
 
     def __repr__(self):
         return (f"TelemetryConfig(enabled={self.enabled}, "
@@ -473,7 +514,10 @@ class TelemetryConfig:
                 f"prometheus_textfile={self.prometheus_textfile!r}, "
                 f"history={self.history}, "
                 f"stamp_static_facts={self.stamp_static_facts}, "
-                f"flops_per_token={self.flops_per_token})")
+                f"flops_per_token={self.flops_per_token}, "
+                f"crash_dump_dir={self.crash_dump_dir!r}, "
+                f"watchdog_enabled={self.watchdog_enabled}, "
+                f"anomaly_trace_enabled={self.anomaly_trace_enabled})")
 
 
 class TensorParallelConfig:
@@ -1074,6 +1118,58 @@ class DeepSpeedConfig:
             raise ValueError(
                 f"telemetry: flops_per_token must be a non-negative "
                 f"number (0 = unknown), got {fpt!r}")
+        self._check_telemetry_forensics(tl)
+
+    def _check_telemetry_forensics(self, tl):
+        from deepspeed_tpu.telemetry.watchdog import WATCHDOG_ACTIONS
+        if tl.crash_dump_dir is not None and \
+                not isinstance(tl.crash_dump_dir, str):
+            raise ValueError(
+                f"telemetry: crash_dump_dir must be a path string or "
+                f"null, got {tl.crash_dump_dir!r}")
+        fh = tl.flight_history
+        if isinstance(fh, bool) or not isinstance(fh, int) or fh < 1:
+            raise ValueError(
+                f"telemetry: flight_history must be an int >= 1, "
+                f"got {fh!r}")
+        for label, given, allowed in (
+                ("watchdog", tl._watchdog_given_keys, tl.WATCHDOG_KEYS),
+                ("anomaly_trace", tl._anomaly_given_keys, tl.ANOMALY_KEYS)):
+            unknown = sorted(set(given) - set(allowed))
+            if unknown:
+                raise ValueError(
+                    f"telemetry: unknown {label} key(s) {unknown}; "
+                    f"allowed: {sorted(allowed)}")
+        for name, v in (("watchdog.enabled", tl.watchdog_enabled),
+                        ("anomaly_trace.enabled", tl.anomaly_trace_enabled)):
+            if not isinstance(v, bool):
+                raise ValueError(
+                    f"telemetry: {name} must be a bool, got {v!r}")
+        for name, v in (
+                ("watchdog.deadline_factor", tl.watchdog_deadline_factor),
+                ("watchdog.min_deadline_s", tl.watchdog_min_deadline_s),
+                ("anomaly_trace.factor", tl.anomaly_trace_factor)):
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or v <= 0:
+                raise ValueError(
+                    f"telemetry: {name} must be a positive number, "
+                    f"got {v!r}")
+        if tl.watchdog_action not in WATCHDOG_ACTIONS:
+            raise ValueError(
+                f"telemetry: watchdog.action must be one of "
+                f"{list(WATCHDOG_ACTIONS)}, got {tl.watchdog_action!r}")
+        for name, v in (
+                ("anomaly_trace.window", tl.anomaly_trace_window),
+                ("anomaly_trace.capture_steps",
+                 tl.anomaly_trace_capture_steps)):
+            if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+                raise ValueError(
+                    f"telemetry: {name} must be an int >= 1, got {v!r}")
+        if tl.watchdog_enabled and not tl.crash_dump_dir:
+            raise ValueError(
+                "telemetry: watchdog.enabled requires crash_dump_dir — "
+                "the watchdog writes its heartbeat files and flight "
+                "dumps there")
 
     def _check_elasticity(self):
         from deepspeed_tpu.runtime.elastic.batch import LR_SCALING_RULES

@@ -379,3 +379,42 @@ TELEMETRY_STAMP_STATIC_FACTS_DEFAULT = True
 # ds_tpu_metrics CLI can also supply it at read time).
 TELEMETRY_FLOPS_PER_TOKEN = "flops_per_token"
 TELEMETRY_FLOPS_PER_TOKEN_DEFAULT = 0
+
+# Runtime forensics (telemetry/flight.py, telemetry/watchdog.py):
+# setting crash_dump_dir turns on the flight recorder — a bounded
+# black-box ring of events / span transitions / collective confessions
+# dumped atomically there (plus all-thread stacks) on unhandled
+# exception, SIGTERM/SIGQUIT, guard-trip abort, or watchdog firing.
+# It also holds per-process heartbeat files and watchdog dumps, so the
+# nested watchdog block requires it. See docs/observability.md.
+TELEMETRY_CRASH_DUMP_DIR = "crash_dump_dir"
+TELEMETRY_CRASH_DUMP_DIR_DEFAULT = None
+TELEMETRY_FLIGHT_HISTORY = "flight_history"
+TELEMETRY_FLIGHT_HISTORY_DEFAULT = 512
+# Hang watchdog: daemon thread fed per-phase heartbeats from the span
+# stack; fires when a step's elapsed wall exceeds
+# max(min_deadline_s, deadline_factor * rolling-median step wall).
+TELEMETRY_WATCHDOG = "watchdog"
+TELEMETRY_WATCHDOG_ENABLED = "enabled"
+TELEMETRY_WATCHDOG_ENABLED_DEFAULT = False
+TELEMETRY_WATCHDOG_DEADLINE_FACTOR = "deadline_factor"
+TELEMETRY_WATCHDOG_DEADLINE_FACTOR_DEFAULT = 3.0
+TELEMETRY_WATCHDOG_MIN_DEADLINE_S = "min_deadline_s"
+TELEMETRY_WATCHDOG_MIN_DEADLINE_S_DEFAULT = 60.0
+# "dump" = flight dump once per hung step, run continues if the step
+# ever completes; "abort" = dump + thread stacks + SIGABRT so a cluster
+# supervisor restarts the process.
+TELEMETRY_WATCHDOG_ACTION = "action"
+TELEMETRY_WATCHDOG_ACTION_DEFAULT = "dump"
+# Anomaly-triggered trace capture: a step-wall regression past factor x
+# rolling median (or a recompile / guard trip) arms the profiling
+# block's TraceProfiler to capture the next capture_steps steps.
+TELEMETRY_ANOMALY_TRACE = "anomaly_trace"
+TELEMETRY_ANOMALY_TRACE_ENABLED = "enabled"
+TELEMETRY_ANOMALY_TRACE_ENABLED_DEFAULT = False
+TELEMETRY_ANOMALY_TRACE_FACTOR = "factor"
+TELEMETRY_ANOMALY_TRACE_FACTOR_DEFAULT = 2.0
+TELEMETRY_ANOMALY_TRACE_WINDOW = "window"
+TELEMETRY_ANOMALY_TRACE_WINDOW_DEFAULT = 32
+TELEMETRY_ANOMALY_TRACE_CAPTURE_STEPS = "capture_steps"
+TELEMETRY_ANOMALY_TRACE_CAPTURE_STEPS_DEFAULT = 3

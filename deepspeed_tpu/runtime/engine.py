@@ -18,9 +18,11 @@ global batch).
 """
 
 import collections
+import contextlib
 import os
 import json
 import signal
+import socket
 import time
 from typing import Any, Callable, NamedTuple, Optional
 
@@ -69,9 +71,11 @@ from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
 from deepspeed_tpu.ops.adam.fused_adam import adam_update, init_adam_state
 from deepspeed_tpu.ops.fp8 import fp8_scope, init_state_bundle
 from deepspeed_tpu.ops.lamb.fused_lamb import init_lamb_state, lamb_update
+from deepspeed_tpu.parallel.collectives import record_collective_sites
 from deepspeed_tpu.parallel.mesh import build_mesh
 from deepspeed_tpu.telemetry import (
-    TelemetrySession, TraceProfiler, null_span, set_default_session)
+    StepAnomalyDetector, TelemetrySession, TraceProfiler, null_span,
+    set_default_session)
 from deepspeed_tpu.telemetry.timers import (
     SynchronizedWallClockTimer, ThroughputTimer)
 from deepspeed_tpu.utils.compat import shard_map
@@ -545,11 +549,25 @@ class DeepSpeedEngine:
         self.telemetry = None
         self.metrics_history = collections.deque(maxlen=tl.history)
         self._batch_tokens = None
+        self._anomaly_detector = None
+        # Process identity stamped on run_start/step events and flight
+        # dumps — the join key `ds_tpu_metrics aggregate` uses to build
+        # the cross-host skew table from per-host logs.
+        self._proc_meta = {"process_index": jax.process_index(),
+                           "process_count": jax.process_count(),
+                           "hostname": socket.gethostname()}
         if tl.enabled:
-            self.telemetry = TelemetrySession.from_config(tl)
+            self.telemetry = TelemetrySession.from_config(
+                tl, meta={**self._proc_meta,
+                          "flavor": self._telemetry_flavor(),
+                          **self._forensics_extra()})
             set_default_session(self.telemetry, replace=False)
             import atexit
             atexit.register(self.telemetry.close)
+            if tl.anomaly_trace_enabled:
+                self._anomaly_detector = StepAnomalyDetector(
+                    factor=tl.anomaly_trace_factor,
+                    window=tl.anomaly_trace_window)
             self.telemetry.emit(
                 "run_start",
                 flavor=self._telemetry_flavor(),
@@ -562,7 +580,9 @@ class DeepSpeedEngine:
                 n_devices=len(jax.devices()),
                 fp16=self.fp16_enabled(),
                 bf16=self.bfloat16_enabled(),
-                flops_per_token=tl.flops_per_token or None)
+                flops_per_token=tl.flops_per_token or None,
+                **self._proc_meta,
+                **self._forensics_extra())
         self.summary_writer = None
         if self._config.tensorboard_enabled and jax.process_index() == 0:
             self.summary_writer = self._get_summary_writer()
@@ -613,6 +633,15 @@ class DeepSpeedEngine:
         if rz.save_on_sigterm:
             self._preemption = PreemptionHandler()
             self._preemption.install()
+        # Forensics (telemetry/flight.py, telemetry/watchdog.py): crash
+        # hooks go in AFTER the preemption handler so a SIGTERM dumps
+        # the flight record first, then chains into the checkpoint-at-
+        # next-boundary latch. The watchdog daemon starts here too.
+        if self.telemetry is not None:
+            if self.telemetry.flight is not None:
+                self.telemetry.flight.install()
+            if self.telemetry.watchdog is not None:
+                self.telemetry.watchdog.start()
         if self.cpu_optimizer is not None:
             self.cpu_optimizer.host_adam_retries = rz.host_adam_retries
             self.cpu_optimizer.host_adam_timeout_s = rz.io_timeout_s
@@ -1231,16 +1260,54 @@ class DeepSpeedEngine:
         ``skip_step`` need no host action (the monitor already logged;
         skip happened inside the compiled step). ``rollback`` reloads the
         newest valid checkpoint, escalating to abort when there is
-        nothing to roll back to."""
+        nothing to roll back to. An abort dumps the flight record first —
+        the aborted run's black box must out-survive the raise."""
         if trip.action == ACTION_ROLLBACK:
             rz = self._config.resilience
             path, _ = self.load_checkpoint(rz.save_dir)
             if path is None:
+                self._dump_flight(f"guard_abort:{trip.guard}",
+                                  extra={"guard_trip": trip.as_event()})
                 raise HealthGuardAbort(trip)
             log_dist(f"health guard '{trip.guard}' rolled back to {path} "
                      f"(step {self.global_steps})", ranks=[0])
         elif trip.action == ACTION_ABORT:
+            self._dump_flight(f"guard_abort:{trip.guard}",
+                              extra={"guard_trip": trip.as_event()})
             raise HealthGuardAbort(trip)
+
+    def _dump_flight(self, reason, extra=None):
+        """Dump the flight record if the recorder is configured (no-op
+        otherwise); never raises."""
+        flight = self.telemetry.flight if self.telemetry is not None \
+            else None
+        if flight is not None:
+            return flight.dump(reason, extra=extra)
+        return None
+
+    def _forensics_extra(self):
+        """Extra run facts stamped on run_start events and flight-dump
+        meta. Subclasses (the pipeline engine) add their topology."""
+        return {}
+
+    def _arm_anomaly_trace(self, reason):
+        """Anomaly-triggered trace capture: arm the TraceProfiler for the
+        next ``capture_steps`` steps (no-op when anomaly_trace is off, a
+        window is already active, or no trace dir is resolvable)."""
+        if self._anomaly_detector is None or self.telemetry is None:
+            return
+        tl = self._config.telemetry
+        trace_dir = self.trace_profiler.trace_dir
+        if trace_dir is None and tl.crash_dump_dir:
+            trace_dir = os.path.join(tl.crash_dump_dir, "anomaly_traces")
+        if not self.trace_profiler.arm(
+                self.global_steps, tl.anomaly_trace_capture_steps,
+                trace_dir=trace_dir, reason=reason):
+            return
+        self.telemetry.emit(
+            "anomaly", step=self.global_steps, reason=reason,
+            capture_steps=tl.anomaly_trace_capture_steps,
+            trace_dir=self.trace_profiler.trace_dir)
 
     def _make_quantized_train_step(self):
         """Compiled step with the int8 chunk-scaled gradient all-reduce
@@ -2447,7 +2514,10 @@ class DeepSpeedEngine:
         # (pinned by the overhead micro-benchmark test).
         tele = self.telemetry
         span = tele.span if tele is not None else null_span
+        watchdog = tele.watchdog if tele is not None else None
         step_wall_t0 = time.perf_counter() if tele is not None else 0.0
+        if watchdog is not None:
+            watchdog.step_start(self.global_steps)
         if batch is None:
             assert self._data_iter is not None, \
                 "no training_data given; pass a batch explicitly"
@@ -2482,6 +2552,15 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         with span("dispatch"):
             placed = self._shard_batch(batch)
+            # Fault harness: a host-side sleep here simulates a stuck
+            # collective/straggler inside the step — the watchdog test
+            # seam (probe is armed-only, and only with fault injection
+            # configured on).
+            if self._config.resilience.fault_injection:
+                hang_s = fault_injection.hang_seconds(self.global_steps)
+                if hang_s > 0.0:
+                    with span("injected_hang"):
+                        time.sleep(hang_s)
             # Derive the step rng from the CHECKPOINTED step counter rather
             # than an in-memory split chain: a resumed engine replays the
             # exact dropout masks the original would have drawn, so training
@@ -2501,14 +2580,30 @@ class DeepSpeedEngine:
                 # hit) and hands the audit the exact HLO that will execute.
                 with span("compile"):
                     self._run_compile_audit(placed, step_rng, lr_in)
-            if self._offload:
-                metrics = self._train_batch_offload(placed, step_rng,
-                                                    lr_in, fault_extra)
-            else:
-                self.params, self.opt_state, self.device_state, metrics = \
-                    self._compiled_train_step(self.params, self.opt_state,
-                                              self.device_state, placed,
-                                              step_rng, lr_in, *fault_extra)
+            # Collective confessions for the flight recorder: the first
+            # call traces the step, and the overlap/ring helpers log one
+            # SiteRecord per collective group they emit while tracing.
+            flight = tele.flight if tele is not None else None
+            sites = None
+            with contextlib.ExitStack() as stack:
+                if first_compile and flight is not None:
+                    sites = stack.enter_context(record_collective_sites())
+                if self._offload:
+                    metrics = self._train_batch_offload(placed, step_rng,
+                                                        lr_in, fault_extra)
+                else:
+                    self.params, self.opt_state, self.device_state, \
+                        metrics = self._compiled_train_step(
+                            self.params, self.opt_state,
+                            self.device_state, placed,
+                            step_rng, lr_in, *fault_extra)
+            if sites is not None:
+                if not sites and self.last_audit_report is not None:
+                    # analysis already traced the step (our call above was
+                    # a jit-cache hit); reuse the audit's captured sites
+                    jx = self.last_audit_report.stats.get("jaxpr") or {}
+                    sites = jx.get("collective_sites") or []
+                flight.record_collectives(sites)
         if first_compile and tele is not None:
             # One-shot static facts (overlaps the step's device execution:
             # the compiled call above is still in flight).
@@ -2578,6 +2673,7 @@ class DeepSpeedEngine:
                               cache_size=findings[0].details["cache_size"],
                               expected=findings[0].details["expected"],
                               message=findings[0].message)
+                    self._arm_anomaly_trace("recompile")
                 if an.fail_on_findings:
                     raise AuditError(AuditReport(flavor="live",
                                                  findings=findings))
@@ -2607,6 +2703,7 @@ class DeepSpeedEngine:
                     # a checkpoint or raise, and the trip must be on
                     # record either way.
                     tele.emit("health_guard", **trip.as_event())
+                    self._arm_anomaly_trace(f"health_guard:{trip.guard}")
                 self._apply_guard_trip(trip)
 
         rz = self._config.resilience
@@ -2622,15 +2719,24 @@ class DeepSpeedEngine:
             # not stalls), the drained phase spans, and the end-to-end
             # host wall time. Ring-buffered on metrics_history for
             # file-less assertions.
+            step_wall = time.perf_counter() - step_wall_t0
+            if watchdog is not None:
+                watchdog.step_end(self.global_steps - 1, step_wall)
             evt = tele.step_event(
                 step=self.global_steps,
                 flavor=self._telemetry_flavor(),
-                wall_s=time.perf_counter() - step_wall_t0,
+                wall_s=step_wall,
                 phases={k: round(v, 6)
                         for k, v in tele.drain_phases().items()},
                 tokens=self._batch_tokens,
+                process_index=self._proc_meta["process_index"],
+                hostname=self._proc_meta["hostname"],
                 **self._scalar_metrics(metrics))
             self.metrics_history.append(evt)
+            if self._anomaly_detector is not None:
+                reason = self._anomaly_detector.observe(step_wall)
+                if reason is not None:
+                    self._arm_anomaly_trace(reason)
 
         if self.global_steps % self._config.steps_per_print == 0:
             loss = float(metrics["loss"])

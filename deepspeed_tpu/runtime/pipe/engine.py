@@ -199,6 +199,13 @@ class PipelineEngine(DeepSpeedEngine):
     def _engine_accum_steps(self):
         return 1
 
+    def _forensics_extra(self):
+        """Pipeline topology on run_start events and flight-dump meta —
+        a postmortem of a hung 1F1B ring needs stages/micro-batches to
+        read the stage-transfer confessions."""
+        return {"num_stages": self.num_stages,
+                "micro_batches": self.micro_batches}
+
     # --- reference-parity introspection -------------------------------
     def train_schedule(self, stage_id=0):
         """The 1F1B instruction stream the compiled program implements."""

@@ -18,6 +18,10 @@ Seams (all deterministic — armed for explicit steps or a fixed count):
 - ``host_adam`` — :func:`maybe_fail_host_adam` raises
   ``InjectedHostAdamError`` at future-submission time, before the C++
   kernel touches the master buffers, so a retry is exact.
+- ``hang`` — :func:`hang_seconds` tells the engine to sleep on the host
+  *inside* the dispatch span at the armed step, simulating a stuck
+  collective/straggler so the hang watchdog
+  (`telemetry/watchdog.py`) can be exercised end to end.
 
 Use :func:`clear_faults` (or the ``fault_registry`` pytest fixture in
 ``tests/``) to disarm everything between tests.
@@ -134,6 +138,29 @@ def preemption_due(step):
             _faults.pop("preemption", None)
             return True
     return False
+
+
+# --------------------------------------------------------------------------
+# Hangs (stuck collective / straggler simulation)
+# --------------------------------------------------------------------------
+
+def inject_hang(at_step, seconds):
+    """Arm a one-shot host-side sleep of ``seconds`` inside the dispatch
+    phase of engine global step ``at_step``."""
+    with _lock:
+        _faults["hang"] = {"at_step": int(at_step),
+                           "seconds": float(seconds)}
+
+
+def hang_seconds(step):
+    """Seconds the engine should sleep at ``step`` (0.0 = not armed).
+    Fires exactly once, at the first step >= the armed point."""
+    with _lock:
+        entry = _faults.get("hang")
+        if entry is not None and int(step) >= entry["at_step"]:
+            _faults.pop("hang", None)
+            return entry["seconds"]
+    return 0.0
 
 
 # --------------------------------------------------------------------------

@@ -8,6 +8,7 @@ the next step boundary, where the engine state is consistent
 (signal-handler-safe: no I/O, no locks in the handler itself).
 """
 
+import faulthandler
 import logging
 import signal
 import threading
@@ -54,17 +55,42 @@ class PreemptionHandler:
         if threading.current_thread() is threading.main_thread():
             self._prev_handler = signal.signal(self.signum, self._on_signal)
             self._installed = True
+            self._register_sigquit_dump()
         else:
             logger.warning(
                 "PreemptionHandler.install() called off the main thread; "
                 "SIGTERM will not be caught (flag-only mode)")
         return self
 
+    def _register_sigquit_dump(self):
+        """Register a faulthandler all-thread stack dump on SIGQUIT, so
+        any resilience-enabled run answers ``kill -QUIT <pid>`` with
+        "where is every thread stuck" on stderr — no config needed.
+        ``chain=False``: with no prior Python handler the previous
+        disposition is SIG_DFL, and chaining would re-raise into it
+        (terminate + core) — replacing keeps the process running. The
+        flight recorder's own SIGQUIT handler, installed later,
+        supersedes this and prints the same stacks itself."""
+        sigquit = getattr(signal, "SIGQUIT", None)
+        if sigquit is None:       # pragma: no cover - non-POSIX
+            return
+        try:
+            faulthandler.register(sigquit, chain=False)
+            self._sigquit_registered = True
+        except (AttributeError, ValueError, OSError):  # pragma: no cover
+            self._sigquit_registered = False
+
     def uninstall(self):
         if self._installed:
             signal.signal(self.signum, self._prev_handler or signal.SIG_DFL)
             self._installed = False
             self._prev_handler = None
+            if getattr(self, "_sigquit_registered", False):
+                try:
+                    faulthandler.unregister(signal.SIGQUIT)
+                except (AttributeError, ValueError):  # pragma: no cover
+                    pass
+                self._sigquit_registered = False
 
     def _on_signal(self, signum, frame):
         self._flag.set()

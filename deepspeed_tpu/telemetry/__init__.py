@@ -13,6 +13,10 @@ layers, bench.py, and the ``ds_tpu_metrics`` CLI share:
   ``ds_tpu_audit --json`` so audits and telemetry join (`events.py`).
 - The synchronized timers and the trace-window profiler that moved here
   from ``utils/`` (`timers.py`, `profiler.py`).
+- The runtime-forensics layer (ISSUE 12): :class:`FlightRecorder`
+  (black-box ring + atomic crash dumps, `flight.py`),
+  :class:`HangWatchdog` / :class:`StepAnomalyDetector` (hang detection
+  + anomaly-triggered trace capture, `watchdog.py`).
 
 See docs/observability.md for the config block and event schema.
 """
@@ -20,8 +24,12 @@ See docs/observability.md for the config block and event schema.
 from deepspeed_tpu.telemetry.events import EventLog, SCHEMA_VERSION  # noqa: F401
 from deepspeed_tpu.telemetry.exporters import (  # noqa: F401
     ConsoleExporter, JsonlExporter, PrometheusTextfileExporter)
+from deepspeed_tpu.telemetry.flight import (  # noqa: F401
+    FlightRecorder, install_crash_hooks, uninstall_crash_hooks)
 from deepspeed_tpu.telemetry.profiler import (  # noqa: F401
     TraceProfiler, device_report)
+from deepspeed_tpu.telemetry.watchdog import (  # noqa: F401
+    HangWatchdog, StepAnomalyDetector)
 from deepspeed_tpu.telemetry.registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry)
 from deepspeed_tpu.telemetry.session import (  # noqa: F401
@@ -34,19 +42,24 @@ __all__ = [
     "ConsoleExporter",
     "Counter",
     "EventLog",
+    "FlightRecorder",
     "Gauge",
+    "HangWatchdog",
     "Histogram",
     "JsonlExporter",
     "MetricsRegistry",
     "PrometheusTextfileExporter",
     "SCHEMA_VERSION",
     "Span",
+    "StepAnomalyDetector",
     "SynchronizedWallClockTimer",
     "TelemetrySession",
     "ThroughputTimer",
     "TraceProfiler",
     "device_report",
     "get_default_session",
+    "install_crash_hooks",
     "null_span",
     "set_default_session",
+    "uninstall_crash_hooks",
 ]

@@ -1,6 +1,7 @@
-"""`ds_tpu_metrics`: tail / summarize / diff telemetry JSONL logs.
+"""`ds_tpu_metrics`: tail / summarize / diff / aggregate telemetry
+JSONL logs, and render flight-recorder postmortems.
 
-Three subcommands over the schema-versioned event log a run writes when
+Five subcommands over the schema-versioned event log a run writes when
 ``telemetry.jsonl_path`` is set (`telemetry/events.py`):
 
 - ``ds_tpu_metrics summary LOG`` — step count, wall time, step-time
@@ -12,9 +13,18 @@ Three subcommands over the schema-versioned event log a run writes when
 - ``ds_tpu_metrics tail LOG -n 20`` — the last N events, one line each.
 - ``ds_tpu_metrics diff A B`` — per-metric regression table between two
   runs; ``--fail-over PCT`` exits 1 when mean step time regressed more.
+- ``ds_tpu_metrics aggregate LOG...`` — merge per-host logs of ONE run
+  (events carry ``process_index``/``hostname``), print the per-step
+  cross-host skew table and the straggler ranking (mean wall excess
+  over the fastest host at each shared step).
+- ``ds_tpu_metrics postmortem DUMP`` — render a flight-recorder crash
+  dump (`telemetry/flight.py`): what fired, the watchdog's verdict,
+  every thread's in-flight phase path and stack, the last collective
+  confessions, and the event-timeline tail.
 
-Exit codes: 0 ok, 1 no step events (summary) or regression past
-``--fail-over`` (diff), 2 usage errors / unreadable files.
+Exit codes: 0 ok, 1 no step events (summary) / regression past
+``--fail-over`` (diff) / no overlapping steps (aggregate), 2 usage
+errors / unreadable files.
 
 flops/token resolution for MFU (first hit wins): ``--flops-per-token``
 flag > the run's ``compile`` event > its ``run_start`` event. Without
@@ -23,6 +33,7 @@ any, the summary reports throughput but skips MFU.
 
 import argparse
 import json
+import os
 import sys
 
 from deepspeed_tpu.telemetry.events import SCHEMA_VERSION
@@ -167,7 +178,7 @@ def _fmt_s(v):
     return f"{v * 1e3:.2f}ms" if v < 1.0 else f"{v:.3f}s"
 
 
-def print_summary(s, out=sys.stdout):
+def print_summary(s, out=None):
     print(f"run summary ({s['flavor'] or 'unknown'} flavor, schema "
           f"{s['schema']})", file=out)
     print(f"  steps {s['steps']}, wall {s['wall_s']:.3f}s, "
@@ -248,7 +259,7 @@ def diff_summaries(a, b):
     return out, step_mean_delta
 
 
-def print_diff(rows, out=sys.stdout):
+def print_diff(rows, out=None):
     print(f"{'metric':<24s} {'A':>12s} {'B':>12s} {'delta':>9s}",
           file=out)
     for r in rows:
@@ -262,7 +273,7 @@ def print_diff(rows, out=sys.stdout):
               f"{fmt(r['b']):>12s} {delta:>9s}{mark}", file=out)
 
 
-def print_tail(events, as_json, out=sys.stdout):
+def print_tail(events, as_json, out=None):
     if as_json:
         print(json.dumps(events, indent=2, default=str), file=out)
         return
@@ -274,6 +285,174 @@ def print_tail(events, as_json, out=sys.stdout):
             and isinstance(v, (str, int, float, bool)))
         print(f"{evt.get('t', 0):.3f} {evt.get('event', '?'):<16s} "
               f"{extra}", file=out)
+
+
+# ---------------------------------------------------------------------------
+# aggregate: multi-host skew + straggler ranking
+# ---------------------------------------------------------------------------
+
+def host_label(events, path):
+    """Identity of the process that wrote this log: the run_start (or any
+    step) event's hostname/process_index stamp, else the file name."""
+    for kind in ("run_start", "step"):
+        for evt in events:
+            if evt.get("event") == kind and \
+                    evt.get("process_index") is not None:
+                host = evt.get("hostname") or "host"
+                return f"{host}/p{evt['process_index']}"
+    return os.path.basename(path)
+
+
+def aggregate(logs):
+    """Merge per-host logs of one run. ``logs`` is ``[(label, events)]``;
+    returns the aggregation dict, or None when no step appears in at
+    least two logs (nothing cross-host to compare).
+
+    The straggler ranking orders hosts by mean *excess* wall — how much
+    slower than the fastest host they were, averaged over every shared
+    step — which is robust to a globally slow phase (all hosts slow
+    together shows zero excess everywhere).
+    """
+    hosts = []
+    per_step = {}
+    for label, events in logs:
+        steps = [e for e in events if e.get("event") == "step"
+                 and e.get("wall_s") is not None]
+        walls = [float(e["wall_s"]) for e in steps]
+        hosts.append({
+            "host": label,
+            "steps": len(steps),
+            "mean_wall_s": sum(walls) / len(walls) if walls else None,
+            "last_step": steps[-1].get("step") if steps else None,
+        })
+        for e in steps:
+            per_step.setdefault(int(e.get("step", -1)),
+                                {})[label] = float(e["wall_s"])
+    shared = {s: w for s, w in per_step.items() if len(w) >= 2}
+    if not shared:
+        return None
+    step_rows = []
+    excess = {h["host"]: [] for h in hosts}
+    slow_count = {h["host"]: 0 for h in hosts}
+    for s in sorted(shared):
+        walls = shared[s]
+        fastest = min(walls.values())
+        slowest = max(walls, key=walls.get)
+        step_rows.append({"step": s, "walls": walls,
+                          "skew_s": max(walls.values()) - fastest,
+                          "slowest": slowest})
+        slow_count[slowest] += 1
+        for label, w in walls.items():
+            excess[label].append(w - fastest)
+    ranking = [{"host": label,
+                "mean_excess_s": sum(ex) / len(ex),
+                "slowest_steps": slow_count[label],
+                "shared_steps": len(ex)}
+               for label, ex in excess.items() if ex]
+    ranking.sort(key=lambda r: -r["mean_excess_s"])
+    return {"schema": SCHEMA_VERSION, "hosts": hosts,
+            "steps": step_rows, "straggler_ranking": ranking}
+
+
+def print_aggregate(agg, n_steps=10, out=None):
+    print(f"cross-host aggregation ({len(agg['hosts'])} host logs, "
+          f"schema {agg['schema']})", file=out)
+    for h in agg["hosts"]:
+        mean = _fmt_s(h["mean_wall_s"])
+        print(f"  {h['host']:<24s} {h['steps']} step(s), "
+              f"mean {mean}, last step {h['last_step']}", file=out)
+    rows = agg["steps"][-max(0, n_steps):]
+    if rows:
+        print(f"  per-step skew (last {len(rows)} shared steps; "
+              f"skew = slowest - fastest wall):", file=out)
+        for r in rows:
+            walls = " ".join(f"{label}={_fmt_s(w)}"
+                             for label, w in sorted(r["walls"].items()))
+            print(f"    step {r['step']:>6d}  skew {_fmt_s(r['skew_s']):>9s}"
+                  f"  slowest {r['slowest']}  [{walls}]", file=out)
+    print("  straggler ranking (mean wall excess over the fastest host "
+          "per shared step):", file=out)
+    for i, r in enumerate(agg["straggler_ranking"], start=1):
+        print(f"    {i}. {r['host']:<24s} +{_fmt_s(r['mean_excess_s'])} "
+              f"mean excess, slowest on {r['slowest_steps']}/"
+              f"{r['shared_steps']} steps", file=out)
+    top = agg["straggler_ranking"][0] if agg["straggler_ranking"] else None
+    if top and top["mean_excess_s"] > 0:
+        print(f"  => straggler: {top['host']}", file=out)
+
+
+# ---------------------------------------------------------------------------
+# postmortem: render one flight-recorder dump
+# ---------------------------------------------------------------------------
+
+def print_postmortem(dump, n_events=15, out=None):
+    meta = dump.get("meta") or {}
+    host = meta.get("hostname", "?")
+    pidx = meta.get("process_index", "?")
+    print(f"flight-recorder postmortem ({dump.get('schema')})", file=out)
+    print(f"  reason   {dump.get('reason')}", file=out)
+    print(f"  host     {host} process {pidx}/"
+          f"{meta.get('process_count', '?')} pid {dump.get('pid')}",
+          file=out)
+    print(f"  t        {dump.get('t')}", file=out)
+    if meta:
+        facts = " ".join(f"{k}={v}" for k, v in sorted(meta.items())
+                         if k not in ("hostname", "process_index",
+                                      "process_count"))
+        if facts:
+            print(f"  run      {facts}", file=out)
+    wd = dump.get("watchdog")
+    if wd:
+        print(f"  watchdog step {wd.get('step')} stuck in "
+              f"'{wd.get('phase')}' for {wd.get('elapsed_s')}s "
+              f"(deadline {wd.get('deadline_s')}s = "
+              f"{wd.get('deadline_factor')} x median "
+              f"{wd.get('median_wall_s')}s)", file=out)
+        print(f"  verdict  {wd.get('verdict')}", file=out)
+        for s in wd.get("stragglers") or []:
+            print(f"    straggler p{s.get('process_index')} "
+                  f"({s.get('hostname')}): step {s.get('step')} "
+                  f"({s.get('behind_steps')} behind), phase "
+                  f"'{s.get('phase')}', beat {s.get('beat_age_s')}s ago",
+                  file=out)
+    exc = dump.get("exception")
+    if exc:
+        print(f"  exception {exc.get('type')}: {exc.get('message')}",
+              file=out)
+    in_flight = dump.get("in_flight_phases") or {}
+    if in_flight:
+        print("  in-flight phases:", file=out)
+        for thread, path in sorted(in_flight.items()):
+            print(f"    {thread:<24s} {path}", file=out)
+    for t in dump.get("threads") or []:
+        flag = " daemon" if t.get("daemon") else ""
+        print(f"  thread {t.get('name')}{flag}:", file=out)
+        for line in (t.get("stack") or [])[-8:]:
+            for part in line.splitlines():
+                print(f"    {part}", file=out)
+    colls = dump.get("collectives") or []
+    if colls:
+        print(f"  collectives traced into the step "
+              f"({len(colls)} site(s)):", file=out)
+        for c in colls[:20]:
+            print(f"    {c.get('site'):<28s} axis={c.get('axis')} "
+                  f"{c.get('primitive')} chunks={c.get('chunks')} "
+                  f"hops={c.get('hops')} chained={c.get('chained')}",
+                  file=out)
+    events = dump.get("events") or []
+    tail = events[-max(0, n_events):]
+    if tail:
+        print(f"  timeline tail (last {len(tail)} of {len(events)} "
+              f"events):", file=out)
+        print_tail(tail, False, out=out)
+    phases = dump.get("phase_log") or []
+    if phases:
+        print(f"  last phase transitions:", file=out)
+        for p in phases[-10:]:
+            dur = f" ({p['duration_s'] * 1e3:.2f}ms)" \
+                if p.get("duration_s") is not None else ""
+            print(f"    {p.get('t', 0):.3f} {p.get('kind'):<6s}"
+                  f"{p.get('path')}{dur}", file=out)
 
 
 def _load(parser, path):
@@ -322,9 +501,26 @@ def main(argv=None):
                         help="exit 1 when mean step time regressed by "
                              "more than PCT percent")
 
+    p_agg = sub.add_parser(
+        "aggregate",
+        help="merge per-host logs: cross-host skew + straggler ranking")
+    p_agg.add_argument("logs", nargs="+",
+                       help="one telemetry JSONL log per host/process")
+    p_agg.add_argument("-n", type=int, default=10,
+                       help="shared steps shown in the skew table")
+    p_agg.add_argument("--json", action="store_true", dest="as_json")
+
+    p_pm = sub.add_parser(
+        "postmortem", help="render a flight-recorder crash dump")
+    p_pm.add_argument("dump", help="flight-*.json dump file")
+    p_pm.add_argument("-n", type=int, default=15,
+                      help="events shown in the timeline tail")
+    p_pm.add_argument("--json", action="store_true", dest="as_json")
+
     args = parser.parse_args(argv)
     if args.cmd is None:
-        parser.error("a subcommand is required: summary, tail, or diff")
+        parser.error("a subcommand is required: summary, tail, diff, "
+                     "aggregate, or postmortem")
 
     if args.cmd == "summary":
         s = summarize(_load(parser, args.log),
@@ -344,6 +540,34 @@ def main(argv=None):
         if args.event:
             events = [e for e in events if e.get("event") == args.event]
         print_tail(events[-max(0, args.n):], args.as_json)
+        return 0
+
+    if args.cmd == "aggregate":
+        logs = []
+        for path in args.logs:
+            events = _load(parser, path)
+            logs.append((host_label(events, path), events))
+        agg = aggregate(logs)
+        if agg is None:
+            print("no step appears in two or more logs — nothing "
+                  "cross-host to compare", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps(agg, indent=2, sort_keys=True))
+        else:
+            print_aggregate(agg, n_steps=args.n)
+        return 0
+
+    if args.cmd == "postmortem":
+        from deepspeed_tpu.telemetry.flight import read_dump
+        try:
+            dump = read_dump(args.dump)
+        except (OSError, ValueError) as exc:
+            parser.error(f"cannot read dump: {exc}")
+        if args.as_json:
+            print(json.dumps(dump, indent=2, sort_keys=True, default=str))
+        else:
+            print_postmortem(dump, n_events=args.n)
         return 0
 
     # diff

@@ -26,6 +26,7 @@ warning instead of propagating.
 """
 
 import collections
+import threading
 import time
 
 from deepspeed_tpu.utils.logging import logger
@@ -34,27 +35,34 @@ SCHEMA_VERSION = "ds-tpu-telemetry/1"
 
 
 class EventLog:
-    """Bounded ring of events + exporter fan-out."""
+    """Bounded ring of events + exporter fan-out.
+
+    ``emit`` serializes under a lock: the hang watchdog emits its
+    ``watchdog`` event from its own daemon thread, and interleaved
+    exporter writes would corrupt the JSONL line stream.
+    """
 
     def __init__(self, exporters=(), history=256):
         self.exporters = list(exporters)
         self._ring = collections.deque(maxlen=int(history))
         self._dead = set()
+        self._lock = threading.Lock()
 
     def emit(self, event, **fields):
         evt = {"schema": SCHEMA_VERSION, "event": event, "t": time.time()}
         evt.update(fields)
-        self._ring.append(evt)
-        for ex in self.exporters:
-            if id(ex) in self._dead:
-                continue
-            try:
-                ex.export(evt)
-            except Exception as e:
-                self._dead.add(id(ex))
-                logger.warning(
-                    f"telemetry: exporter {type(ex).__name__} failed "
-                    f"({e}); disabling it for the rest of the run")
+        with self._lock:
+            self._ring.append(evt)
+            for ex in self.exporters:
+                if id(ex) in self._dead:
+                    continue
+                try:
+                    ex.export(evt)
+                except Exception as e:
+                    self._dead.add(id(ex))
+                    logger.warning(
+                        f"telemetry: exporter {type(ex).__name__} failed "
+                        f"({e}); disabling it for the rest of the run")
         return evt
 
     def recent(self, n=None, event=None):

@@ -19,14 +19,27 @@ rewrites the textfile atomically (tmp + rename, the collector contract)
 every ``write_every`` events and on close.
 """
 
+import atexit
 import json
 import os
 import sys
 
+# Events worth an fsync: the ones a postmortem needs to out-survive the
+# process that wrote them. Everything else gets flush-per-line only.
+DURABLE_EVENTS = frozenset({
+    "run_start", "health_guard", "recompile", "preemption", "watchdog",
+    "anomaly",
+})
+
 
 class JsonlExporter:
     """Append one JSON line per event; flushed per write so ``tail -f``
-    and a mid-run ``ds_tpu_metrics summary`` always see whole lines."""
+    and a mid-run ``ds_tpu_metrics summary`` always see whole lines.
+
+    The tail of a crashed run must not die in buffers: the first open
+    registers an atexit close, and :data:`DURABLE_EVENTS` (guard trips,
+    recompiles, preemption, watchdog/anomaly firings) additionally
+    ``fsync`` so they reach disk even if the process is killed next."""
 
     def __init__(self, path):
         self.path = str(path)
@@ -38,8 +51,11 @@ class JsonlExporter:
             if d:
                 os.makedirs(d, exist_ok=True)
             self._f = open(self.path, "a")
+            atexit.register(self.close)
         self._f.write(json.dumps(event, default=str) + "\n")
         self._f.flush()
+        if event.get("event") in DURABLE_EVENTS:
+            os.fsync(self._f.fileno())
 
     def close(self):
         if self._f is not None:

@@ -48,11 +48,33 @@ class TraceProfiler:
         self.start_step = int(trace_start_step)
         self.num_steps = int(trace_num_steps)
         self._active = False
+        self.armed_reason = None
         self.step_times = collections.deque(maxlen=history)
 
     @property
     def enabled(self):
         return self.trace_dir is not None and self.num_steps > 0
+
+    def arm(self, start_step, num_steps, trace_dir=None, reason=None):
+        """(Re-)point the capture window at a future step — the
+        anomaly-triggered capture path (step-wall regression, recompile,
+        guard trip arm the *next* ``num_steps`` steps). Re-arming after
+        a window closed is supported; an in-flight window is never
+        disturbed. Returns True when armed."""
+        if self._active:
+            return False
+        if trace_dir is not None:
+            self.trace_dir = str(trace_dir)
+        if self.trace_dir is None or int(num_steps) <= 0:
+            return False
+        self.start_step = int(start_step)
+        self.num_steps = int(num_steps)
+        self.armed_reason = reason
+        log_dist(f"profiler: armed {self.num_steps}-step trace window at "
+                 f"step {self.start_step}"
+                 f"{f' ({reason})' if reason else ''} -> {self.trace_dir}",
+                 ranks=[0])
+        return True
 
     def in_window(self, global_step):
         """True only for steps inside the trace window — the engine syncs
@@ -66,10 +88,13 @@ class TraceProfiler:
         if not self.enabled or self._active:
             return
         if self.in_window(global_step):
+            import atexit
             import jax
 
             jax.profiler.start_trace(self.trace_dir)
             self._active = True
+            # a window past the end of the run still flushes xprof files
+            atexit.register(self.close)
             log_dist(f"profiler: trace started at step {global_step} "
                      f"-> {self.trace_dir}", ranks=[0])
 

@@ -8,6 +8,13 @@ bench.py) reach the *process-default* session via
 Span durations accumulate per phase name between ``drain_phases()``
 calls — the engine drains once per step and stamps the result into that
 step's event, so nested/repeated spans within a step sum correctly.
+
+The session is also the forensics hub: when the config enables them it
+owns a :class:`~deepspeed_tpu.telemetry.flight.FlightRecorder` (which
+rides the exporter fan-out and additionally receives every span
+transition) and a
+:class:`~deepspeed_tpu.telemetry.watchdog.HangWatchdog` (which every
+span entry/exit feeds as a heartbeat).
 """
 
 from deepspeed_tpu.telemetry.events import EventLog
@@ -33,15 +40,28 @@ def set_default_session(session, replace=True):
 
 
 class TelemetrySession:
-    def __init__(self, registry=None, exporters=(), history=256):
+    def __init__(self, registry=None, exporters=(), history=256,
+                 flight=None, watchdog=None):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
+        self.flight = flight
+        self.watchdog = watchdog
+        if flight is not None:
+            exporters = list(exporters) + [flight]
         self.events = EventLog(exporters=exporters, history=history)
+        if watchdog is not None and watchdog.session is None:
+            watchdog.session = self
         self._phases = {}
 
     @classmethod
-    def from_config(cls, tcfg):
-        """Build a session from a validated ``TelemetryConfig``."""
+    def from_config(cls, tcfg, meta=None):
+        """Build a session from a validated ``TelemetryConfig``.
+
+        ``meta`` (process identity + run facts from the engine) is
+        stamped into the flight recorder's dumps and names the
+        watchdog's heartbeat file; forensics pieces only exist when
+        the config enables them.
+        """
         from deepspeed_tpu.telemetry.exporters import (
             ConsoleExporter, JsonlExporter, PrometheusTextfileExporter)
         registry = MetricsRegistry()
@@ -54,18 +74,47 @@ class TelemetrySession:
             exporters.append(PrometheusTextfileExporter(
                 tcfg.prometheus_textfile, registry,
                 write_every=tcfg.prometheus_write_every))
+        meta = dict(meta or {})
+        flight = watchdog = None
+        if tcfg.crash_dump_dir:
+            from deepspeed_tpu.telemetry.flight import FlightRecorder
+            flight = FlightRecorder(tcfg.crash_dump_dir,
+                                    history=tcfg.flight_history, meta=meta)
+        if tcfg.watchdog_enabled:
+            from deepspeed_tpu.telemetry.watchdog import HangWatchdog
+            watchdog = HangWatchdog(
+                flight=flight,
+                deadline_factor=tcfg.watchdog_deadline_factor,
+                min_deadline_s=tcfg.watchdog_min_deadline_s,
+                action=tcfg.watchdog_action,
+                heartbeat_dir=tcfg.crash_dump_dir,
+                process_index=meta.get("process_index", 0),
+                process_count=meta.get("process_count", 1),
+                hostname=meta.get("hostname"))
         return cls(registry=registry, exporters=exporters,
-                   history=tcfg.history)
+                   history=tcfg.history, flight=flight, watchdog=watchdog)
 
     # -- spans ---------------------------------------------------------
     def span(self, name):
         return Span(name, self)
+
+    def _enter_phase(self, name, path):
+        wd = self.watchdog
+        if wd is not None:
+            wd.beat(path)
+        if self.flight is not None:
+            self.flight.record_phase("enter", path)
 
     def _record_phase(self, name, path, duration_s):
         self._phases[name] = self._phases.get(name, 0.0) + duration_s
         self.registry.histogram(
             "phase_seconds", labels={"phase": name},
             help="host wall seconds per step phase").observe(duration_s)
+        wd = self.watchdog
+        if wd is not None:
+            wd.beat(path)
+        if self.flight is not None:
+            self.flight.record_phase("exit", path, duration_s)
 
     def drain_phases(self):
         """Per-phase seconds accumulated since the last drain (one step's
@@ -96,4 +145,6 @@ class TelemetrySession:
         return self.emit("step", **fields)
 
     def close(self):
+        if self.watchdog is not None:
+            self.watchdog.stop()
         self.events.close()

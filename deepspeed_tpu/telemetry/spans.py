@@ -16,6 +16,13 @@ The disabled fast path is :func:`null_span`: a module-level singleton
 whose ``__enter__``/``__exit__`` do nothing, so an engine with telemetry
 off pays one attribute check + one no-op context manager per phase
 (pinned by the overhead micro-benchmark test).
+
+Every thread's span stack is also registered in a process-global map so
+the forensics layer (`telemetry/flight.py`, `telemetry/watchdog.py`)
+can read *other* threads' in-flight phase paths — thread-locals are
+invisible cross-thread, and "which phase is the main thread stuck in"
+is exactly what a hang dump must answer. :func:`live_phase_paths`
+snapshots that map.
 """
 
 import threading
@@ -27,13 +34,34 @@ except Exception:                        # jax-less tools (the CLI).
     TraceAnnotation = None
 
 _local = threading.local()
+# thread ident -> that thread's live span stack (the same list object
+# _local.stack aliases); entries for exited threads are pruned on read
+_live_stacks = {}
 
 
 def _stack():
     stack = getattr(_local, "stack", None)
     if stack is None:
         stack = _local.stack = []
+        _live_stacks[threading.get_ident()] = stack
     return stack
+
+
+def live_phase_paths():
+    """``{thread_ident: "a/b" in-flight span path}`` for every thread
+    currently inside at least one span. Reads are lock-free snapshots:
+    a concurrently-mutating stack at worst yields a one-frame-stale
+    path, which is fine for forensics."""
+    live = {t.ident for t in threading.enumerate()}
+    out = {}
+    for ident, stack in list(_live_stacks.items()):
+        if ident not in live:
+            _live_stacks.pop(ident, None)
+            continue
+        path = "/".join(stack)
+        if path:
+            out[ident] = path
+    return out
 
 
 class Span:
@@ -57,6 +85,8 @@ class Span:
         if TraceAnnotation is not None:
             self._annotation = TraceAnnotation(f"ds_tpu/{self.path}")
             self._annotation.__enter__()
+        if self._session is not None:
+            self._session._enter_phase(self.name, self.path)
         self._t0 = time.perf_counter()
         return self
 

@@ -43,6 +43,67 @@ def test_engine_captures_trace_window(tmp_path):
     assert 0 < min_s <= mean_s <= max_s
 
 
+def test_engine_trace_window_starting_at_step_zero(tmp_path):
+    # start_step=0 means the very first (compile) step is traced — the
+    # window must open before any step has completed
+    trace_dir = str(tmp_path / "trace")
+    cfg = base_config(
+        profiling={"trace_dir": trace_dir, "trace_start_step": 0,
+                   "trace_num_steps": 1},
+    )
+    params = simple_init_params(jax.random.PRNGKey(0), hidden_dim=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, loss_fn=simple_loss_fn, params=params)
+    batch = random_batch(16, hidden_dim=16)
+    for _ in range(2):
+        engine.train_batch(batch)
+    assert not engine.trace_profiler._active   # window closed after step 0
+    found = [f for _, _, fs in os.walk(trace_dir) for f in fs]
+    assert any("xplane" in f or "trace" in f for f in found), found
+
+
+def test_trace_window_past_end_of_run_still_flushes(tmp_path):
+    # a 5-step window on a 2-step run: the run ends mid-window, so the
+    # trace is still active and close() (the atexit path) must flush it
+    trace_dir = str(tmp_path / "trace")
+    p = TraceProfiler(trace_dir=trace_dir, trace_start_step=0,
+                      trace_num_steps=5)
+    p.before_step(0)
+    p.after_step(0, 0.01)
+    p.before_step(1)
+    p.after_step(1, 0.01)
+    assert p._active                           # run over, window not
+    p.close()
+    assert not p._active
+    found = [f for _, _, fs in os.walk(trace_dir) for f in fs]
+    assert any("xplane" in f or "trace" in f for f in found), found
+    p.close()                                  # idempotent: atexit re-entry
+
+
+def test_rearm_second_trace_window_in_one_process(tmp_path):
+    first = str(tmp_path / "first")
+    second = str(tmp_path / "second")
+    p = TraceProfiler(trace_dir=first, trace_start_step=0,
+                      trace_num_steps=1)
+    p.before_step(0)
+    assert not p.arm(1, 1)                     # in-flight window undisturbed
+    p.after_step(0, 0.01)                      # window closes itself
+    assert not p._active
+    # re-arming after a closed window targets a fresh dir
+    assert p.arm(2, 1, trace_dir=second, reason="recompile storm")
+    assert p.armed_reason == "recompile storm"
+    p.before_step(1)
+    assert not p._active                       # step 1 is outside the window
+    p.before_step(2)
+    p.after_step(2, 0.01)
+    for d in (first, second):
+        found = [f for _, _, fs in os.walk(d) for f in fs]
+        assert any("xplane" in f or "trace" in f for f in found), (d, found)
+    # arming with no trace_dir anywhere is a no-op
+    assert not TraceProfiler().arm(0, 1)
+    assert not p.arm(3, 0)                     # zero-length window
+
+
 def test_device_report_prints_topology():
     buf = io.StringIO()
     device_report(out=buf)
