@@ -340,6 +340,21 @@ class ResilienceConfig:
             fi, RESILIENCE_FAULT_INJECTION_ENABLED,
             RESILIENCE_FAULT_INJECTION_ENABLED_DEFAULT)
 
+        hot = sub.get(RESILIENCE_HOT_CHECKPOINT, {}) or {}
+        self.hot_enabled = get_scalar_param(
+            hot, RESILIENCE_HOT_ENABLED, RESILIENCE_HOT_ENABLED_DEFAULT)
+        self.hot_interval_steps = get_scalar_param(
+            hot, RESILIENCE_HOT_INTERVAL_STEPS,
+            RESILIENCE_HOT_INTERVAL_STEPS_DEFAULT)
+        self.hot_capacity = get_scalar_param(
+            hot, RESILIENCE_HOT_CAPACITY, RESILIENCE_HOT_CAPACITY_DEFAULT)
+        self.hot_mirror_dir = get_scalar_param(
+            hot, RESILIENCE_HOT_MIRROR_DIR,
+            RESILIENCE_HOT_MIRROR_DIR_DEFAULT)
+        self.hot_mirror_keep = get_scalar_param(
+            hot, RESILIENCE_HOT_MIRROR_KEEP,
+            RESILIENCE_HOT_MIRROR_KEEP_DEFAULT)
+
         self.host_adam_retries = get_scalar_param(
             sub, RESILIENCE_HOST_ADAM_RETRIES,
             RESILIENCE_HOST_ADAM_RETRIES_DEFAULT)
@@ -1254,6 +1269,20 @@ class DeepSpeedConfig:
             raise ValueError(
                 f"resilience: guards.scale_collapse.patience must be >= 1, "
                 f"got {rz.scale_collapse_patience}")
+        if rz.hot_enabled:
+            if rz.hot_interval_steps < 1:
+                raise ValueError(
+                    f"resilience: hot_checkpoint.interval_steps must be "
+                    f">= 1 when the tier is enabled, "
+                    f"got {rz.hot_interval_steps}")
+            if rz.hot_capacity < 1:
+                raise ValueError(
+                    f"resilience: hot_checkpoint.capacity must be >= 1, "
+                    f"got {rz.hot_capacity}")
+            if rz.hot_mirror_keep < 1:
+                raise ValueError(
+                    f"resilience: hot_checkpoint.mirror_keep must be "
+                    f">= 1, got {rz.hot_mirror_keep}")
 
     def _do_warning_check(self):
         fp16_enabled = self.fp16_enabled

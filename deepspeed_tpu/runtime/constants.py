@@ -259,6 +259,21 @@ RESILIENCE_FAULT_INJECTION = "fault_injection"
 RESILIENCE_FAULT_INJECTION_ENABLED = "enabled"
 RESILIENCE_FAULT_INJECTION_ENABLED_DEFAULT = False
 
+# In-memory hot-checkpoint tier (runtime/resilience/hotckpt.py):
+# frequent CRC-stamped device->host snapshots the restore ladder tries
+# before any disk checkpoint. interval_steps = 0 disables the tier.
+RESILIENCE_HOT_CHECKPOINT = "hot_checkpoint"
+RESILIENCE_HOT_ENABLED = "enabled"
+RESILIENCE_HOT_ENABLED_DEFAULT = False
+RESILIENCE_HOT_INTERVAL_STEPS = "interval_steps"
+RESILIENCE_HOT_INTERVAL_STEPS_DEFAULT = 1
+RESILIENCE_HOT_CAPACITY = "capacity"
+RESILIENCE_HOT_CAPACITY_DEFAULT = 1
+RESILIENCE_HOT_MIRROR_DIR = "mirror_dir"
+RESILIENCE_HOT_MIRROR_DIR_DEFAULT = None  # None = RAM-only tier
+RESILIENCE_HOT_MIRROR_KEEP = "mirror_keep"
+RESILIENCE_HOT_MIRROR_KEEP_DEFAULT = 1
+
 RESILIENCE_HOST_ADAM_RETRIES = "host_adam_retries"
 RESILIENCE_HOST_ADAM_RETRIES_DEFAULT = 2
 

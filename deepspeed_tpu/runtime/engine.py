@@ -62,6 +62,10 @@ from deepspeed_tpu.runtime.elastic import (
 from deepspeed_tpu.runtime.elastic.topology import spec_to_json
 from deepspeed_tpu.runtime.resilience import fault_injection
 from deepspeed_tpu.runtime.resilience.checkpoint import CheckpointManager
+from deepspeed_tpu.runtime.resilience.hotckpt import (
+    HotCheckpointCorruptError,
+    HotCheckpointStore,
+)
 from deepspeed_tpu.runtime.resilience.guards import (
     ACTION_ABORT, ACTION_ROLLBACK, ACTION_SKIP_STEP,
     HealthGuardAbort, StepHealthMonitor)
@@ -617,6 +621,15 @@ class DeepSpeedEngine:
             io_retries=rz.io_retries,
             io_retry_base_s=rz.io_retry_base_s,
             io_timeout_s=rz.io_timeout_s)
+        # In-memory hot-checkpoint tier (runtime/resilience/hotckpt.py):
+        # the restore ladder's first stop, ahead of any disk checkpoint.
+        self._hot_store = None
+        if rz.hot_enabled:
+            self._hot_store = HotCheckpointStore(
+                capacity=rz.hot_capacity,
+                mirror_dir=rz.hot_mirror_dir,
+                mirror_keep=rz.hot_mirror_keep,
+                process_index=jax.process_index())
         self._health_monitor = None
         if rz.guards_enabled:
             self._health_monitor = StepHealthMonitor(
@@ -1264,7 +1277,24 @@ class DeepSpeedEngine:
         the aborted run's black box must out-survive the raise."""
         if trip.action == ACTION_ROLLBACK:
             rz = self._config.resilience
-            path, _ = self.load_checkpoint(rz.save_dir)
+            path = None
+            # The hot RAM tier serves in-process rollbacks in seconds —
+            # no disk read, no replay of the disk save interval. A
+            # corrupt/mismatched snapshot falls through to disk.
+            if self._hot_store is not None:
+                t0 = time.perf_counter()
+                try:
+                    got = self._hot_store.restore()
+                except HotCheckpointCorruptError as e:
+                    logger.warning("rollback: hot RAM snapshot rejected: "
+                                   "%s", e)
+                    got = None
+                if got is not None and self._install_hot_restore(
+                        got, "hot_ram"):
+                    path = "<hot_ram>"
+                    self._emit_recovery("hot_ram", "<ram>", t0)
+            if path is None:
+                path, _ = self.load_checkpoint(rz.save_dir)
             if path is None:
                 self._dump_flight(f"guard_abort:{trip.guard}",
                                   extra={"guard_trip": trip.as_event()})
@@ -2557,6 +2587,9 @@ class DeepSpeedEngine:
             # seam (probe is armed-only, and only with fault injection
             # configured on).
             if self._config.resilience.fault_injection:
+                # Hard process death inside the step — the supervisor
+                # soak seam. For SIGKILL this call never returns.
+                fault_injection.maybe_kill("step", self.global_steps)
                 hang_s = fault_injection.hang_seconds(self.global_steps)
                 if hang_s > 0.0:
                     with span("injected_hang"):
@@ -2707,6 +2740,9 @@ class DeepSpeedEngine:
                 self._apply_guard_trip(trip)
 
         rz = self._config.resilience
+        if self._hot_store is not None and \
+                self.global_steps % rz.hot_interval_steps == 0:
+            self._hot_snapshot()
         if rz.save_interval_steps and rz.save_dir and \
                 self.global_steps % rz.save_interval_steps == 0:
             self.save_checkpoint(rz.save_dir)
@@ -3121,7 +3157,30 @@ class DeepSpeedEngine:
         # trips orbax's "unsafe when restoring on a different topology"
         # path, which is exactly the elastic/restage case we support.
         restored, meta, path = self._ckpt_manager.load(load_dir, resolved)
+        self._install_restored_state(
+            restored, meta,
+            load_optimizer_states=load_optimizer_states,
+            load_lr_scheduler_states=load_lr_scheduler_states)
+        log_dist(f"loaded checkpoint {path} (saved at dp="
+                 f"{meta.get('dp_world_size')}, now dp={self.dp_world_size})",
+                 ranks=[0])
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "checkpoint_load", step=self.global_steps, path=str(path),
+                duration_s=round(time.perf_counter() - load_t0, 6),
+                topology=check.kind,
+                saved_dp_world_size=meta.get("dp_world_size"),
+                dp_world_size=self.dp_world_size)
+        return path, meta.get("client_state", {})
 
+    def _install_restored_state(self, restored, meta,
+                                load_optimizer_states=True,
+                                load_lr_scheduler_states=True):
+        """Install a restored host-numpy state tree + meta sidecar into
+        this engine, re-placing every leaf on the *current*
+        mesh/shardings. Shared by the disk path (``load_checkpoint``)
+        and the hot tier's RAM/mirror restores — the tiers differ only
+        in where the bytes come from."""
         # Re-place on the *current* mesh/shardings: the elastic-checkpoint
         # capability (reference stage1.py:1030 re-partitions for a new dp
         # world size) comes for free from resharding on load.
@@ -3202,26 +3261,93 @@ class DeepSpeedEngine:
         if self._health_monitor is not None:
             # Pre-restore loss history would poison the spike detector.
             self._health_monitor.reset_history()
-        log_dist(f"loaded checkpoint {path} (saved at dp="
-                 f"{meta.get('dp_world_size')}, now dp={self.dp_world_size})",
-                 ranks=[0])
+
+    def _hot_snapshot(self):
+        """One in-RAM hot snapshot (async CRC stamp + optional mirror)."""
+        t0 = time.perf_counter()
+        tag = f"step{self.global_steps}"
+        self._hot_store.snapshot(tag, self._checkpoint_state_tree(),
+                                 self._checkpoint_meta(None),
+                                 topology=self._topology())
         if self.telemetry is not None:
             self.telemetry.emit(
-                "checkpoint_load", step=self.global_steps, path=str(path),
-                duration_s=round(time.perf_counter() - load_t0, 6),
-                topology=check.kind,
-                saved_dp_world_size=meta.get("dp_world_size"),
-                dp_world_size=self.dp_world_size)
-        return path, meta.get("client_state", {})
+                "hot_snapshot", step=self.global_steps, tag=tag,
+                mirrored=bool(self._hot_store.mirror_dir),
+                duration_s=round(time.perf_counter() - t0, 6))
+
+    def _install_hot_restore(self, got, tier):
+        """Install a hot-tier ``(state, meta, topology)`` triple; False
+        when the snapshot's topology fingerprint no longer matches (a
+        restart onto a different mesh must fall through to the disk
+        tier, whose elastic reshard-on-load can absorb the change)."""
+        state, meta, topology = got
+        try:
+            check = check_topology(topology, self._topology(),
+                                   elastic=False)
+        except Exception as e:
+            logger.warning("hot restore (%s): topology check failed "
+                           "(%s); falling through", tier, e)
+            return False
+        if check.kind != "same":
+            logger.warning(
+                "hot restore (%s): snapshot topology %s does not match "
+                "the current mesh; falling through to disk", tier,
+                check.changed if hasattr(check, "changed") else check.kind)
+            return False
+        self._install_restored_state(state, meta)
+        return True
+
+    def _emit_recovery(self, tier, source, t0, error=None):
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "recovery_ladder", tier=tier, source=source,
+                step=self.global_steps,
+                duration_s=round(time.perf_counter() - t0, 6),
+                error=error)
 
     def _auto_resume(self):
-        """Resume from the newest valid checkpoint in resilience.save_dir.
-
-        Returns the loaded path, or None when the directory holds nothing
-        loadable (fresh start)."""
+        """Resume through the recovery ladder: hot RAM → hot mirror →
+        newest valid disk checkpoint (→ older disk, inside
+        ``resolve_tag``). Returns a description of what was loaded, or
+        None when nothing is loadable (fresh start). Each successful
+        rung emits a ``recovery_ladder`` event naming the tier, so
+        ``ds_tpu_metrics summary`` shows which tier actually served the
+        restart."""
         rz = self._config.resilience
+        t0 = time.perf_counter()
+        if self._hot_store is not None:
+            # Tier 1: hot RAM — survives in-process restarts only (a
+            # fresh process starts with an empty store).
+            try:
+                got = self._hot_store.restore()
+            except HotCheckpointCorruptError as e:
+                logger.warning("hot RAM restore rejected: %s", e)
+                got = None
+            if got is not None and self._install_hot_restore(got,
+                                                             "hot_ram"):
+                self._emit_recovery("hot_ram", "<ram>", t0)
+                return "<hot_ram>"
+            # Tier 2: hot mirror on local disk — the fast path for a
+            # restarted process. Snapshot leaves are keyed by path; the
+            # fresh-init state tree supplies the structure.
+            if rz.hot_mirror_dir and os.path.isdir(rz.hot_mirror_dir):
+                try:
+                    got = HotCheckpointStore.load_mirror(
+                        rz.hot_mirror_dir, self._checkpoint_state_tree())
+                except HotCheckpointCorruptError as e:
+                    logger.warning("hot mirror restore rejected: %s", e)
+                    got = None
+                if got is not None and self._install_hot_restore(
+                        got, "hot_mirror"):
+                    self._emit_recovery("hot_mirror", rz.hot_mirror_dir,
+                                        t0)
+                    return f"<hot_mirror:{rz.hot_mirror_dir}>"
+        # Tier 3: durable disk checkpoints (resolve_tag already scans
+        # past a corrupt newest one, emitting checkpoint_fallback).
         tag = self._ckpt_manager.resolve_tag(rz.save_dir, None)
         if tag is None:
             return None
         path, _ = self.load_checkpoint(rz.save_dir)
+        if path is not None:
+            self._emit_recovery("disk", str(path), t0)
         return path

@@ -13,9 +13,12 @@ slice needs to survive long runs:
   circuit breaker, loss-scale collapse) with configurable actions
   (``warn | skip_step | rollback_to_checkpoint | abort``).
 - :mod:`preemption` — SIGTERM-driven save-and-exit between steps.
+- :mod:`hotckpt` — the in-memory hot-checkpoint tier: frequent CRC-
+  stamped device→host snapshots (optionally mirrored to local disk)
+  that the engine's restore ladder tries before any disk checkpoint.
 - :mod:`fault_injection` — deterministic fault hooks (NaN grads,
-  mid-write I/O failures, simulated preemption, host-Adam worker
-  exceptions) for testing failure behavior.
+  mid-write I/O failures, simulated preemption, hangs, hard SIGKILLs,
+  host-Adam worker exceptions) for testing failure behavior.
 - :mod:`retry` — bounded retry-with-backoff used by checkpoint I/O and
   the offload host-Adam futures.
 """
@@ -24,6 +27,11 @@ from deepspeed_tpu.runtime.resilience.checkpoint import (
     CheckpointCorruptError,
     CheckpointIOError,
     CheckpointManager,
+)
+from deepspeed_tpu.runtime.resilience.hotckpt import (
+    HotCheckpointCorruptError,
+    HotCheckpointStore,
+    HotSnapshot,
 )
 from deepspeed_tpu.runtime.resilience.guards import (
     ACTION_ABORT,
@@ -49,6 +57,9 @@ __all__ = [
     "CheckpointCorruptError",
     "CheckpointIOError",
     "CheckpointManager",
+    "HotCheckpointCorruptError",
+    "HotCheckpointStore",
+    "HotSnapshot",
     "ACTION_ABORT",
     "ACTION_ROLLBACK",
     "ACTION_SKIP_STEP",

@@ -39,6 +39,7 @@ import json
 import logging
 import os
 import shutil
+import time
 import zlib
 from concurrent.futures import ThreadPoolExecutor
 
@@ -64,6 +65,33 @@ TMP_PREFIX = ".tmp."
 # PartitionSpec). v1 checkpoints stay loadable — readers treat the
 # sections as optional.
 MANIFEST_VERSION = 2
+# Tmp dirs younger than this survive retention GC: on a shared
+# filesystem a ``.tmp.<tag>`` dir that is not ours may be another
+# process's *in-flight* async save, and deleting it from under that
+# writer corrupts the checkpoint it is about to publish. A crashed
+# attempt's leftover goes quiet, ages past the grace window, and is
+# collected on a later save.
+TMP_GC_GRACE_S = 900.0
+
+
+def _newest_mtime(root):
+    """Newest mtime anywhere under ``root`` (the dir itself, nested dirs,
+    files). A writer touching any file keeps the whole tree "recent" —
+    the top-level dir mtime alone misses writes inside orbax's nested
+    state/ layout."""
+    newest = 0.0
+    try:
+        newest = os.path.getmtime(root)
+        for dirpath, _, filenames in os.walk(root):
+            newest = max(newest, os.path.getmtime(dirpath))
+            for name in filenames:
+                newest = max(newest,
+                             os.path.getmtime(os.path.join(dirpath, name)))
+    except OSError:
+        # Entries vanishing mid-walk mean someone is actively mutating
+        # the tree — treat it as freshly written.
+        return time.time()
+    return newest
 
 
 class CheckpointIOError(RuntimeError):
@@ -113,19 +141,22 @@ def _file_inventory(root, skip={MANIFEST_NAME}):
 class CheckpointManager:
     def __init__(self, save_dir=None, keep_last_n=0, async_save=False,
                  io_retries=3, io_retry_base_s=0.05, io_timeout_s=None,
-                 process_index=None, process_count=None):
+                 process_index=None, process_count=None,
+                 tmp_gc_grace_s=TMP_GC_GRACE_S):
         self.save_dir = os.path.abspath(save_dir) if save_dir else None
         self.keep_last_n = int(keep_last_n)
         self.async_save = bool(async_save)
         self.io_retries = int(io_retries)
         self.io_retry_base_s = float(io_retry_base_s)
         self.io_timeout_s = io_timeout_s
+        self.tmp_gc_grace_s = float(tmp_gc_grace_s)
         self._pi = jax.process_index() if process_index is None \
             else process_index
         self._pc = jax.process_count() if process_count is None \
             else process_count
         self._pool = None
         self._pending = None
+        self._active_tmp = None
 
     # ------------------------------------------------------------------
     # paths
@@ -203,12 +234,14 @@ class CheckpointManager:
             if os.path.isdir(tmp):
                 shutil.rmtree(tmp)
             os.makedirs(tmp, exist_ok=True)
+            self._active_tmp = tmp
             import orbax.checkpoint as ocp
             ocp.PyTreeCheckpointer().save(
                 os.path.join(tmp, STATE_SUBDIR), state, force=True)
             # Worst-case interrupt point for the harness: state is on
             # disk but the checkpoint is not yet valid or published.
             fault_injection.maybe_fail_io(fault_op)
+            fault_injection.maybe_kill("checkpoint_save")
             if self._pi == 0:
                 with open(os.path.join(tmp, META_NAME), "w") as f:
                     json.dump(meta, f)
@@ -229,7 +262,10 @@ class CheckpointManager:
                     shutil.rmtree(final)
                 os.rename(tmp, final)
 
-        self._retry(write, what=f"checkpoint save {final}")
+        try:
+            self._retry(write, what=f"checkpoint save {final}")
+        finally:
+            self._active_tmp = None
         if save_latest and self._pi == 0:
             self._retry(lambda: self._write_latest(save_dir, tag),
                         what=f"latest pointer {save_dir}")
@@ -324,26 +360,68 @@ class CheckpointManager:
         An explicit ``tag`` is strict (its checkpoint must validate —
         the caller asked for *that* one). ``tag=None`` tries the
         ``latest`` pointer first, then falls back to scanning for the
-        newest checkpoint that passes validation.
+        newest checkpoint that passes validation. Falling back past one
+        or more corrupt/incomplete checkpoints emits a durable
+        ``checkpoint_fallback`` telemetry event recording which tags
+        were skipped and why — silently resuming from an older step is
+        exactly the kind of surprise postmortems need to see.
         """
         load_dir = os.path.abspath(load_dir)
         if tag is not None:
             self.validate(self.ckpt_path(load_dir, tag))
             return str(tag)
+        skipped = []
+        tried = set()
+
+        def usable(name, path):
+            if name in tried:
+                return False
+            tried.add(name)
+            try:
+                self.validate(path)
+                return True
+            except CheckpointCorruptError as e:
+                logger.warning("skipping invalid checkpoint: %s", e)
+                skipped.append({"tag": str(name),
+                                "error": type(e).__name__,
+                                "reason": str(e.reason)})
+                return False
+
+        resolved = None
+        pointed = None
         latest = os.path.join(load_dir, LATEST_NAME)
         if os.path.isfile(latest):
             with open(latest) as f:
                 pointed = f.read().strip()
-            if pointed and self.is_valid(self.ckpt_path(load_dir, pointed)):
+            if pointed and usable(pointed, self.ckpt_path(load_dir, pointed)):
                 return pointed
             logger.warning(
                 "latest pointer %r is stale or its checkpoint is invalid; "
                 "scanning %s for the newest valid checkpoint",
                 pointed, load_dir)
         for name, _, path in self.list_checkpoints(load_dir):
-            if self.is_valid(path):
-                return name
-        return None
+            if usable(name, path):
+                resolved = name
+                break
+        if skipped:
+            self._emit_fallback(load_dir, resolved, skipped)
+        return resolved
+
+    @staticmethod
+    def _emit_fallback(load_dir, resolved, skipped):
+        try:
+            from deepspeed_tpu.telemetry.session import get_default_session
+            session = get_default_session()
+            if session is None:
+                return
+            session.emit("checkpoint_fallback",
+                         dir=load_dir,
+                         resolved_tag=resolved,
+                         skipped=len(skipped),
+                         checkpoints=skipped[:8])
+        except Exception:
+            logger.debug("checkpoint_fallback event emission failed",
+                         exc_info=True)
 
     # ------------------------------------------------------------------
     # load
@@ -419,13 +497,31 @@ class CheckpointManager:
                 logger.info("retention GC removed checkpoint %s", path)
             except OSError as e:
                 logger.warning("retention GC failed for %s: %s", path, e)
-        # Leftover tmp dirs from crashed attempts are dead weight too.
+        # Leftover tmp dirs from crashed attempts are dead weight too —
+        # but on a shared filesystem a ``.tmp.<tag>`` dir may be ANOTHER
+        # process's async save that is still being written (process 0
+        # runs GC while peers stream orbax shards into their tmp dirs).
+        # Only reap a tmp dir that (a) is not this manager's in-flight
+        # write, (b) does not belong to a checkpoint we are keeping, and
+        # (c) has gone quiet for the full grace window — an active
+        # writer keeps refreshing mtimes somewhere in the tree.
+        live = {t for t, _, _ in ckpts[:keep]}
+        now = time.time()
         for name in os.listdir(save_dir):
-            if name.startswith(TMP_PREFIX):
-                live = {t for t, _, _ in ckpts[:keep]}
-                if name[len(TMP_PREFIX):] not in live:
-                    shutil.rmtree(os.path.join(save_dir, name),
-                                  ignore_errors=True)
+            if not name.startswith(TMP_PREFIX):
+                continue
+            path = os.path.join(save_dir, name)
+            if path == self._active_tmp:
+                continue
+            if name[len(TMP_PREFIX):] in live:
+                continue
+            if now - _newest_mtime(path) < self.tmp_gc_grace_s:
+                logger.info(
+                    "retention GC keeping recent tmp dir %s "
+                    "(may be a peer's in-flight save)", path)
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            logger.info("retention GC removed stale tmp dir %s", path)
 
     def close(self):
         self.wait()

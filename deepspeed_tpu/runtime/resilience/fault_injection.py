@@ -22,11 +22,21 @@ Seams (all deterministic — armed for explicit steps or a fixed count):
   *inside* the dispatch span at the armed step, simulating a stuck
   collective/straggler so the hang watchdog
   (`telemetry/watchdog.py`) can be exercised end to end.
+- ``kill`` — :func:`maybe_kill` delivers a hard signal (default SIGKILL)
+  to the process itself, either mid-step (inside the dispatch span,
+  after the batch is consumed and before the optimizer state is
+  consistent) or mid-checkpoint-save (state bytes staged, manifest not
+  yet sealed) — the ungraceful exits the ``ds_tpu_run`` supervisor
+  (`runtime/supervisor/`) must detect and recover from. Unlike every
+  other seam this one never raises: the process just dies, exactly like
+  an OOM-killer or preempted-VM death.
 
 Use :func:`clear_faults` (or the ``fault_registry`` pytest fixture in
 ``tests/``) to disarm everything between tests.
 """
 
+import os
+import signal
 import threading
 
 import numpy as np
@@ -161,6 +171,48 @@ def hang_seconds(step):
             _faults.pop("hang", None)
             return entry["seconds"]
     return 0.0
+
+
+# --------------------------------------------------------------------------
+# Hard process death (SIGKILL mid-step / mid-checkpoint-save)
+# --------------------------------------------------------------------------
+
+KILL_OPS = ("step", "checkpoint_save")
+
+
+def inject_kill(op="step", at_step=None, signum=signal.SIGKILL):
+    """Arm a hard self-delivered signal at a worst-case point.
+
+    ``op="step"`` fires inside the dispatch span of the first engine
+    global step >= ``at_step``; ``op="checkpoint_save"`` fires inside
+    the checkpoint writer after the state bytes are staged and before
+    the manifest seal + atomic rename (``at_step`` is ignored there —
+    the next save dies). The default SIGKILL cannot be caught, so no
+    preemption handler, atexit hook, or flight recorder runs: this is
+    the ungraceful-exit seam the supervisor soak tests need.
+    """
+    if op not in KILL_OPS:
+        raise ValueError(f"kill op must be one of {KILL_OPS}, got {op!r}")
+    with _lock:
+        _faults[f"kill:{op}"] = {
+            "at_step": None if at_step is None else int(at_step),
+            "signum": int(signum),
+        }
+
+
+def maybe_kill(op, step=None):
+    """Probe called at the kill seams; delivers the armed signal to this
+    process (and for SIGKILL never returns)."""
+    with _lock:
+        entry = _faults.get(f"kill:{op}")
+        if entry is None:
+            return
+        if entry["at_step"] is not None and (
+                step is None or int(step) < entry["at_step"]):
+            return
+        _faults.pop(f"kill:{op}", None)
+        signum = entry["signum"]
+    os.kill(os.getpid(), signum)
 
 
 # --------------------------------------------------------------------------

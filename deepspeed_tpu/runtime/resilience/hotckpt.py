@@ -1,0 +1,277 @@
+"""In-memory hot-checkpoint tier: seconds-scale recovery for benign
+restarts.
+
+Disk checkpoints (`checkpoint.py`) are the durable tier, but every
+recovery through them replays up to ``save_interval_steps`` of work and
+pays a full orbax round trip. Large-scale training systems (MegaScale;
+the Gemini in-RAM checkpoint design) keep a second, much cheaper tier:
+frequent device→host snapshots held in RAM, so the common benign
+failures — a guard-trip rollback, a single-worker restart — resume from
+the last *step or two*, not the last disk save.
+
+:class:`HotCheckpointStore` holds up to ``capacity`` recent snapshots:
+
+- **Snapshot isolation**: :meth:`snapshot` copies every leaf to host
+  numpy with ``np.array(..., copy=True)`` (same discipline as the async
+  disk path — the engine's compiled steps donate their buffers, and
+  already-host leaves would otherwise alias live memory).
+- **CRC stamping**: each snapshot is crc32-stamped per leaf on a
+  background worker; :meth:`restore` re-verifies before handing the
+  tree back, so a corrupted snapshot raises
+  :class:`HotCheckpointCorruptError` instead of resuming from garbage
+  (the restore ladder then falls through to disk).
+- **Mirror**: with ``mirror_dir`` each snapshot is also staged to a
+  local directory (``hot-<tag>/state.npz`` + ``hot.json``, tmp+rename
+  atomic) — in RAM the tier dies with the process, the mirror is what
+  lets a *restarted* process still skip the disk round trip. Point it
+  at fast local disk (or a peer's export) rather than the shared
+  checkpoint filesystem.
+
+The store knows nothing about the engine: it moves opaque pytrees. The
+engine's restore ladder (``_auto_resume``) decides hot RAM → hot mirror
+→ disk and re-places leaves on the current mesh.
+"""
+
+import collections
+import json
+import logging
+import os
+import shutil
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import jax
+
+from deepspeed_tpu.runtime.resilience.checkpoint import _leaf_checksums
+
+logger = logging.getLogger(__name__)
+
+MIRROR_PREFIX = "hot-"
+MIRROR_TMP_PREFIX = ".tmp.hot-"
+MIRROR_STATE_NAME = "state.npz"
+MIRROR_META_NAME = "hot.json"
+MIRROR_LATEST_NAME = "hot-latest"
+
+
+class HotCheckpointCorruptError(RuntimeError):
+    """A hot snapshot (RAM or mirror) failed CRC/structure validation."""
+
+    def __init__(self, what, reason):
+        super().__init__(f"corrupt hot checkpoint ({what}): {reason}")
+        self.what = what
+        self.reason = reason
+
+
+class HotSnapshot:
+    """One host-RAM snapshot: tag + state pytree + meta + fingerprint."""
+
+    __slots__ = ("tag", "state", "meta", "topology", "checksums", "t")
+
+    def __init__(self, tag, state, meta, topology):
+        self.tag = str(tag)
+        self.state = state
+        self.meta = meta
+        self.topology = topology
+        self.checksums = None   # stamped by the background worker
+        self.t = time.time()
+
+
+def _snapshot_to_host(state):
+    return jax.tree_util.tree_map(
+        lambda x: np.array(jax.device_get(x), copy=True), state)
+
+
+class HotCheckpointStore:
+    def __init__(self, capacity=1, mirror_dir=None, mirror_keep=1,
+                 process_index=0):
+        self.capacity = max(1, int(capacity))
+        self.mirror_dir = os.path.abspath(mirror_dir) if mirror_dir \
+            else None
+        self.mirror_keep = max(1, int(mirror_keep))
+        self.process_index = int(process_index)
+        self._snaps = collections.deque(maxlen=self.capacity)
+        self._pool = None
+        self._pending = None
+
+    # ------------------------------------------------------------------
+    # snapshot
+    # ------------------------------------------------------------------
+    def snapshot(self, tag, state, meta, topology=None):
+        """Copy ``state`` to host RAM and keep it; CRC stamping and the
+        optional mirror write happen on a background worker (call
+        :meth:`wait` — :meth:`restore` does — before relying on them)."""
+        self.wait()   # surface a previous stamping/mirror failure
+        snap = HotSnapshot(tag, _snapshot_to_host(state), meta, topology)
+        self._snaps.append(snap)
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="hot_ckpt")
+        self._pending = self._pool.submit(self._stamp_and_mirror, snap)
+        return snap
+
+    def _stamp_and_mirror(self, snap):
+        snap.checksums = _leaf_checksums(snap.state)
+        if self.mirror_dir:
+            self._write_mirror(snap)
+
+    def wait(self):
+        """Join the in-flight stamp/mirror job, raising its error."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
+
+    def latest(self):
+        """Newest snapshot (CRC stamped — joins the background job), or
+        None when the store is empty."""
+        if not self._snaps:
+            return None
+        self.wait()
+        return self._snaps[-1]
+
+    # ------------------------------------------------------------------
+    # restore (RAM tier)
+    # ------------------------------------------------------------------
+    def restore(self, snap=None):
+        """``(state, meta, topology)`` from the newest (or the given)
+        snapshot after CRC verification. Raises
+        :class:`HotCheckpointCorruptError` on a mismatch — callers fall
+        through to the next ladder tier."""
+        if snap is None:
+            snap = self.latest()
+        else:
+            self.wait()
+        if snap is None:
+            return None
+        if snap.checksums is None:
+            raise HotCheckpointCorruptError(
+                f"ram:{snap.tag}", "snapshot was never CRC-stamped")
+        actual = _leaf_checksums(snap.state)
+        if actual != snap.checksums:
+            bad = sorted(k for k in snap.checksums
+                         if actual.get(k) != snap.checksums[k])
+            raise HotCheckpointCorruptError(
+                f"ram:{snap.tag}",
+                f"crc mismatch on {len(bad)} leaves (first: {bad[:3]})")
+        return snap.state, snap.meta, snap.topology
+
+    # ------------------------------------------------------------------
+    # mirror tier
+    # ------------------------------------------------------------------
+    def _write_mirror(self, snap):
+        final = os.path.join(self.mirror_dir, MIRROR_PREFIX + snap.tag)
+        tmp = os.path.join(self.mirror_dir, MIRROR_TMP_PREFIX + snap.tag)
+        try:
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp, exist_ok=True)
+            leaves, _ = jax.tree_util.tree_flatten_with_path(snap.state)
+            arrays = {jax.tree_util.keystr(path): np.asarray(leaf)
+                      for path, leaf in leaves}
+            with open(os.path.join(tmp, MIRROR_STATE_NAME), "wb") as f:
+                np.savez(f, **arrays)
+            with open(os.path.join(tmp, MIRROR_META_NAME), "w") as f:
+                json.dump({"tag": snap.tag, "t": snap.t,
+                           "process_index": self.process_index,
+                           "meta": snap.meta, "topology": snap.topology,
+                           "checksums": snap.checksums}, f)
+            if os.path.isdir(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            latest_tmp = os.path.join(self.mirror_dir,
+                                      MIRROR_LATEST_NAME + ".tmp")
+            with open(latest_tmp, "w") as f:
+                f.write(snap.tag)
+            os.replace(latest_tmp,
+                       os.path.join(self.mirror_dir, MIRROR_LATEST_NAME))
+            self._gc_mirror()
+        except OSError as e:
+            # The mirror is an accelerator, not the durable tier — a
+            # failed write degrades recovery latency, never correctness.
+            logger.warning("hot-checkpoint mirror write failed: %s", e)
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _gc_mirror(self):
+        entries = []
+        for name in os.listdir(self.mirror_dir):
+            path = os.path.join(self.mirror_dir, name)
+            if name.startswith(MIRROR_PREFIX) and os.path.isdir(path):
+                entries.append((os.path.getmtime(path), path))
+            elif name.startswith(MIRROR_TMP_PREFIX):
+                shutil.rmtree(path, ignore_errors=True)
+        entries.sort(reverse=True)
+        for _, path in entries[self.mirror_keep:]:
+            shutil.rmtree(path, ignore_errors=True)
+
+    @staticmethod
+    def load_mirror(mirror_dir, template):
+        """``(state, meta, topology)`` from the newest mirror snapshot
+        under ``mirror_dir``, rebuilt against ``template``'s pytree
+        structure (mirrors store leaves by key path — the restoring
+        process supplies the structure, typically its freshly
+        initialized state tree). Returns None when the dir holds no
+        usable mirror; raises :class:`HotCheckpointCorruptError` on a
+        CRC/structure mismatch."""
+        mirror_dir = os.path.abspath(mirror_dir)
+        latest = os.path.join(mirror_dir, MIRROR_LATEST_NAME)
+        candidates = []
+        try:
+            with open(latest) as f:
+                tag = f.read().strip()
+            if tag:
+                candidates.append(
+                    os.path.join(mirror_dir, MIRROR_PREFIX + tag))
+        except OSError:
+            pass
+        try:
+            extra = [os.path.join(mirror_dir, n)
+                     for n in os.listdir(mirror_dir)
+                     if n.startswith(MIRROR_PREFIX)
+                     and os.path.isdir(os.path.join(mirror_dir, n))]
+            extra.sort(key=os.path.getmtime, reverse=True)
+            candidates.extend(p for p in extra if p not in candidates)
+        except OSError:
+            return None
+        for path in candidates:
+            try:
+                return HotCheckpointStore._load_one_mirror(path, template)
+            except Exception as e:
+                # a torn mirror can fail anywhere in the decode stack
+                # (zipfile, npy header, json, CRC) — skip to the next
+                logger.warning("skipping unusable hot mirror %s: %s",
+                               path, e)
+        return None
+
+    @staticmethod
+    def _load_one_mirror(path, template):
+        with open(os.path.join(path, MIRROR_META_NAME)) as f:
+            doc = json.load(f)
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+        with np.load(os.path.join(path, MIRROR_STATE_NAME)) as npz:
+            restored = []
+            for key_path, _ in leaves:
+                key = jax.tree_util.keystr(key_path)
+                if key not in npz:
+                    raise HotCheckpointCorruptError(
+                        path, f"mirror missing leaf {key} — snapshot is "
+                        "from a different state tree")
+                restored.append(np.array(npz[key]))
+        state = jax.tree_util.tree_unflatten(treedef, restored)
+        checksums = doc.get("checksums")
+        if checksums:
+            actual = _leaf_checksums(state)
+            for key, rec in checksums.items():
+                got = actual.get(key)
+                if got is None or got["crc32"] != rec["crc32"]:
+                    raise HotCheckpointCorruptError(
+                        path, f"crc mismatch for leaf {key}")
+        return state, doc.get("meta"), doc.get("topology")
+
+    def close(self):
+        try:
+            self.wait()
+        finally:
+            if self._pool is not None:
+                self._pool.shutdown(wait=True)
+                self._pool = None
+            self._snaps.clear()

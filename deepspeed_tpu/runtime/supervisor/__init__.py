@@ -1,0 +1,40 @@
+"""Job-level recovery: the ``ds_tpu_run`` launcher/supervisor.
+
+Per-process resilience (PRs 2/12: guards, preemption saves, watchdog,
+flight recorder) detects failures but cannot act on them — a hung or
+crashed worker dumps its black box and dies. The supervisor closes the
+detect→recover loop at the *job* level:
+
+- :mod:`state` — worker-slot bookkeeping, failure causes, the
+  supervisor result type.
+- :mod:`supervisor` — :class:`Supervisor`: spawns the per-process
+  workers, watches exit codes and the watchdog heartbeat files
+  (``hb-p<idx>.json``), classifies failures (crash / hang / preemption),
+  and performs coordinated kill-and-restart with exponential backoff, a
+  max-restart budget, and elastic downsizing when the same slot keeps
+  failing (``solve_elastic_batch`` re-derives the batch plan; the
+  engine's reshard-on-resume absorbs the world-size change on load).
+- :mod:`cli` — the ``ds_tpu_run`` command line (``bin/ds_tpu_run``).
+
+Restart/recovery telemetry lands in the supervisor's own JSONL log
+(``restart`` events, restart counters, a time-to-recover histogram) so
+``ds_tpu_metrics summary`` sees the whole loop.
+"""
+
+from deepspeed_tpu.runtime.supervisor.state import (
+    CAUSE_CRASH,
+    CAUSE_HANG,
+    CAUSE_PREEMPTION,
+    SupervisorResult,
+    WorkerSlot,
+)
+from deepspeed_tpu.runtime.supervisor.supervisor import Supervisor
+
+__all__ = [
+    "CAUSE_CRASH",
+    "CAUSE_HANG",
+    "CAUSE_PREEMPTION",
+    "Supervisor",
+    "SupervisorResult",
+    "WorkerSlot",
+]

@@ -1,0 +1,112 @@
+"""Argument parsing for ``ds_tpu_run`` (see ``bin/ds_tpu_run``).
+
+Everything after ``--`` is the worker command, spawned once per
+process index::
+
+    ds_tpu_run --nproc 2 --workdir /tmp/job \\
+        --hang-timeout-s 30 --max-restarts 3 \\
+        -- python train.py --config ds_config.json
+
+Exit status: 0 when every worker completed (wrote its done marker),
+1 otherwise (restart budget exhausted, or --timeout-s hit).
+"""
+
+import argparse
+import sys
+
+from deepspeed_tpu.runtime.supervisor.supervisor import Supervisor
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="ds_tpu_run",
+        description="Launch and supervise a deepspeed_tpu job: restart "
+                    "on crash/hang/preemption, downsize on repeated "
+                    "failure. Worker command follows `--`.")
+    p.add_argument("--nproc", type=int, required=True,
+                   help="number of worker processes to launch")
+    p.add_argument("--workdir", required=True,
+                   help="job working directory (worker cwd, logs/, "
+                        "done markers)")
+    p.add_argument("--heartbeat-dir", default=None,
+                   help="directory scanned (recursively) for the "
+                        "watchdog's hb-p*.json files; default: workdir")
+    p.add_argument("--jsonl", default=None,
+                   help="supervisor telemetry JSONL log (restart / "
+                        "recovery events for ds_tpu_metrics)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="job-level restart budget (default 3)")
+    p.add_argument("--backoff-base-s", type=float, default=0.5,
+                   help="first restart backoff; doubles per restart "
+                        "(default 0.5)")
+    p.add_argument("--backoff-cap-s", type=float, default=30.0,
+                   help="backoff ceiling in seconds (default 30)")
+    p.add_argument("--hang-timeout-s", type=float, default=None,
+                   help="declare a hang when a heartbeat reports "
+                        "in_step with step_elapsed_s past this "
+                        "(default: off)")
+    p.add_argument("--heartbeat-stale-s", type=float, default=None,
+                   help="declare a hang when a worker's heartbeat file "
+                        "stops updating for this long (default: off)")
+    p.add_argument("--poll-interval-s", type=float, default=0.25,
+                   help="supervisor poll period (default 0.25)")
+    p.add_argument("--kill-grace-s", type=float, default=5.0,
+                   help="SIGTERM to SIGKILL grace on coordinated stop "
+                        "(default 5)")
+    p.add_argument("--downsize-after", type=int, default=2,
+                   help="consecutive failures of one slot before an "
+                        "elastic downsize (default 2)")
+    p.add_argument("--min-world", type=int, default=1,
+                   help="never downsize below this world size "
+                        "(default 1)")
+    p.add_argument("--target-global-batch", type=int, default=None,
+                   help="re-solve micro/accum for the current world "
+                        "and export DS_TPU_RUN_MICRO_BATCH / "
+                        "_GRAD_ACCUM / _LR_SCALE to workers")
+    p.add_argument("--lr-scaling", default="linear",
+                   choices=("linear", "sqrt", "none"),
+                   help="LR rescale rule for elastic batch plans "
+                        "(default linear)")
+    p.add_argument("--timeout-s", type=float, default=None,
+                   help="abort the whole job after this long "
+                        "(default: none)")
+    p.add_argument("worker_cmd", nargs=argparse.REMAINDER,
+                   help="worker command after `--`")
+    return p
+
+
+def main(argv=None):
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    cmd = list(args.worker_cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no worker command given (append `-- cmd ...`)")
+    sup = Supervisor(
+        cmd, args.nproc, args.workdir,
+        heartbeat_dir=args.heartbeat_dir,
+        jsonl_path=args.jsonl,
+        max_restarts=args.max_restarts,
+        backoff_base_s=args.backoff_base_s,
+        backoff_cap_s=args.backoff_cap_s,
+        hang_timeout_s=args.hang_timeout_s,
+        heartbeat_stale_s=args.heartbeat_stale_s,
+        poll_interval_s=args.poll_interval_s,
+        kill_grace_s=args.kill_grace_s,
+        downsize_after=args.downsize_after,
+        min_world_size=args.min_world,
+        target_global_batch=args.target_global_batch,
+        lr_scaling=args.lr_scaling,
+        timeout_s=args.timeout_s,
+    )
+    result = sup.run()
+    print(f"ds_tpu_run: {result.reason} "
+          f"(restarts={result.restarts}, downsizes={result.downsizes}, "
+          f"world={result.world_size}, causes={result.causes})",
+          file=sys.stderr)
+    return 0 if result.success else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""Worker-slot bookkeeping for the ``ds_tpu_run`` supervisor.
+
+A *slot* is a logical process index in the current (possibly downsized)
+world; the OS process occupying it changes across restarts. The
+supervisor classifies every failure into one of the causes below — the
+cause drives both the telemetry (``restart`` events, restart counters
+by cause) and the policy (repeated failures of the same slot trigger an
+elastic downsize).
+"""
+
+import time
+from typing import NamedTuple
+
+# Failure causes (the `cause` field of restart events).
+CAUSE_CRASH = "crash"            # nonzero/negative exit code
+CAUSE_HANG = "hang"              # heartbeat shows a stuck step
+CAUSE_PREEMPTION = "preemption"  # clean exit 0 without a done marker
+
+# Terminal reasons (SupervisorResult.reason).
+REASON_COMPLETED = "completed"
+REASON_RESTART_BUDGET = "restart_budget_exhausted"
+
+
+class SupervisorResult(NamedTuple):
+    """What one supervised job run amounted to."""
+    success: bool
+    reason: str
+    restarts: int
+    downsizes: int
+    world_size: int
+    causes: dict     # cause -> count
+
+
+class WorkerSlot:
+    """One logical worker: index, live process, failure history."""
+
+    def __init__(self, index):
+        self.index = int(index)
+        self.proc = None
+        self.started_t = None
+        self.attempt = 0               # spawns of this slot so far
+        self.consecutive_failures = 0  # reset on any observed progress
+        self.done = False
+        self.last_step = None          # newest heartbeat step seen
+
+    @property
+    def running(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def pid(self):
+        return self.proc.pid if self.proc is not None else None
+
+    def mark_spawned(self, proc, clock=time.monotonic):
+        self.proc = proc
+        self.started_t = clock()
+        self.attempt += 1
+
+    def __repr__(self):
+        state = "done" if self.done else \
+            ("running" if self.running else "down")
+        return (f"WorkerSlot(index={self.index}, {state}, "
+                f"pid={self.pid}, attempt={self.attempt}, "
+                f"consecutive_failures={self.consecutive_failures})")

@@ -1,0 +1,365 @@
+"""The ``ds_tpu_run`` supervisor: spawn, watch, classify, restart.
+
+One :class:`Supervisor` owns a job of ``num_workers`` worker processes
+(one per training process). It watches two signals the workers already
+produce — exit codes, and the hang watchdog's per-process heartbeat
+files (``hb-p<idx>.json``, `telemetry/watchdog.py`) matched to workers
+by pid — classifies every failure as **crash** (nonzero exit), **hang**
+(heartbeat stuck in a step past ``hang_timeout_s``, or gone stale), or
+**preemption** (clean exit 0 without the worker's done marker), and
+recovers with a coordinated kill-and-restart: SIGTERM (letting healthy
+workers take their preemption save), then SIGKILL after a grace period,
+exponential backoff, respawn.
+
+Two budgets bound the loop: ``max_restarts`` total, and — when the SAME
+slot keeps failing ``downsize_after`` times in a row (a bad host, not a
+bad step) — an **elastic downsize**: the job restarts with one fewer
+worker, ``solve_elastic_batch`` re-derives the micro×accum plan for the
+smaller world (exported to workers via ``DS_TPU_RUN_MICRO_BATCH`` /
+``DS_TPU_RUN_GRAD_ACCUM`` / ``DS_TPU_RUN_LR_SCALE``), and the engine's
+reshard-on-resume absorbs the topology change at checkpoint load.
+
+Worker contract (all optional beyond the index variables):
+
+- ``DS_TPU_RUN_PROCESS_INDEX`` / ``DS_TPU_RUN_NUM_WORKERS`` — this
+  worker's slot and the current world size.
+- ``DS_TPU_RUN_RESTART_COUNT`` — job-level restart count (0 first
+  launch); fault-injection harnesses arm faults only when it is 0.
+- ``DS_TPU_RUN_ATTEMPT`` — this slot's spawn count (1-based).
+- ``DS_TPU_RUN_WORKDIR`` — the supervisor's working directory.
+- On clean completion the worker must create
+  ``<workdir>/done-p<idx>`` (see :func:`done_path`); exit 0 without it
+  reads as a preemption and is restarted.
+
+The supervisor emits its own telemetry (``restart`` events — durable,
+fsynced — plus ``restarts_total`` counters and a ``time_to_recover``
+histogram) to ``jsonl_path``, so ``ds_tpu_metrics summary`` on that log
+shows the whole recovery loop.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from deepspeed_tpu.runtime.elastic.batch import solve_elastic_batch
+from deepspeed_tpu.runtime.supervisor.state import (
+    CAUSE_CRASH,
+    CAUSE_HANG,
+    CAUSE_PREEMPTION,
+    REASON_COMPLETED,
+    REASON_RESTART_BUDGET,
+    SupervisorResult,
+    WorkerSlot,
+)
+from deepspeed_tpu.utils.logging import logger
+
+REASON_TIMEOUT = "timeout"
+
+_HB_PREFIX = "hb-p"
+
+
+def done_path(workdir, index):
+    """Path of the done marker worker ``index`` writes on completion."""
+    return os.path.join(workdir, f"done-p{int(index):05d}")
+
+
+class Supervisor:
+    def __init__(self, argv, num_workers, workdir,
+                 heartbeat_dir=None,
+                 jsonl_path=None,
+                 max_restarts=3,
+                 backoff_base_s=0.5,
+                 backoff_cap_s=30.0,
+                 hang_timeout_s=None,
+                 heartbeat_stale_s=None,
+                 poll_interval_s=0.25,
+                 kill_grace_s=5.0,
+                 downsize_after=2,
+                 min_world_size=1,
+                 target_global_batch=None,
+                 lr_scaling="linear",
+                 timeout_s=None,
+                 env=None):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, "
+                             f"got {num_workers}")
+        self.argv = list(argv)
+        self.workdir = os.path.abspath(workdir)
+        self.heartbeat_dir = os.path.abspath(heartbeat_dir) \
+            if heartbeat_dir else self.workdir
+        self.max_restarts = int(max_restarts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.hang_timeout_s = hang_timeout_s
+        self.heartbeat_stale_s = heartbeat_stale_s
+        self.poll_interval_s = float(poll_interval_s)
+        self.kill_grace_s = float(kill_grace_s)
+        self.downsize_after = int(downsize_after)
+        self.min_world_size = max(1, int(min_world_size))
+        self.target_global_batch = target_global_batch
+        self.lr_scaling = lr_scaling
+        self.timeout_s = timeout_s
+        self.base_env = dict(env) if env is not None else dict(os.environ)
+
+        self.world_size = int(num_workers)
+        self.slots = [WorkerSlot(i) for i in range(self.world_size)]
+        self.restarts = 0
+        self.downsizes = 0
+        self.causes = {}
+        self._session = None
+        if jsonl_path:
+            from deepspeed_tpu.telemetry.session import TelemetrySession
+            from deepspeed_tpu.telemetry.exporters import JsonlExporter
+            self._session = TelemetrySession(
+                exporters=[JsonlExporter(jsonl_path)])
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+    def _emit(self, event, **fields):
+        if self._session is not None:
+            try:
+                self._session.emit(event, **fields)
+            except Exception:   # pragma: no cover - telemetry never kills
+                pass
+
+    def _count_restart(self, cause, time_to_recover_s):
+        if self._session is not None:
+            reg = self._session.registry
+            reg.counter("restarts_total", labels={"cause": cause},
+                        help="supervisor restarts by failure cause").inc()
+            reg.histogram(
+                "time_to_recover_seconds",
+                help="failure detection to workers respawned"
+            ).observe(time_to_recover_s)
+
+    # ------------------------------------------------------------------
+    # spawning
+    # ------------------------------------------------------------------
+    def _batch_plan_env(self):
+        if not self.target_global_batch:
+            return {}
+        plan = solve_elastic_batch(self.target_global_batch,
+                                   self.world_size,
+                                   lr_scaling=self.lr_scaling)
+        return {
+            "DS_TPU_RUN_MICRO_BATCH": str(plan.micro_batch),
+            "DS_TPU_RUN_GRAD_ACCUM": str(plan.grad_accum),
+            "DS_TPU_RUN_LR_SCALE": repr(plan.lr_scale),
+        }
+
+    def _spawn(self, slot):
+        env = dict(self.base_env)
+        env.update(self._batch_plan_env())
+        env.update({
+            "DS_TPU_RUN_PROCESS_INDEX": str(slot.index),
+            "DS_TPU_RUN_NUM_WORKERS": str(self.world_size),
+            "DS_TPU_RUN_RESTART_COUNT": str(self.restarts),
+            "DS_TPU_RUN_ATTEMPT": str(slot.attempt + 1),
+            "DS_TPU_RUN_WORKDIR": self.workdir,
+        })
+        log_dir = os.path.join(self.workdir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log_f = open(os.path.join(log_dir, f"w{slot.index}.log"), "ab")
+        try:
+            proc = subprocess.Popen(self.argv, env=env, stdout=log_f,
+                                    stderr=subprocess.STDOUT,
+                                    cwd=self.workdir)
+        finally:
+            log_f.close()   # the child holds its own fd
+        slot.mark_spawned(proc)
+        slot.last_step = None
+        logger.info("ds_tpu_run: spawned worker %d (pid %d, attempt %d, "
+                    "world %d)", slot.index, proc.pid, slot.attempt,
+                    self.world_size)
+
+    def _spawn_all(self):
+        for slot in self.slots:
+            if not slot.done:
+                self._spawn(slot)
+
+    # ------------------------------------------------------------------
+    # monitoring
+    # ------------------------------------------------------------------
+    def _scan_heartbeats(self):
+        """pid -> newest parseable heartbeat under heartbeat_dir (walked
+        recursively: per-worker crash dirs nest in CPU test mode, one
+        shared dir on a real pod)."""
+        out = {}
+        for dirpath, _, filenames in os.walk(self.heartbeat_dir):
+            for name in filenames:
+                if not (name.startswith(_HB_PREFIX)
+                        and name.endswith(".json")):
+                    continue
+                try:
+                    with open(os.path.join(dirpath, name)) as f:
+                        hb = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if isinstance(hb, dict) and hb.get("pid") is not None:
+                    prev = out.get(int(hb["pid"]))
+                    if prev is None or hb.get("t", 0) > prev.get("t", 0):
+                        out[int(hb["pid"])] = hb
+        return out
+
+    def _classify_failure(self):
+        """(cause, slot) of the first detected failure, or (None, None).
+        Also flips ``done`` on slots whose marker appeared and resets
+        failure streaks on observed step progress."""
+        heartbeats = self._scan_heartbeats()
+        now = time.time()
+        for slot in self.slots:
+            if slot.done:
+                continue
+            rc = slot.proc.poll() if slot.proc is not None else None
+            if rc is not None:
+                if os.path.exists(done_path(self.workdir, slot.index)):
+                    slot.done = True
+                    logger.info("ds_tpu_run: worker %d completed",
+                                slot.index)
+                    continue
+                return ((CAUSE_PREEMPTION if rc == 0 else CAUSE_CRASH),
+                        slot)
+            hb = heartbeats.get(slot.pid)
+            if hb is None:
+                continue   # not started reporting yet; exit code covers
+            step = hb.get("step")
+            if step is not None:
+                if slot.last_step is not None and step > slot.last_step:
+                    slot.consecutive_failures = 0
+                slot.last_step = step
+            stuck = (self.hang_timeout_s is not None
+                     and hb.get("in_step")
+                     and float(hb.get("step_elapsed_s") or 0.0)
+                     > float(self.hang_timeout_s))
+            stale = (self.heartbeat_stale_s is not None
+                     and now - float(hb.get("t") or now)
+                     > float(self.heartbeat_stale_s))
+            if stuck or stale:
+                return CAUSE_HANG, slot
+        return None, None
+
+    # ------------------------------------------------------------------
+    # kill / restart
+    # ------------------------------------------------------------------
+    def _kill_all(self):
+        """Coordinated stop: SIGTERM everyone (healthy workers take
+        their preemption save), grace period, then SIGKILL leftovers."""
+        live = [s for s in self.slots if s.running]
+        for slot in live:
+            try:
+                slot.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + self.kill_grace_s
+        for slot in live:
+            remaining = deadline - time.monotonic()
+            try:
+                slot.proc.wait(timeout=max(0.0, remaining))
+            except subprocess.TimeoutExpired:
+                try:
+                    slot.proc.kill()
+                    slot.proc.wait(timeout=self.kill_grace_s)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+
+    def _maybe_downsize(self, failed):
+        """Drop ``failed``'s slot when it keeps failing and the world
+        can shrink; returns True when the world changed. A downsize is a
+        full job restart: done markers are cleared (the smaller world
+        re-derives the batch plan, so completed work from the old plan
+        no longer lines up) and every slot's history resets."""
+        if failed.consecutive_failures < self.downsize_after or \
+                self.world_size <= self.min_world_size:
+            return False
+        self.world_size -= 1
+        self.downsizes += 1
+        for slot in self.slots:
+            marker = done_path(self.workdir, slot.index)
+            if os.path.exists(marker):
+                try:
+                    os.remove(marker)
+                except OSError:
+                    pass
+        self.slots = [WorkerSlot(i) for i in range(self.world_size)]
+        logger.warning(
+            "ds_tpu_run: worker slot %d failed %d consecutive times — "
+            "elastic downsize to world %d", failed.index,
+            failed.consecutive_failures, self.world_size)
+        return True
+
+    def _restart(self, cause, failed):
+        t_detect = time.monotonic()
+        self._kill_all()
+        failed.consecutive_failures += 1
+        downsized = self._maybe_downsize(failed)
+        # Count the restart BEFORE respawning: workers read the updated
+        # DS_TPU_RUN_RESTART_COUNT (fault harnesses arm only at 0).
+        self.restarts += 1
+        self.causes[cause] = self.causes.get(cause, 0) + 1
+        backoff = min(self.backoff_cap_s,
+                      self.backoff_base_s * (2 ** (self.restarts - 1)))
+        time.sleep(backoff)
+        self._spawn_all()
+        time_to_recover = time.monotonic() - t_detect
+        self._count_restart(cause, time_to_recover)
+        self._emit("restart", cause=cause, failed_index=failed.index,
+                   restarts=self.restarts, world_size=self.world_size,
+                   downsize=downsized, backoff_s=round(backoff, 3),
+                   time_to_recover_s=round(time_to_recover, 3),
+                   consecutive_failures=failed.consecutive_failures)
+        logger.warning(
+            "ds_tpu_run: restart %d/%d (cause=%s, worker %d%s) after "
+            "%.2fs backoff", self.restarts, self.max_restarts, cause,
+            failed.index,
+            ", downsized" if downsized else "", backoff)
+
+    # ------------------------------------------------------------------
+    # run loop
+    # ------------------------------------------------------------------
+    def run(self):
+        os.makedirs(self.workdir, exist_ok=True)
+        self._emit("run_start", role="supervisor",
+                   num_workers=self.world_size, argv=self.argv,
+                   max_restarts=self.max_restarts,
+                   hang_timeout_s=self.hang_timeout_s)
+        self._spawn_all()
+        t0 = time.monotonic()
+        try:
+            while True:
+                time.sleep(self.poll_interval_s)
+                cause, failed = self._classify_failure()
+                if cause is not None:
+                    if self.restarts >= self.max_restarts:
+                        self._kill_all()
+                        return self._finish(False, REASON_RESTART_BUDGET,
+                                            last_cause=cause)
+                    self._restart(cause, failed)
+                    continue
+                if all(slot.done for slot in self.slots):
+                    return self._finish(True, REASON_COMPLETED)
+                if self.timeout_s is not None and \
+                        time.monotonic() - t0 > self.timeout_s:
+                    self._kill_all()
+                    return self._finish(False, REASON_TIMEOUT)
+        finally:
+            self._kill_all()
+            if self._session is not None:
+                self._session.close()
+
+    def _finish(self, success, reason, last_cause=None):
+        result = SupervisorResult(
+            success=success, reason=reason, restarts=self.restarts,
+            downsizes=self.downsizes, world_size=self.world_size,
+            causes=dict(self.causes))
+        self._emit("supervisor_done", success=success, reason=reason,
+                   restarts=self.restarts, downsizes=self.downsizes,
+                   world_size=self.world_size, causes=self.causes,
+                   last_cause=last_cause)
+        (logger.info if success else logger.error)(
+            "ds_tpu_run: %s (restarts=%d, downsizes=%d, world=%d)",
+            reason, self.restarts, self.downsizes, self.world_size)
+        return result

@@ -102,10 +102,14 @@ def _wire_bytes_per_step(events):
 
 
 def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
-    """Aggregate a run's events into the summary dict (None when the log
-    holds no step events)."""
+    """Aggregate a run's events into the summary dict. None when the
+    log holds neither step events nor resilience events (a supervisor's
+    log is all restarts and recoveries — still worth a summary)."""
     steps = [e for e in events if e.get("event") == "step"]
-    if not steps:
+    if not steps and not any(
+            e.get("event") in ("restart", "recovery_ladder",
+                               "checkpoint_fallback", "supervisor_done")
+            for e in events):
         return None
     walls = sorted(float(e["wall_s"]) for e in steps
                    if e.get("wall_s") is not None)
@@ -124,6 +128,19 @@ def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
         if evt.get("event") == "health_guard":
             action = evt.get("action", "?")
             guard_actions[action] = guard_actions.get(action, 0) + 1
+    restart_causes = {}
+    ladder_tiers = {}
+    recover_secs = []
+    for evt in events:
+        kind = evt.get("event")
+        if kind == "restart":
+            cause = evt.get("cause", "?")
+            restart_causes[cause] = restart_causes.get(cause, 0) + 1
+            if evt.get("time_to_recover_s") is not None:
+                recover_secs.append(float(evt["time_to_recover_s"]))
+        elif kind == "recovery_ladder":
+            tier = evt.get("tier", "?")
+            ladder_tiers[tier] = ladder_tiers.get(tier, 0) + 1
     saves = [e for e in events if e.get("event") == "checkpoint_save"]
     save_secs = [float(e["duration_s"]) for e in saves
                  if e.get("duration_s") is not None]
@@ -142,7 +159,7 @@ def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
     return {
         "schema": SCHEMA_VERSION,
         "steps": len(steps),
-        "flavor": steps[-1].get("flavor"),
+        "flavor": steps[-1].get("flavor") if steps else None,
         "wall_s": total_s,
         "step_s": {
             "mean": (total_s / len(walls)) if walls else None,
@@ -168,6 +185,20 @@ def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
             },
             "checkpoint_load": sum(
                 1 for e in events if e.get("event") == "checkpoint_load"),
+            "checkpoint_fallback": sum(
+                1 for e in events
+                if e.get("event") == "checkpoint_fallback"),
+            "restart": {
+                "count": sum(restart_causes.values()),
+                "by_cause": restart_causes,
+                "mean_time_to_recover_s": (
+                    sum(recover_secs) / len(recover_secs))
+                if recover_secs else None,
+            },
+            "recovery_ladder": {
+                "count": sum(ladder_tiers.values()),
+                "by_tier": ladder_tiers,
+            },
         },
     }
 
@@ -215,6 +246,21 @@ def print_summary(s, out=None):
           f"save(s)"
           + (f" (mean {_fmt_s(save_mean)})" if save_mean else "")
           + f", {ev['checkpoint_load']} load(s)", file=out)
+    rst = ev.get("restart") or {}
+    lad = ev.get("recovery_ladder") or {}
+    fallbacks = ev.get("checkpoint_fallback", 0)
+    if rst.get("count") or lad.get("count") or fallbacks:
+        causes = ", ".join(f"{k}={v}" for k, v in
+                           sorted((rst.get("by_cause") or {}).items())) \
+            or "none"
+        tiers = ", ".join(f"{k}={v}" for k, v in
+                          sorted((lad.get("by_tier") or {}).items())) \
+            or "none"
+        ttr = rst.get("mean_time_to_recover_s")
+        print(f"  resilience: {rst.get('count', 0)} restart(s) [{causes}]"
+              + (f" mean recover {_fmt_s(ttr)}" if ttr else "")
+              + f", {lad.get('count', 0)} recovery ladder load(s) "
+              f"[{tiers}], {fallbacks} checkpoint fallback(s)", file=out)
     if s["last_loss"] is not None:
         print(f"  last loss {s['last_loss']:.6g}", file=out)
 
@@ -303,17 +349,20 @@ def host_label(events, path):
     return os.path.basename(path)
 
 
-def aggregate(logs):
+def aggregate(logs, no_heartbeat=()):
     """Merge per-host logs of one run. ``logs`` is ``[(label, events)]``;
     returns the aggregation dict, or None when no step appears in at
-    least two logs (nothing cross-host to compare).
+    least two logs (nothing cross-host to compare) and no host is known
+    dead. ``no_heartbeat`` lists hosts that never produced a usable
+    log/heartbeat (``{"host", "status": "no-heartbeat", "reason"}``
+    rows) — a crashed host must show up in the report, not crash it.
 
     The straggler ranking orders hosts by mean *excess* wall — how much
     slower than the fastest host they were, averaged over every shared
     step — which is robust to a globally slow phase (all hosts slow
     together shows zero excess everywhere).
     """
-    hosts = []
+    hosts = [dict(row) for row in no_heartbeat]
     per_step = {}
     for label, events in logs:
         steps = [e for e in events if e.get("event") == "step"
@@ -329,7 +378,7 @@ def aggregate(logs):
             per_step.setdefault(int(e.get("step", -1)),
                                 {})[label] = float(e["wall_s"])
     shared = {s: w for s, w in per_step.items() if len(w) >= 2}
-    if not shared:
+    if not shared and not no_heartbeat:
         return None
     step_rows = []
     excess = {h["host"]: [] for h in hosts}
@@ -358,6 +407,11 @@ def print_aggregate(agg, n_steps=10, out=None):
     print(f"cross-host aggregation ({len(agg['hosts'])} host logs, "
           f"schema {agg['schema']})", file=out)
     for h in agg["hosts"]:
+        if h.get("status") == "no-heartbeat":
+            print(f"  {h['host']:<24s} NO HEARTBEAT "
+                  f"({h.get('reason', 'missing')}) — host crashed "
+                  f"before/while reporting", file=out)
+            continue
         mean = _fmt_s(h["mean_wall_s"])
         print(f"  {h['host']:<24s} {h['steps']} step(s), "
               f"mean {mean}, last step {h['last_step']}", file=out)
@@ -410,6 +464,12 @@ def print_postmortem(dump, n_events=15, out=None):
               f"{wd.get('median_wall_s')}s)", file=out)
         print(f"  verdict  {wd.get('verdict')}", file=out)
         for s in wd.get("stragglers") or []:
+            if s.get("status") == "no-heartbeat":
+                print(f"    straggler p{s.get('process_index')}: "
+                      f"no-heartbeat ({s.get('reason', 'missing')}) — "
+                      f"process died before/while writing its heartbeat",
+                      file=out)
+                continue
             print(f"    straggler p{s.get('process_index')} "
                   f"({s.get('hostname')}): step {s.get('step')} "
                   f"({s.get('behind_steps')} behind), phase "
@@ -453,6 +513,26 @@ def print_postmortem(dump, n_events=15, out=None):
                 if p.get("duration_s") is not None else ""
             print(f"    {p.get('t', 0):.3f} {p.get('kind'):<6s}"
                   f"{p.get('path')}{dur}", file=out)
+
+
+def print_heartbeat_status(directory, expected_count=None, out=None):
+    """One line per process in a heartbeat dir — live heartbeats plus
+    the expected-but-silent ``no-heartbeat`` processes."""
+    from deepspeed_tpu.telemetry.watchdog import scan_heartbeats
+    heartbeats, no_heartbeat = scan_heartbeats(
+        directory, expected_count=expected_count)
+    print(f"  heartbeat dir {directory}: {len(heartbeats)} heartbeat "
+          f"file(s), {len(no_heartbeat)} silent", file=out)
+    for hb in sorted(heartbeats,
+                     key=lambda h: h.get("process_index") or 0):
+        state = (f"in step for {hb.get('step_elapsed_s')}s"
+                 if hb.get("in_step") else "between steps")
+        print(f"    p{hb.get('process_index')} ({hb.get('hostname')}): "
+              f"step {hb.get('step')}, phase '{hb.get('phase')}', "
+              f"{state}", file=out)
+    for gone in no_heartbeat:
+        print(f"    p{gone['process_index']}: no-heartbeat "
+              f"({gone['reason']})", file=out)
 
 
 def _load(parser, path):
@@ -509,6 +589,14 @@ def main(argv=None):
     p_agg.add_argument("-n", type=int, default=10,
                        help="shared steps shown in the skew table")
     p_agg.add_argument("--json", action="store_true", dest="as_json")
+    p_agg.add_argument("--heartbeats", default=None, metavar="DIR",
+                       help="also scan this heartbeat dir and list "
+                            "processes with no usable hb-p*.json as "
+                            "no-heartbeat hosts")
+    p_agg.add_argument("--expect-hosts", type=int, default=None,
+                       help="expected process count: indices in "
+                            "range(N) with no heartbeat file at all are "
+                            "reported as no-heartbeat")
 
     p_pm = sub.add_parser(
         "postmortem", help="render a flight-recorder crash dump")
@@ -516,6 +604,11 @@ def main(argv=None):
     p_pm.add_argument("-n", type=int, default=15,
                       help="events shown in the timeline tail")
     p_pm.add_argument("--json", action="store_true", dest="as_json")
+    p_pm.add_argument("--heartbeats", default=None, metavar="DIR",
+                      help="also render the heartbeat dir's per-process "
+                           "status (silent hosts show as no-heartbeat)")
+    p_pm.add_argument("--expect-hosts", type=int, default=None,
+                      help="expected process count for --heartbeats")
 
     args = parser.parse_args(argv)
     if args.cmd is None:
@@ -544,10 +637,28 @@ def main(argv=None):
 
     if args.cmd == "aggregate":
         logs = []
+        no_heartbeat = []
         for path in args.logs:
-            events = _load(parser, path)
+            try:
+                events = read_events(path)
+            except OSError as exc:
+                # A crashed host may never have opened (or half-wrote)
+                # its log — report it, don't die on it.
+                no_heartbeat.append({
+                    "host": os.path.basename(path),
+                    "status": "no-heartbeat",
+                    "reason": f"unreadable log ({exc})"})
+                continue
             logs.append((host_label(events, path), events))
-        agg = aggregate(logs)
+        if args.heartbeats:
+            from deepspeed_tpu.telemetry.watchdog import scan_heartbeats
+            _, silent = scan_heartbeats(
+                args.heartbeats, expected_count=args.expect_hosts)
+            no_heartbeat.extend(
+                {"host": f"p{g['process_index']}",
+                 "status": "no-heartbeat", "reason": g["reason"]}
+                for g in silent)
+        agg = aggregate(logs, no_heartbeat=no_heartbeat)
         if agg is None:
             print("no step appears in two or more logs — nothing "
                   "cross-host to compare", file=sys.stderr)
@@ -563,11 +674,24 @@ def main(argv=None):
         try:
             dump = read_dump(args.dump)
         except (OSError, ValueError) as exc:
-            parser.error(f"cannot read dump: {exc}")
+            # A host killed mid-dump leaves a truncated/absent file —
+            # degrade to whatever else we can report instead of a usage
+            # error.
+            print(f"cannot read dump {args.dump}: {exc} — host produced "
+                  f"no usable flight dump (no-heartbeat)",
+                  file=sys.stderr)
+            if args.heartbeats:
+                print_heartbeat_status(args.heartbeats,
+                                       expected_count=args.expect_hosts,
+                                       out=sys.stderr)
+            return 1
         if args.as_json:
             print(json.dumps(dump, indent=2, sort_keys=True, default=str))
         else:
             print_postmortem(dump, n_events=args.n)
+            if args.heartbeats:
+                print_heartbeat_status(args.heartbeats,
+                                       expected_count=args.expect_hosts)
         return 0
 
     # diff

@@ -28,7 +28,7 @@ import sys
 # process that wrote them. Everything else gets flush-per-line only.
 DURABLE_EVENTS = frozenset({
     "run_start", "health_guard", "recompile", "preemption", "watchdog",
-    "anomaly",
+    "anomaly", "restart", "recovery_ladder", "checkpoint_fallback",
 })
 
 

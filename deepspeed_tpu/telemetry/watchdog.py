@@ -52,24 +52,65 @@ def heartbeat_path(directory, process_index):
     return os.path.join(directory, f"{_HB_PREFIX}{int(process_index):05d}.json")
 
 
-def read_heartbeats(directory):
-    """All parseable per-process heartbeat files in ``directory``."""
-    out = []
+def _hb_index(name):
+    """Process index encoded in a heartbeat filename, or None."""
+    try:
+        return int(name[len(_HB_PREFIX):-len(".json")])
+    except ValueError:
+        return None
+
+
+def scan_heartbeats(directory, expected_count=None):
+    """``(heartbeats, no_heartbeat)`` for ``directory``.
+
+    ``heartbeats`` is every parseable per-process heartbeat file.
+    ``no_heartbeat`` lists the processes that SHOULD have reported but
+    did not — a half-written file (killed mid-``json.dump``, though the
+    writer's tmp+``os.replace`` makes that rare), or, with
+    ``expected_count``, an index in ``range(expected_count)`` with no
+    file at all (the process died before its watchdog ever wrote).
+    Each entry is ``{"process_index", "status": "no-heartbeat",
+    "reason": "missing"|"unparseable"}`` — JSON-safe, so consumers
+    (``classify``, ``ds_tpu_metrics``, the supervisor) can report the
+    host instead of raising.
+    """
+    heartbeats = []
+    unparseable = set()
+    seen = set()
     try:
         names = sorted(os.listdir(directory))
     except OSError:
-        return out
+        names = []
     for name in names:
         if not (name.startswith(_HB_PREFIX) and name.endswith(".json")):
             continue
+        idx = _hb_index(name)
         try:
             with open(os.path.join(directory, name)) as f:
                 hb = json.load(f)
         except (OSError, ValueError):
+            if idx is not None:
+                unparseable.add(idx)
             continue
         if isinstance(hb, dict):
-            out.append(hb)
-    return out
+            heartbeats.append(hb)
+            pi = hb.get("process_index")
+            seen.add(idx if pi is None else pi)
+        elif idx is not None:
+            unparseable.add(idx)
+    expected = range(int(expected_count)) if expected_count else \
+        sorted(unparseable)
+    no_heartbeat = [
+        {"process_index": idx, "status": "no-heartbeat",
+         "reason": "unparseable" if idx in unparseable else "missing"}
+        for idx in expected if idx not in seen
+    ]
+    return heartbeats, no_heartbeat
+
+
+def read_heartbeats(directory):
+    """All parseable per-process heartbeat files in ``directory``."""
+    return scan_heartbeats(directory)[0]
 
 
 class HangWatchdog:
@@ -254,7 +295,10 @@ class HangWatchdog:
         same step with a beat at least half a deadline staler than ours
         — then we are ``waiting_on_straggler`` at the collective.
         Otherwise (no peers, or every peer at/above our step and fresh)
-        the stall is local: ``this_host_stuck``.
+        the stall is local: ``this_host_stuck``. A peer with no
+        parseable heartbeat at all (crashed before its watchdog ever
+        wrote, or killed mid-write) is the prime suspect: it is listed
+        first with ``status: "no-heartbeat"`` and null step fields.
         """
         if not self.heartbeat_dir or self.process_count <= 1:
             return VERDICT_THIS_HOST, []
@@ -262,14 +306,23 @@ class HangWatchdog:
         grace = 0.5 * (self.deadline_s() or self.min_deadline_s)
         mine = None
         peers = []
-        for hb in read_heartbeats(self.heartbeat_dir):
+        heartbeats, no_heartbeat = scan_heartbeats(
+            self.heartbeat_dir, expected_count=self.process_count)
+        for hb in heartbeats:
             if hb.get("process_index") == self.process_index:
                 mine = hb
             else:
                 peers.append(hb)
         my_step = self._step
         my_age = now - mine["t"] if mine else 0.0
-        stragglers = []
+        stragglers = [
+            {"process_index": gone["process_index"], "hostname": None,
+             "step": None, "behind_steps": None, "phase": None,
+             "beat_age_s": None, "status": "no-heartbeat",
+             "reason": gone["reason"]}
+            for gone in no_heartbeat
+            if gone["process_index"] != self.process_index
+        ]
         for hb in peers:
             step = hb.get("step", -1)
             age = now - hb.get("t", now)
@@ -284,7 +337,10 @@ class HangWatchdog:
                     "phase": hb.get("phase"),
                     "beat_age_s": round(age, 3),
                 })
-        stragglers.sort(key=lambda s: (-s["behind_steps"], -s["beat_age_s"]))
+        stragglers.sort(key=lambda s: (s.get("status") == "no-heartbeat",
+                                       s.get("behind_steps") or 0,
+                                       s.get("beat_age_s") or 0.0),
+                        reverse=True)
         if stragglers:
             return VERDICT_STRAGGLER, stragglers
         return VERDICT_THIS_HOST, []

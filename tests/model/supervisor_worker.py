@@ -1,0 +1,114 @@
+"""Fault-injected training worker for the ``ds_tpu_run`` soak test.
+
+Runs one small single-process CPU training to ``DS_TPU_SOAK_TOTAL_STEPS``
+under the supervisor's env contract, arming ONE fault only on the first
+launch (``DS_TPU_RUN_RESTART_COUNT == 0``)::
+
+    python supervisor_worker.py clean       # no fault (the oracle run)
+    python supervisor_worker.py hang        # stuck inside a step
+    python supervisor_worker.py kill        # SIGKILL mid-step
+    python supervisor_worker.py kill_save   # SIGKILL mid-checkpoint-save
+
+Everything the recovery ladder needs is per-worker under the
+supervisor's workdir: disk checkpoints in ``ckpt-p<idx>/``, the hot
+mirror in ``hot-p<idx>/``, watchdog heartbeats + flight dumps in
+``forensics-p<idx>/`` (the supervisor scans recursively, matching
+heartbeats to workers by pid), and step/recovery telemetry appended to
+``telemetry-p<idx>.jsonl`` across attempts. On completion the worker
+writes the supervisor's ``done-p<idx>`` marker.
+
+Also the CI ``supervisor-smoke`` worker: it only needs the env contract
+and a writable workdir, no accelerator.
+"""
+
+import os
+import sys
+
+# CPU + virtual devices before jax initializes a backend (same dance as
+# tests/conftest.py; standalone runs don't go through conftest).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platform_name", "cpu")
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.runtime.resilience import fault_injection  # noqa: E402
+from tests.unit.simple_model import (  # noqa: E402
+    RandomDataset,
+    base_config,
+    simple_init_params,
+    simple_loss_fn,
+)
+
+HANG_AT = int(os.environ.get("DS_TPU_SOAK_FAULT_STEP", "7"))
+TOTAL = int(os.environ.get("DS_TPU_SOAK_TOTAL_STEPS", "10"))
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "clean"
+    idx = int(os.environ.get("DS_TPU_RUN_PROCESS_INDEX", "0"))
+    restarts = int(os.environ.get("DS_TPU_RUN_RESTART_COUNT", "0"))
+    workdir = os.environ.get("DS_TPU_RUN_WORKDIR", os.getcwd())
+
+    cfg = base_config(
+        resilience={
+            "save_dir": os.path.join(workdir, f"ckpt-p{idx}"),
+            "auto_resume": True,
+            "save_interval_steps": 5,
+            "checkpoint": {"keep_last_n": 2},
+            "preemption": {"save_on_sigterm": True},
+            "fault_injection": {"enabled": True},
+            # Hot tier every step: a mid-run kill resumes from the
+            # mirror (newest step), not the older periodic disk save.
+            "hot_checkpoint": {
+                "enabled": True, "interval_steps": 1, "capacity": 2,
+                "mirror_dir": os.path.join(workdir, f"hot-p{idx}"),
+                "mirror_keep": 2},
+        },
+        telemetry={
+            "enabled": True,
+            "jsonl_path": os.path.join(workdir,
+                                       f"telemetry-p{idx}.jsonl"),
+            "crash_dump_dir": os.path.join(workdir, f"forensics-p{idx}"),
+            "watchdog": {"enabled": True, "deadline_factor": 4.0,
+                         "min_deadline_s": 1.0},
+        })
+
+    # Arm the scripted fault only before the first restart — exactly the
+    # DS_TPU_RUN_RESTART_COUNT contract production harnesses use.
+    if restarts == 0:
+        if mode == "hang":
+            fault_injection.inject_hang(at_step=HANG_AT, seconds=120.0)
+        elif mode == "kill":
+            fault_injection.inject_kill("step", at_step=HANG_AT)
+        elif mode == "kill_save":
+            fault_injection.inject_kill("checkpoint_save")
+        elif mode != "clean":
+            raise SystemExit(f"unknown worker mode {mode!r}")
+
+    params = simple_init_params(jax.random.PRNGKey(idx))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        config=cfg, params=params, loss_fn=simple_loss_fn, seed=idx,
+        training_data=RandomDataset(64, seed=idx))
+    while engine.global_steps < TOTAL:
+        engine.train_batch()
+
+    with open(os.path.join(workdir, f"done-p{idx:05d}"), "w") as f:
+        f.write(f"steps={engine.global_steps}")
+    if engine.telemetry is not None:
+        engine.telemetry.close()
+
+
+if __name__ == "__main__":
+    main()

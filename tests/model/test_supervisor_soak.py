@@ -1,0 +1,147 @@
+"""End-to-end supervisor soak (resilience PR acceptance): injected
+faults → detection → coordinated restart → bit-exact resume.
+
+Each scenario launches ``supervisor_worker.py`` under a real
+:class:`Supervisor` (separate OS processes, the production ``ds_tpu_run``
+path), arms ONE fault on the first launch, and checks:
+
+- the supervisor classifies the failure correctly (hang via watchdog
+  heartbeats, crash via exit code) and restarts within its budget;
+- the restarted worker resumes through the recovery ladder and finishes
+  with a loss curve BIT-EXACT with an uninterrupted oracle run;
+- a mid-run kill resumes from the hot mirror (newest step), measurably
+  past the newest durable disk checkpoint — the hot tier, not disk,
+  served the restart;
+- the supervisor's restart telemetry is visible to
+  ``ds_tpu_metrics summary``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.runtime.supervisor import (
+    CAUSE_CRASH,
+    CAUSE_HANG,
+    Supervisor,
+)
+from deepspeed_tpu.telemetry.cli import read_events, summarize
+
+# slow: each scenario is a real multi-process launch (subprocess oracle
+# + supervised run with kill/backoff cycles) — slow-lane / CI
+# supervisor-smoke material, not the per-commit fast lane.
+pytestmark = [pytest.mark.model, pytest.mark.faultinject,
+              pytest.mark.slow]
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "supervisor_worker.py")
+TOTAL = 10          # keep in sync with supervisor_worker.py defaults
+DISK_INTERVAL = 5   # worker's save_interval_steps
+
+
+def read_curve(jsonl_path):
+    """step -> loss, last occurrence winning (replayed steps after a
+    resume overwrite the pre-kill entries)."""
+    losses = {}
+    for line in open(jsonl_path):
+        if not line.strip():
+            continue
+        ev = json.loads(line)
+        if ev.get("event") == "step" and ev.get("loss") is not None:
+            losses[int(ev["step"])] = ev["loss"]
+    return losses
+
+
+def recovery_events(jsonl_path):
+    return [json.loads(line) for line in open(jsonl_path)
+            if line.strip()
+            and json.loads(line).get("event") == "recovery_ladder"]
+
+
+@pytest.fixture(scope="module")
+def oracle_curve(tmp_path_factory):
+    """Loss curve of one uninterrupted run (same seed/config/process
+    granularity as the supervised workers)."""
+    workdir = tmp_path_factory.mktemp("oracle")
+    env = dict(os.environ, DS_TPU_RUN_WORKDIR=str(workdir))
+    subprocess.run([sys.executable, WORKER, "clean"], check=True,
+                   env=env, cwd=str(workdir), timeout=240)
+    curve = read_curve(workdir / "telemetry-p0.jsonl")
+    assert sorted(curve) == list(range(1, TOTAL + 1))
+    return curve
+
+
+def run_supervised(workdir, mode):
+    sup = Supervisor([sys.executable, WORKER, mode], 1, str(workdir),
+                     jsonl_path=str(workdir / "sup.jsonl"),
+                     hang_timeout_s=3.0, kill_grace_s=3.0,
+                     max_restarts=3, backoff_base_s=0.1,
+                     poll_interval_s=0.2, timeout_s=240.0)
+    return sup.run()
+
+
+def assert_restart_visible_in_metrics(workdir, cause):
+    events = read_events(str(workdir / "sup.jsonl"))
+    summary = summarize(events)
+    restart = summary["events"]["restart"]
+    assert restart["count"] == 1
+    assert restart["by_cause"] == {cause: 1}
+    assert restart["mean_time_to_recover_s"] > 0
+
+
+def test_injected_hang_watchdog_restart_bit_exact(tmp_path, oracle_curve):
+    """Hung worker: the watchdog dumps its black box, the supervisor
+    sees the stuck heartbeat, SIGKILLs past the grace period (the hung
+    main thread never honors SIGTERM), and the resumed run is
+    bit-exact."""
+    result = run_supervised(tmp_path, "hang")
+    assert result.success, result
+    assert result.causes == {CAUSE_HANG: 1}
+    # the in-worker watchdog dumped before the supervisor killed it
+    dumps = list((tmp_path / "forensics-p0").glob(
+        "flight-p00000-watchdog-*.json"))
+    assert dumps, "watchdog must dump the flight record on the hang"
+    assert read_curve(tmp_path / "telemetry-p0.jsonl") == oracle_curve
+    assert_restart_visible_in_metrics(tmp_path, CAUSE_HANG)
+
+
+def test_sigkill_midstep_resumes_from_hot_mirror(tmp_path, oracle_curve):
+    """SIGKILL mid-step: classified as a crash; the fresh process
+    resumes from the hot mirror at the newest snapshotted step — beyond
+    the newest durable disk checkpoint — and stays bit-exact."""
+    result = run_supervised(tmp_path, "kill")
+    assert result.success, result
+    assert result.causes == {CAUSE_CRASH: 1}
+    recoveries = recovery_events(tmp_path / "telemetry-p0.jsonl")
+    assert len(recoveries) == 1
+    assert recoveries[0]["tier"] == "hot_mirror"
+    assert recoveries[0]["step"] > DISK_INTERVAL, (
+        "hot tier must resume past the newest disk checkpoint "
+        f"(got step {recoveries[0]['step']})")
+    assert read_curve(tmp_path / "telemetry-p0.jsonl") == oracle_curve
+    assert_restart_visible_in_metrics(tmp_path, CAUSE_CRASH)
+
+
+def test_sigkill_mid_checkpoint_save_recovers(tmp_path, oracle_curve):
+    """SIGKILL inside the durable save (tmp dir half-written): the
+    torn tmp dir must not poison the restart — the ladder serves the
+    resume and a later save still publishes a valid checkpoint."""
+    result = run_supervised(tmp_path, "kill_save")
+    assert result.success, result
+    assert result.causes == {CAUSE_CRASH: 1}
+    # The kill lands after step 5's math but before its telemetry line,
+    # and the hot tier resumes AT step 5 — so that one step's loss is
+    # legitimately unlogged. Every logged step must match the oracle,
+    # and the whole post-restart continuation must be present.
+    curve = read_curve(tmp_path / "telemetry-p0.jsonl")
+    assert all(oracle_curve[s] == v for s, v in curve.items()), (
+        "logged steps diverged from the uninterrupted oracle")
+    assert set(curve) >= set(range(DISK_INTERVAL + 1, TOTAL + 1))
+    # the post-restart periodic save published a loadable checkpoint
+    from deepspeed_tpu.runtime.resilience.checkpoint import (
+        CheckpointManager)
+    mgr = CheckpointManager(save_dir=str(tmp_path / "ckpt-p0"))
+    assert mgr.resolve_tag(str(tmp_path / "ckpt-p0")) is not None

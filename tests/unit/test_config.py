@@ -228,3 +228,33 @@ def test_compilation_cache_dir_config(tmp_path):
     finally:
         # restore the default so other tests are unaffected
         jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_hot_checkpoint_config():
+    cfg = make_config({
+        "train_batch_size": 16,
+        "resilience": {
+            "save_dir": "/tmp/ckpt",
+            "hot_checkpoint": {"enabled": True, "interval_steps": 2,
+                               "capacity": 3, "mirror_dir": "/tmp/hot",
+                               "mirror_keep": 2}}})
+    rz = cfg.resilience
+    assert rz.hot_enabled and rz.hot_interval_steps == 2
+    assert rz.hot_capacity == 3 and rz.hot_mirror_keep == 2
+    assert rz.hot_mirror_dir == "/tmp/hot"
+    # disabled by default, knobs unvalidated when off
+    assert not make_config(
+        {"train_batch_size": 16}).resilience.hot_enabled
+
+
+def test_hot_checkpoint_config_validation():
+    with pytest.raises(ValueError, match="interval_steps"):
+        make_config({
+            "train_batch_size": 16,
+            "resilience": {"hot_checkpoint": {
+                "enabled": True, "interval_steps": 0}}})
+    with pytest.raises(ValueError, match="capacity"):
+        make_config({
+            "train_batch_size": 16,
+            "resilience": {"hot_checkpoint": {
+                "enabled": True, "capacity": 0}}})

@@ -261,3 +261,50 @@ class TestGuardsWithoutInjection:
             eng.train_batch(random_batch(BATCH, seed=i))
         assert eng._last_metrics["health/scale_collapse_trips"] >= 1
         assert int(eng._last_metrics["consecutive_skipped_steps"]) >= 1
+
+
+class TestHardKillFaults:
+    """The ``kill`` seam (robustness PR satellite): hard process death
+    by self-delivered signal. In-process tests observe the delivery
+    with a catchable signal; the SIGKILL default is exercised for real
+    by the supervisor soak (tests/model/test_supervisor_soak.py)."""
+
+    def test_kill_validates_op(self, fault_registry):
+        with pytest.raises(ValueError, match="kill op"):
+            fault_registry.inject_kill("reticulate_splines")
+
+    def test_unarmed_probe_is_inert(self, fault_registry):
+        fault_registry.maybe_kill("step", step=5)   # must not signal
+
+    def test_armed_kill_fires_at_step(self, fault_registry):
+        import signal
+        hits = []
+        prev = signal.signal(signal.SIGUSR1,
+                             lambda *a: hits.append(a[0]))
+        try:
+            fault_registry.inject_kill("step", at_step=3,
+                                       signum=signal.SIGUSR1)
+            fault_registry.maybe_kill("step", step=2)   # not yet
+            assert hits == []
+            fault_registry.maybe_kill("step", step=3)
+            assert hits == [signal.SIGUSR1]
+            # one-shot: the armed entry popped on delivery
+            fault_registry.maybe_kill("step", step=4)
+            assert hits == [signal.SIGUSR1]
+        finally:
+            signal.signal(signal.SIGUSR1, prev)
+
+    def test_kill_during_checkpoint_save_op(self, fault_registry):
+        import signal
+        hits = []
+        prev = signal.signal(signal.SIGUSR1,
+                             lambda *a: hits.append(a[0]))
+        try:
+            fault_registry.inject_kill("checkpoint_save",
+                                       signum=signal.SIGUSR1)
+            fault_registry.maybe_kill("step", step=1)   # wrong op
+            assert hits == []
+            fault_registry.maybe_kill("checkpoint_save")
+            assert hits == [signal.SIGUSR1]
+        finally:
+            signal.signal(signal.SIGUSR1, prev)

@@ -40,6 +40,7 @@ from deepspeed_tpu.telemetry.watchdog import (
     VERDICT_STRAGGLER,
     VERDICT_THIS_HOST,
     heartbeat_path,
+    scan_heartbeats,
 )
 from tests.unit.simple_model import (
     base_config,
@@ -487,3 +488,55 @@ def test_watchdog_config_action_validated(tmp_path):
         "watchdog": {"enabled": True, "action": "page_oncall"}})
     with pytest.raises(ValueError, match="watchdog.action"):
         DeepSpeedConfig(cfg, world_size=1)
+
+
+# ---------------------------------------------------------------------------
+# no-heartbeat degradation: killed hosts must be reported, not raise
+# ---------------------------------------------------------------------------
+
+def test_scan_heartbeats_reports_missing_and_unparseable(tmp_path):
+    now = time.time()
+    with open(heartbeat_path(tmp_path, 0), "w") as f:
+        json.dump({"t": now, "process_index": 0, "step": 5}, f)
+    # killed mid-json.dump: truncated file
+    with open(heartbeat_path(tmp_path, 1), "w") as f:
+        f.write('{"t": 123.4, "process_ind')
+    heartbeats, no_heartbeat = scan_heartbeats(str(tmp_path),
+                                               expected_count=3)
+    assert [hb["process_index"] for hb in heartbeats] == [0]
+    assert sorted((g["process_index"], g["reason"])
+                  for g in no_heartbeat) == \
+        [(1, "unparseable"), (2, "missing")]
+    assert all(g["status"] == "no-heartbeat" for g in no_heartbeat)
+
+
+def test_scan_heartbeats_missing_dir(tmp_path):
+    heartbeats, no_heartbeat = scan_heartbeats(
+        str(tmp_path / "nope"), expected_count=2)
+    assert heartbeats == []
+    assert [g["reason"] for g in no_heartbeat] == ["missing", "missing"]
+
+
+def test_classify_blames_silent_peer_first(tmp_path):
+    """A peer killed before (or while) writing its heartbeat is the
+    prime straggler suspect — classify must rank it first with null
+    step fields instead of raising on the bad file."""
+    wd = HangWatchdog(deadline_factor=2.0, min_deadline_s=0.01,
+                      heartbeat_dir=str(tmp_path),
+                      process_index=0, process_count=3, hostname="host-a")
+    for i in range(4):
+        wd.step_end(i, 0.01)
+    wd.step_start(6)
+    wd._write_heartbeat()
+    with open(heartbeat_path(tmp_path, 1), "w") as f:
+        json.dump({"t": time.time(), "process_index": 1,
+                   "hostname": "host-b", "step": 5,
+                   "phase": "dispatch"}, f)
+    # peer 2 never wrote: SIGKILLed before its watchdog started
+    verdict, stragglers = wd.classify()
+    assert verdict == VERDICT_STRAGGLER
+    assert stragglers[0]["process_index"] == 2
+    assert stragglers[0]["status"] == "no-heartbeat"
+    assert stragglers[0]["step"] is None
+    assert stragglers[1]["process_index"] == 1
+    assert stragglers[1]["behind_steps"] == 1

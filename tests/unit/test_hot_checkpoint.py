@@ -1,0 +1,145 @@
+"""Hot-checkpoint tier unit tests: snapshot isolation, CRC
+verification, capacity eviction, and the local mirror (write, load
+against a template tree, GC, corrupt-candidate skipping).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.resilience.hotckpt import (
+    HotCheckpointCorruptError,
+    HotCheckpointStore,
+    MIRROR_LATEST_NAME,
+    MIRROR_PREFIX,
+    MIRROR_STATE_NAME,
+)
+
+
+def make_state(step):
+    return {"params": {"w": np.arange(8, dtype=np.float32) + step,
+                       "b": np.zeros(4, np.float32)},
+            "step": np.asarray(step, np.int32)}
+
+
+def make_template():
+    return {"params": {"w": np.zeros(8, np.float32),
+                       "b": np.zeros(4, np.float32)},
+            "step": np.asarray(0, np.int32)}
+
+
+@pytest.fixture
+def store():
+    s = HotCheckpointStore(capacity=2)
+    yield s
+    s.close()
+
+
+class TestRamTier:
+    def test_round_trip(self, store):
+        store.snapshot("step3", make_state(3), {"global_steps": 3},
+                       topology={"world": 1})
+        state, meta, topology = store.restore()
+        assert meta["global_steps"] == 3
+        assert topology == {"world": 1}
+        np.testing.assert_array_equal(state["params"]["w"],
+                                      make_state(3)["params"]["w"])
+
+    def test_snapshot_is_isolated(self, store):
+        """Mutating the source tree after snapshot() must not reach the
+        held copy (compiled steps donate their buffers)."""
+        src = make_state(5)
+        store.snapshot("step5", src, {})
+        src["params"]["w"][:] = -1.0
+        state, _, _ = store.restore()
+        np.testing.assert_array_equal(state["params"]["w"],
+                                      make_state(5)["params"]["w"])
+
+    def test_capacity_evicts_oldest(self, store):
+        for step in (1, 2, 3):   # capacity=2
+            store.snapshot(f"step{step}", make_state(step), {"s": step})
+        store.wait()
+        assert [s.tag for s in store._snaps] == ["step2", "step3"]
+        _, meta, _ = store.restore()
+        assert meta["s"] == 3
+
+    def test_restore_none_when_empty(self, store):
+        assert store.restore() is None
+
+    def test_corruption_detected_on_restore(self, store):
+        store.snapshot("step1", make_state(1), {})
+        store.wait()
+        store._snaps[-1].state["params"]["w"][0] += 1.0   # bit flip
+        with pytest.raises(HotCheckpointCorruptError) as ei:
+            store.restore()
+        assert "crc mismatch" in str(ei.value)
+
+    def test_unstamped_snapshot_is_corrupt(self, store):
+        snap = store.snapshot("step1", make_state(1), {})
+        store.wait()
+        snap.checksums = None
+        with pytest.raises(HotCheckpointCorruptError):
+            store.restore(snap)
+
+
+class TestMirrorTier:
+    def test_write_and_load(self, tmp_path):
+        store = HotCheckpointStore(capacity=1, mirror_dir=str(tmp_path))
+        store.snapshot("step7", make_state(7), {"global_steps": 7},
+                       topology={"world": 2})
+        store.close()
+        got = HotCheckpointStore.load_mirror(str(tmp_path),
+                                             make_template())
+        assert got is not None
+        state, meta, topology = got
+        assert meta["global_steps"] == 7
+        assert topology == {"world": 2}
+        np.testing.assert_array_equal(state["params"]["w"],
+                                      make_state(7)["params"]["w"])
+
+    def test_mirror_gc_keeps_newest(self, tmp_path):
+        store = HotCheckpointStore(capacity=1, mirror_dir=str(tmp_path),
+                                   mirror_keep=2)
+        for step in range(4):
+            store.snapshot(f"step{step}", make_state(step), {"s": step})
+            store.wait()
+        store.close()
+        kept = sorted(n for n in os.listdir(tmp_path)
+                      if n.startswith(MIRROR_PREFIX)
+                      and n != MIRROR_LATEST_NAME)
+        assert kept == ["hot-step2", "hot-step3"]
+
+    def test_load_skips_corrupt_newest(self, tmp_path):
+        store = HotCheckpointStore(capacity=1, mirror_dir=str(tmp_path),
+                                   mirror_keep=2)
+        for step in (1, 2):
+            store.snapshot(f"step{step}", make_state(step), {"s": step})
+            store.wait()
+        store.close()
+        # torn write in the newest mirror's state bytes
+        victim = tmp_path / "hot-step2" / MIRROR_STATE_NAME
+        with open(victim, "r+b") as f:
+            f.truncate(os.path.getsize(victim) // 2)
+        got = HotCheckpointStore.load_mirror(str(tmp_path),
+                                             make_template())
+        assert got is not None
+        _, meta, _ = got
+        assert meta["s"] == 1
+
+    def test_load_rejects_mismatched_template(self, tmp_path):
+        """A mirror from a different state tree (extra leaf in the
+        template) must be skipped, not half-loaded."""
+        store = HotCheckpointStore(capacity=1, mirror_dir=str(tmp_path))
+        store.snapshot("step1", make_state(1), {})
+        store.close()
+        template = make_template()
+        template["params"]["extra"] = np.zeros(2, np.float32)
+        assert HotCheckpointStore.load_mirror(str(tmp_path),
+                                              template) is None
+
+    def test_load_empty_dir(self, tmp_path):
+        assert HotCheckpointStore.load_mirror(str(tmp_path),
+                                              make_template()) is None
+        assert HotCheckpointStore.load_mirror(
+            str(tmp_path / "missing"), make_template()) is None

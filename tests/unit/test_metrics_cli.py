@@ -155,3 +155,95 @@ def test_corrupt_lines_skipped(tmp_path):
         f.write("{truncated\n\n")
     proc = run_cli("summary", str(log), "--json")
     assert json.loads(proc.stdout)["steps"] == 4
+
+
+# ---------------------------------------------------------------------------
+# resilience events + no-heartbeat degradation (robustness PR)
+# ---------------------------------------------------------------------------
+
+def write_supervisor_log(path):
+    session = TelemetrySession(exporters=[JsonlExporter(str(path))])
+    session.emit("restart", cause="hang", failed_index=1, restarts=1,
+                 world_size=2, downsize=False, backoff_s=0.5,
+                 time_to_recover_s=2.0)
+    session.emit("restart", cause="crash", failed_index=0, restarts=2,
+                 world_size=2, downsize=True, backoff_s=1.0,
+                 time_to_recover_s=4.0)
+    session.emit("recovery_ladder", tier="hot_mirror", source="/tmp/hot",
+                 step=7, duration_s=0.2)
+    session.emit("supervisor_done", success=True, reason="completed",
+                 restarts=2, downsizes=1, world_size=1)
+    session.close()
+    return path
+
+
+def test_summary_of_supervisor_log(tmp_path):
+    """A supervisor log has no step events — summary must still render
+    the restart/recovery picture instead of exiting 1."""
+    log = write_supervisor_log(tmp_path / "sup.jsonl")
+    proc = run_cli("summary", str(log), "--json")
+    s = json.loads(proc.stdout)
+    assert s["steps"] == 0
+    assert s["events"]["restart"]["count"] == 2
+    assert s["events"]["restart"]["by_cause"] == {"hang": 1, "crash": 1}
+    assert s["events"]["restart"]["mean_time_to_recover_s"] == 3.0
+    assert s["events"]["recovery_ladder"]["by_tier"] == {"hot_mirror": 1}
+    text = run_cli("summary", str(log)).stdout
+    assert "resilience:" in text
+
+
+def test_summary_counts_resilience_events_alongside_steps(tmp_path):
+    log = write_log(tmp_path / "run.jsonl")
+    session = TelemetrySession(exporters=[JsonlExporter(str(log))])
+    session.emit("recovery_ladder", tier="disk", source="/ckpt", step=4,
+                 duration_s=1.0)
+    session.emit("checkpoint_fallback", dir="/ckpt", resolved_tag="old",
+                 skipped=1, checkpoints=[{"tag": "new"}])
+    session.close()
+    proc = run_cli("summary", str(log), "--json")
+    s = json.loads(proc.stdout)
+    assert s["steps"] == 4
+    assert s["events"]["recovery_ladder"]["by_tier"] == {"disk": 1}
+    assert s["events"]["checkpoint_fallback"] == 1
+
+
+def test_aggregate_reports_unreadable_log_as_no_heartbeat(tmp_path):
+    a = write_log(tmp_path / "a.jsonl")
+    b = write_log(tmp_path / "b.jsonl", step_wall=0.2)
+    proc = run_cli("aggregate", str(a), str(b),
+                   str(tmp_path / "missing-host.jsonl"))
+    assert "NO HEARTBEAT" in proc.stdout
+    assert "missing-host.jsonl" in proc.stdout
+
+
+def test_aggregate_heartbeat_dir_reports_silent_hosts(tmp_path):
+    a = write_log(tmp_path / "a.jsonl")
+    b = write_log(tmp_path / "b.jsonl", step_wall=0.2)
+    hb_dir = tmp_path / "hb"
+    hb_dir.mkdir()
+    (hb_dir / "hb-p00000.json").write_text(json.dumps(
+        {"t": 1.0, "process_index": 0, "step": 4}))
+    (hb_dir / "hb-p00001.json").write_text('{"t": 1.0, "proc')  # torn
+    proc = run_cli("aggregate", str(a), str(b),
+                   "--heartbeats", str(hb_dir), "--expect-hosts", "3")
+    out = proc.stdout
+    assert "NO HEARTBEAT (unparseable)" in out
+    assert "NO HEARTBEAT (missing)" in out
+
+
+def test_postmortem_unreadable_dump_degrades(tmp_path):
+    """A host SIGKILLed mid-dump leaves a truncated file — postmortem
+    must explain, not stack-trace or usage-error."""
+    dump = tmp_path / "flight-p00000-crash-1.json"
+    dump.write_text('{"schema": "ds-tpu-flight/1", "rea')   # torn write
+    hb_dir = tmp_path / "hb"
+    hb_dir.mkdir()
+    (hb_dir / "hb-p00001.json").write_text(json.dumps(
+        {"t": 2.0, "process_index": 1, "step": 9, "phase": "dispatch"}))
+    proc = run_cli("postmortem", str(dump),
+                   "--heartbeats", str(hb_dir), "--expect-hosts", "2",
+                   check=False)
+    assert proc.returncode == 1          # degraded, not usage error (2)
+    err = proc.stderr
+    assert "no usable flight dump" in err
+    assert "heartbeat" in err

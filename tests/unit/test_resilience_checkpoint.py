@@ -9,6 +9,7 @@ skipped by discovery) instead of an opaque orbax traceback.
 
 import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -128,6 +129,43 @@ class TestValidationAndFallback:
         os.remove(tmp_path / "t0" / META_NAME)
         assert mgr.resolve_tag(str(tmp_path)) is None
 
+    def test_fallback_emits_durable_event(self, mgr, tmp_path):
+        """Silently resuming from an older checkpoint hides data loss:
+        the fallback must land as a checkpoint_fallback telemetry event
+        naming every checkpoint it skipped and why."""
+        from deepspeed_tpu.telemetry.session import (
+            TelemetrySession, set_default_session)
+        mgr.save(str(tmp_path), "old", make_state(1), make_meta(1))
+        mgr.save(str(tmp_path), "new", make_state(2), make_meta(2))
+        os.remove(tmp_path / "new" / META_NAME)
+        session = TelemetrySession()
+        set_default_session(session)
+        try:
+            assert mgr.resolve_tag(str(tmp_path)) == "old"
+            events = session.events.recent(event="checkpoint_fallback")
+            assert len(events) == 1
+            ev = events[0]
+            assert ev["resolved_tag"] == "old"
+            assert ev["skipped"] == 1
+            assert ev["checkpoints"][0]["tag"] == "new"
+            assert ev["checkpoints"][0]["error"] == \
+                "CheckpointCorruptError"
+        finally:
+            set_default_session(None)
+
+    def test_no_fallback_event_on_clean_resolve(self, mgr, tmp_path):
+        from deepspeed_tpu.telemetry.session import (
+            TelemetrySession, set_default_session)
+        mgr.save(str(tmp_path), "t0", make_state(0), make_meta(0))
+        session = TelemetrySession()
+        set_default_session(session)
+        try:
+            assert mgr.resolve_tag(str(tmp_path)) == "t0"
+            assert session.events.recent(event="checkpoint_fallback") \
+                == []
+        finally:
+            set_default_session(None)
+
     def test_checksum_mismatch_on_load(self, mgr, tmp_path):
         mgr.save(str(tmp_path), "t0", make_state(0), make_meta(0))
         manifest_path = tmp_path / "t0" / MANIFEST_NAME
@@ -156,10 +194,41 @@ class TestRetentionGC:
 
     def test_gc_removes_stale_tmp_dirs(self, tmp_path):
         mgr = CheckpointManager(save_dir=str(tmp_path), keep_last_n=1,
-                                io_retry_base_s=0.001)
+                                io_retry_base_s=0.001, tmp_gc_grace_s=0)
         os.makedirs(tmp_path / (TMP_PREFIX + "crashed"))
         mgr.save(str(tmp_path), "t0", make_state(0), make_meta(0))
         assert not os.path.isdir(tmp_path / (TMP_PREFIX + "crashed"))
+
+    def test_gc_spares_other_workers_inflight_tmp(self, tmp_path):
+        """Regression: a sync saver's retention GC must not delete a tmp
+        dir another process's *async* save is still writing — fresh tmp
+        dirs sit inside the grace window and survive."""
+        inflight = tmp_path / (TMP_PREFIX + "global_step9")
+        os.makedirs(inflight / "state")
+        with open(inflight / "state" / "leaf.npy", "wb") as f:
+            f.write(b"partial bytes from another process")
+        mgr = CheckpointManager(save_dir=str(tmp_path), keep_last_n=1,
+                                io_retry_base_s=0.001)   # default grace
+        mgr.save(str(tmp_path), "t0", make_state(0), make_meta(0))
+        assert os.path.isdir(inflight)
+
+    def test_gc_collects_inflight_tmp_once_stale(self, tmp_path):
+        """Same layout as above, but with the tmp dir's mtimes backdated
+        past the grace window: it is abandoned debris and must go."""
+        inflight = tmp_path / (TMP_PREFIX + "global_step9")
+        os.makedirs(inflight / "state")
+        with open(inflight / "state" / "leaf.npy", "wb") as f:
+            f.write(b"orphaned bytes")
+        old = time.time() - 3600.0
+        for dirpath, _, names in os.walk(inflight):
+            os.utime(dirpath, (old, old))
+            for n in names:
+                os.utime(os.path.join(dirpath, n), (old, old))
+        mgr = CheckpointManager(save_dir=str(tmp_path), keep_last_n=1,
+                                io_retry_base_s=0.001,
+                                tmp_gc_grace_s=900.0)
+        mgr.save(str(tmp_path), "t0", make_state(0), make_meta(0))
+        assert not os.path.isdir(inflight)
 
     def test_gc_never_removes_newest(self, tmp_path):
         mgr = CheckpointManager(save_dir=str(tmp_path), keep_last_n=1,

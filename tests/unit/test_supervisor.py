@@ -1,0 +1,174 @@
+"""Supervisor unit tests with real subprocesses: completion via done
+markers, crash/preemption/hang classification, restart budget, elastic
+downsize on a repeatedly failing slot, batch-plan env export, and the
+restart telemetry JSONL.
+
+Workers are tiny python scripts written to tmp_path — each decides its
+behaviour from the ``DS_TPU_RUN_*`` env contract (fail on attempt 1,
+succeed on attempt 2, etc.), which is exactly how the fault-injection
+soak test arms faults only before the first restart.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from deepspeed_tpu.runtime.supervisor import (
+    CAUSE_CRASH,
+    CAUSE_HANG,
+    CAUSE_PREEMPTION,
+    Supervisor,
+)
+from deepspeed_tpu.runtime.supervisor.state import (
+    REASON_COMPLETED,
+    REASON_RESTART_BUDGET,
+)
+
+pytestmark = pytest.mark.skipif(os.name == "nt",
+                                reason="POSIX signals required")
+
+# Worker preamble: the env contract, plus a done() helper matching the
+# supervisor's done_path() layout.
+PREAMBLE = """\
+import json, os, sys, time
+idx = int(os.environ["DS_TPU_RUN_PROCESS_INDEX"])
+attempt = int(os.environ["DS_TPU_RUN_ATTEMPT"])
+restarts = int(os.environ["DS_TPU_RUN_RESTART_COUNT"])
+workdir = os.environ["DS_TPU_RUN_WORKDIR"]
+
+def done():
+    with open(os.path.join(workdir, "done-p%05d" % idx), "w") as f:
+        f.write("ok")
+"""
+
+
+def write_worker(tmp_path, body):
+    script = tmp_path / "worker.py"
+    script.write_text(PREAMBLE + body)
+    return [sys.executable, str(script)]
+
+
+def make_supervisor(cmd, workdir, **kw):
+    kw.setdefault("poll_interval_s", 0.05)
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_cap_s", 0.05)
+    kw.setdefault("kill_grace_s", 2.0)
+    kw.setdefault("timeout_s", 60.0)
+    return Supervisor(cmd, kw.pop("nproc", 2), str(workdir), **kw)
+
+
+class TestLifecycle:
+    def test_all_workers_complete(self, tmp_path):
+        cmd = write_worker(tmp_path, "done()\n")
+        result = make_supervisor(cmd, tmp_path).run()
+        assert result.success and result.reason == REASON_COMPLETED
+        assert result.restarts == 0 and result.causes == {}
+
+    def test_crash_restarted_then_completes(self, tmp_path):
+        cmd = write_worker(tmp_path, """\
+if idx == 1 and attempt == 1:
+    sys.exit(3)
+done()
+""")
+        result = make_supervisor(cmd, tmp_path).run()
+        assert result.success
+        assert result.restarts == 1
+        assert result.causes == {CAUSE_CRASH: 1}
+
+    def test_clean_exit_without_marker_is_preemption(self, tmp_path):
+        cmd = write_worker(tmp_path, """\
+if restarts == 0:
+    sys.exit(0)      # clean exit, no done marker
+done()
+""")
+        result = make_supervisor(cmd, tmp_path).run()
+        assert result.success
+        assert CAUSE_PREEMPTION in result.causes
+
+    def test_restart_budget_exhausted(self, tmp_path):
+        cmd = write_worker(tmp_path, "sys.exit(1)\n")
+        result = make_supervisor(cmd, tmp_path, max_restarts=2,
+                                 downsize_after=99).run()
+        assert not result.success
+        assert result.reason == REASON_RESTART_BUDGET
+        assert result.restarts == 2
+
+    def test_hang_detected_via_heartbeat(self, tmp_path):
+        cmd = write_worker(tmp_path, """\
+if restarts == 0:
+    with open(os.path.join(workdir, "hb-p%05d.json" % idx), "w") as f:
+        json.dump({"pid": os.getpid(), "t": time.time(), "step": 4,
+                   "in_step": True, "step_elapsed_s": 999.0}, f)
+    time.sleep(120)   # hung: supervisor must SIGTERM us
+done()
+""")
+        result = make_supervisor(cmd, tmp_path, nproc=1,
+                                 hang_timeout_s=5.0).run()
+        assert result.success
+        assert result.causes == {CAUSE_HANG: 1}
+
+
+class TestElasticDownsize:
+    def test_bad_slot_triggers_downsize(self, tmp_path):
+        # slot 1 fails every time it exists; slot 0 always completes.
+        cmd = write_worker(tmp_path, """\
+if idx == 1:
+    sys.exit(1)
+done()
+""")
+        result = make_supervisor(cmd, tmp_path, max_restarts=5,
+                                 downsize_after=2, min_world_size=1).run()
+        assert result.success
+        assert result.downsizes == 1
+        assert result.world_size == 1
+
+    def test_min_world_blocks_downsize(self, tmp_path):
+        cmd = write_worker(tmp_path, "sys.exit(1)\n")
+        result = make_supervisor(cmd, tmp_path, max_restarts=3,
+                                 downsize_after=1, min_world_size=2).run()
+        assert not result.success
+        assert result.downsizes == 0
+        assert result.world_size == 2
+
+    def test_batch_plan_reexported_after_downsize(self, tmp_path):
+        cmd = write_worker(tmp_path, """\
+world = int(os.environ["DS_TPU_RUN_NUM_WORKERS"])
+micro = int(os.environ["DS_TPU_RUN_MICRO_BATCH"])
+accum = int(os.environ["DS_TPU_RUN_GRAD_ACCUM"])
+assert micro * accum * world == 8, (micro, accum, world)
+if idx == 1:
+    sys.exit(1)
+done()
+""")
+        result = make_supervisor(cmd, tmp_path, max_restarts=5,
+                                 downsize_after=1, min_world_size=1,
+                                 target_global_batch=8).run()
+        assert result.success
+        assert result.world_size == 1    # plan re-solved for world=1
+
+
+class TestTelemetry:
+    def test_restart_events_and_result_logged(self, tmp_path):
+        cmd = write_worker(tmp_path, """\
+if idx == 0 and attempt == 1:
+    sys.exit(2)
+done()
+""")
+        jsonl = tmp_path / "sup.jsonl"
+        result = make_supervisor(cmd, tmp_path,
+                                 jsonl_path=str(jsonl)).run()
+        assert result.success
+        events = [json.loads(line) for line in
+                  jsonl.read_text().splitlines() if line.strip()]
+        by_type = {}
+        for ev in events:
+            by_type.setdefault(ev.get("event"), []).append(ev)
+        restarts = by_type.get("restart", [])
+        assert len(restarts) == 1
+        ev = restarts[0]
+        assert ev["cause"] == CAUSE_CRASH
+        assert ev["failed_index"] == 0
+        assert ev["time_to_recover_s"] >= 0
+        assert by_type["supervisor_done"][0]["success"] is True
