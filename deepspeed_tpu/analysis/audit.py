@@ -58,8 +58,12 @@ STEP_FLAVORS = ("dense", "zero1", "zero2", "zero3", "offload", "quantized",
 # specific subsystems. `pipeline_tp` runs pipe x model x data with
 # tensor_parallel.overlap on, driving the overlap rule end-to-end;
 # `fp8` runs GPT-2-tiny with fp8 delayed-scaling matmuls + the
-# quantized ZeRO-3 gather wire, driving the fp8 rule end-to-end.
-EXTRA_FLAVORS = ("pipeline_tp", "fp8")
+# quantized ZeRO-3 gather wire, driving the fp8 rule end-to-end;
+# `decode` runs the serving engine (`inference/`) through a scripted
+# continuous-batching stream across two seq buckets and audits the
+# compiled decode program: zero in-loop recompiles, cache-dtype
+# hygiene, and donation of the ring-buffer KV cache.
+EXTRA_FLAVORS = ("pipeline_tp", "fp8", "decode")
 
 
 class AuditError(RuntimeError):
@@ -608,6 +612,76 @@ def build_flavor_engine(flavor, config_overrides=None):
     return engine, _toy_batch()
 
 
+def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None):
+    """Audit the serving engine's compiled decode program.
+
+    Builds a tiny :class:`~deepspeed_tpu.inference.engine.
+    InferenceEngine`, drives a scripted continuous-batching stream that
+    crosses two seq buckets with admission/eviction (more requests than
+    cache rows, mixed prompt lengths and generation budgets), then
+    lowers the decode program through its live avals (a jit-cache hit)
+    and runs the rule catalog over it — the `decode` rule pins zero
+    in-loop recompiles and cache-dtype hygiene, the generic donation
+    rule pins that the ring-buffer KV cache actually aliases in place.
+    """
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.cache import cache_dtype_census
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler, Request)
+    from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
+
+    t0 = time.perf_counter()
+    cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32)
+    model = GPT2LMHead(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    inf_cfg = {"max_batch": 2, "seq_buckets": (16, 32),
+               "prefill_chunk": 4, "kv_cache_dtype": kv_cache_dtype}
+    inf_cfg.update(config_overrides or {})
+    engine = InferenceEngine(model, params, config=inf_cfg)
+    sched = ContinuousBatchingScheduler(engine)
+    rng = np.random.default_rng(0)
+    # 5 requests over 2 rows: slot recycling, both buckets, a clamped
+    # over-budget request that length-evicts, and an open-loop arrival.
+    stream = [Request("r0", rng.integers(0, cfg.vocab_size, 3).tolist(),
+                      max_new_tokens=4),
+              Request("r1", rng.integers(0, cfg.vocab_size, 20).tolist(),
+                      max_new_tokens=6),
+              Request("r2", rng.integers(0, cfg.vocab_size, 2).tolist(),
+                      max_new_tokens=3, arrival_step=3),
+              Request("r3", rng.integers(0, cfg.vocab_size, 30).tolist(),
+                      max_new_tokens=10),
+              Request("r4", rng.integers(0, cfg.vocab_size, 6).tolist(),
+                      max_new_tokens=5)]
+    completions = sched.run(stream)
+    hlo_text, expected, pinfo = _lower_step(engine._decode,
+                                            engine.decode_lowering_args())
+    census = cache_dtype_census(engine.cache)
+    ctx = StepContext(
+        hlo_text=hlo_text, flavor="decode",
+        compute_dtype="f32" if cfg.dtype == jnp.float32 else "bf16",
+        expected_donated_params=expected, donated_param_info=pinfo,
+        declared_donate_argnums=getattr(
+            engine._decode, "_ds_donate_argnums", None),
+        decode_compile_counts=engine.compile_counts(),
+        decode_kv_cache_dtype=engine.kv_cache_dtype,
+        decode_cache_census=census,
+        skip_rules={"recompile"})
+    findings = run_rules(ctx, rules)
+    findings.extend(engine.recompile_findings())
+    report = AuditReport(flavor="decode", findings=findings)
+    report.stats = _hlo_stats(hlo_text, ctx)
+    report.hlo_text = hlo_text
+    report.stats["compile_counts"] = engine.compile_counts()
+    report.stats["completions"] = len(completions)
+    report.stats["finish_reasons"] = sorted(
+        c.finish_reason for c in completions)
+    report.stats["cache"] = engine.cache_facts()
+    report.stats["audit_wall_s"] = round(time.perf_counter() - t0, 3)
+    return report
+
+
 def audit_flavors(flavors=None, rules=None, steps=0,
                   config_overrides=None):
     """Build + audit toy engines for the stock flavors.
@@ -615,6 +689,11 @@ def audit_flavors(flavors=None, rules=None, steps=0,
     Returns ``{flavor: AuditReport}`` in the order requested."""
     out = {}
     for flavor in flavors or STEP_FLAVORS:
+        if flavor == "decode":
+            # the serving flavor audits an InferenceEngine, not a
+            # train-step engine — it has its own orchestrator.
+            out[flavor] = audit_decode(rules=rules)
+            continue
         engine, batch = build_flavor_engine(
             flavor, config_overrides=config_overrides)
         out[flavor] = audit_engine(engine, batch, rules=rules, steps=steps)

@@ -113,6 +113,18 @@ class StepContext:
     # default from param_bytes.
     peak_memory: dict = None
     peak_budget_bytes: int = 0
+    # Serving audit (`inference/engine.py`): decode_compile_counts is
+    # the engine's {"prefill": n, "decode": n} AFTER a scripted stream
+    # exercised admit/evict across >= 2 seq buckets — any program above
+    # decode_expected_compiles means a shape leaked into a jit boundary
+    # and the serving loop recompiled mid-stream. decode_cache_census
+    # ({dtype: payload leaf count} from `cache_dtype_census`) plus the
+    # configured decode_kv_cache_dtype pin cache-storage hygiene: one
+    # payload dtype, and the codec's dtype when quantization is on.
+    decode_compile_counts: dict = None
+    decode_expected_compiles: int = 1
+    decode_kv_cache_dtype: str = None
+    decode_cache_census: dict = None
     skip_rules: set = field(default_factory=set)
 
 
@@ -664,6 +676,64 @@ def rule_fp8(ctx):
     return findings
 
 
+def rule_decode(ctx):
+    """The serving loop's recompile contract and cache-dtype hygiene.
+
+    The decode engine compiles exactly two programs (chunked prefill +
+    decode) and reuses them for the whole serve; admission, eviction
+    and seq buckets are host-side bookkeeping that must never reach a
+    jit boundary. ``decode_compile_counts`` is the engine's jit-cache
+    census after a stream crossed bucket sizes — growth past
+    ``decode_expected_compiles`` is the mid-stream recompile the whole
+    design exists to prevent (every extra entry stalls live requests
+    for a full XLA compile).
+
+    Cache hygiene: the KV cache's payload leaves must store ONE dtype,
+    and when ``kv_cache_dtype`` names a codec it must be that codec's
+    dtype — a mixed or full-precision census means some layer's cache
+    silently skipped quantization and the promised HBM saving is gone.
+    """
+    if ctx.decode_compile_counts is None and ctx.decode_cache_census is None:
+        return []
+    findings = []
+    for prog, n in sorted((ctx.decode_compile_counts or {}).items()):
+        if n is not None and n > ctx.decode_expected_compiles:
+            findings.append(Finding(
+                "decode", SEV_ERROR,
+                f"serving {prog} program accumulated {n} jit cache "
+                f"entries (expected {ctx.decode_expected_compiles}) — "
+                f"a shape or dtype leaked into the compiled boundary "
+                f"and the decode loop recompiled mid-stream",
+                {"program": prog, "cache_size": n,
+                 "expected": ctx.decode_expected_compiles}))
+    census = ctx.decode_cache_census
+    if census:
+        if len(census) > 1:
+            findings.append(Finding(
+                "decode", SEV_ERROR,
+                f"KV cache payload leaves store mixed dtypes "
+                f"{sorted(census)} — every layer's cache must share one "
+                f"storage dtype",
+                {"census": dict(census),
+                 "kv_cache_dtype": ctx.decode_kv_cache_dtype}))
+        from deepspeed_tpu.runtime.comm.codecs import CODECS
+        codec = CODECS.get(ctx.decode_kv_cache_dtype)
+        if codec is not None:
+            import jax.numpy as jnp
+            want = str(jnp.dtype(codec.dtype))
+            stray = sorted(dt for dt in census if dt != want)
+            if stray:
+                findings.append(Finding(
+                    "decode", SEV_ERROR,
+                    f"kv_cache_dtype={ctx.decode_kv_cache_dtype!r} "
+                    f"promises {want} cache storage but payload leaves "
+                    f"store {stray} — quantization silently skipped; "
+                    f"the promised KV HBM saving is not happening",
+                    {"census": dict(census), "expected_dtype": want,
+                     "kv_cache_dtype": ctx.decode_kv_cache_dtype}))
+    return findings
+
+
 # Rule catalog: id -> rule. `recompile` is listed for config validation
 # but runs in the orchestrator (it needs live step objects, not HLO).
 RULES = {
@@ -677,6 +747,7 @@ RULES = {
     "resharding": rule_resharding,
     "peak_memory": rule_peak_memory,
     "fp8": rule_fp8,
+    "decode": rule_decode,
 }
 RULE_IDS = tuple(RULES) + ("recompile",)
 

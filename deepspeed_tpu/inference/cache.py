@@ -1,0 +1,276 @@
+"""Bucketed ring-buffer KV cache.
+
+One cache = one statically-shaped buffer per layer, ``[max_batch,
+max_seq, n_head, head_dim]`` for keys and values (``scan_layers``
+models stack a leading layer axis so the whole cache rides the same
+``lax.scan`` as the params). Rows are the ring: a finished request's
+row is handed to the next admitted request and simply overwritten —
+admission/eviction never changes a compiled shape, which is what keeps
+the decode loop at exactly one compile (`engine.compile_counts`).
+
+Causality comes from explicit positions, not shapes: every write lands
+at the token's absolute position and every read masks cache index
+``s`` unless ``s <= query position``. A slot past a row's live prefix
+is either stale (from the row's previous tenant) or garbage from a
+padded prefill chunk — both masked, and both overwritten before the
+mask ever exposes them (the decode step writes position ``p`` before
+attending to it).
+
+Optional int8/fp8 storage reuses the wire-codec recipe from
+``runtime/comm/codecs.py`` (absmax scale into the codec's ``qmax``,
+zero guard, round+clip for int) at per-(row, position, head) scale
+granularity — one f32 scale per head vector, the KV analog of the
+per-chunk wire scales.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.comm.codecs import CODECS, get_codec
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheSpec:
+    """Static shape + storage format of one engine's KV cache."""
+    n_layer: int
+    max_batch: int
+    max_seq: int
+    n_head: int
+    head_dim: int
+    dtype: Any = jnp.bfloat16       # storage dtype (codec dtype when quantized)
+    codec: Optional[str] = None     # None | "int8" | "f8e4m3fn" | "f8e5m2"
+    stacked: bool = False           # scan_layers layout (leading layer axis)
+
+
+def spec_for_model(cfg, max_batch, max_seq, kv_cache_dtype=None):
+    """Resolve a :class:`KVCacheSpec` from a ``GPT2Config`` and the
+    ``inference.kv_cache_dtype`` knob (None = model compute dtype,
+    "bf16"/"f32" = plain storage, a codec name = quantized storage)."""
+    codec = None
+    if kv_cache_dtype is None:
+        dtype = cfg.dtype
+    elif kv_cache_dtype == "bf16":
+        dtype = jnp.bfloat16
+    elif kv_cache_dtype in ("f32", "fp32"):
+        dtype = jnp.float32
+    elif kv_cache_dtype in CODECS:
+        codec = kv_cache_dtype
+        dtype = CODECS[kv_cache_dtype].dtype
+    else:
+        raise ValueError(
+            f"kv_cache_dtype must be None, 'bf16', 'f32', or a codec "
+            f"name from {sorted(CODECS)}; got {kv_cache_dtype!r}")
+    if max_seq > cfg.n_positions:
+        raise ValueError(
+            f"max seq bucket {max_seq} exceeds the model's n_positions "
+            f"{cfg.n_positions}")
+    return KVCacheSpec(
+        n_layer=cfg.n_layer, max_batch=int(max_batch),
+        max_seq=int(max_seq), n_head=cfg.n_head,
+        head_dim=cfg.n_embd // cfg.n_head, dtype=dtype, codec=codec,
+        stacked=bool(cfg.scan_layers))
+
+
+def _layer_leaves(spec):
+    shape = (spec.max_batch, spec.max_seq, spec.n_head, spec.head_dim)
+    leaves = {"k": jnp.zeros(shape, spec.dtype),
+              "v": jnp.zeros(shape, spec.dtype)}
+    if spec.codec is not None:
+        sshape = shape[:-1]
+        leaves["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        leaves["v_scale"] = jnp.zeros(sshape, jnp.float32)
+    return leaves
+
+
+def init_kv_cache(spec):
+    """Zero-filled cache pytree keyed like the model's params: per-layer
+    ``h_<i>`` subtrees (unrolled) or one stacked ``h`` subtree
+    (``scan_layers``)."""
+    layer = _layer_leaves(spec)
+    if spec.stacked:
+        return {"h": jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a, (spec.n_layer,) + a.shape),
+            layer)}
+    return {f"h_{i}": jax.tree_util.tree_map(jnp.array, layer)
+            for i in range(spec.n_layer)}
+
+
+def kv_cache_nbytes(cache):
+    return sum(int(leaf.nbytes)
+               for leaf in jax.tree_util.tree_leaves(cache))
+
+
+def cache_dtype_census(cache):
+    """``{dtype_str: leaf count}`` over the cache's k/v payload leaves
+    (scales excluded) — the decode audit's cache-dtype-hygiene fact."""
+    census = {}
+    flat = jax.tree_util.tree_flatten_with_path(cache)[0]
+    for path, leaf in flat:
+        key = str(getattr(path[-1], "key", path[-1]))
+        if key.endswith("_scale"):
+            continue
+        dt = str(jnp.dtype(leaf.dtype))
+        census[dt] = census.get(dt, 0) + 1
+    return census
+
+
+def kv_partition_specs(spec, model_axis="model"):
+    """PartitionSpecs sharding the cache's head axis over the TP mesh
+    axis — the cache analog of the model's Megatron column-parallel QKV
+    (`models/gpt2.py:gpt2_partition_specs`): each TP shard holds the
+    heads it computes, so decode attention runs collective-free and the
+    row-parallel ``c_proj`` psum GSPMD inserts is the only combine."""
+    from jax.sharding import PartitionSpec as P
+    lead = (None,) if spec.stacked else ()
+    # no trailing None after the sharded head axis: jit keys compiled
+    # programs on the exact sharding object, and GSPMD canonicalizes
+    # output specs without trailing Nones — a trailing-None input spec
+    # would mismatch the pinned output and recompile on the 2nd call.
+    payload = P(*lead, None, None, model_axis)
+    scale = P(*lead, None, None, model_axis)
+
+    def per_layer():
+        leaves = {"k": payload, "v": payload}
+        if spec.codec is not None:
+            leaves["k_scale"] = scale
+            leaves["v_scale"] = scale
+        return leaves
+
+    if spec.stacked:
+        return {"h": per_layer()}
+    return {f"h_{i}": per_layer() for i in range(spec.n_layer)}
+
+
+# ---------------------------------------------------------------------------
+# in-jit cache ops (used by models/gpt2.py's cached attention path)
+# ---------------------------------------------------------------------------
+
+def _codec_of(layer_cache):
+    """Recover the storage codec from the cache leaves themselves (a
+    traced pytree can't carry the name): quantized caches are the ones
+    with scale leaves, and the payload dtype names the codec."""
+    if "k_scale" not in layer_cache:
+        return None
+    dt = jnp.dtype(layer_cache["k"].dtype)
+    for codec in CODECS.values():
+        if jnp.dtype(codec.dtype) == dt:
+            return codec
+    raise ValueError(
+        f"quantized KV cache stores dtype {dt} which matches no codec "
+        f"in {sorted(CODECS)}")
+
+
+def _quantize(x, codec):
+    """Per-(row, position, head) absmax quantization — the
+    ``encode_chunks`` recipe with the head vector as the chunk."""
+    codec = get_codec(codec)
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = absmax / codec.qmax
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    scaled = xf / safe[..., None]
+    if codec.integer:
+        q = jnp.clip(jnp.round(scaled), -codec.qmax, codec.qmax)
+    else:
+        q = jnp.clip(scaled, -codec.qmax, codec.qmax)
+    return q.astype(codec.dtype), scale
+
+
+def _dequantize(q, scale, dtype):
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def _row_write(buf, new, start):
+    """Write ``new`` [B, T, ...] into ``buf`` [B, S, ...] at per-row
+    offsets ``start`` [B] (positions are contiguous per row, so one
+    dynamic_update_slice per row covers the whole chunk)."""
+    def one(row_buf, row_new, p):
+        idx = (p,) + (0,) * (row_buf.ndim - 1)
+        return jax.lax.dynamic_update_slice(row_buf, row_new, idx)
+    return jax.vmap(one)(buf, new, start)
+
+
+def write_kv(layer_cache, k_new, v_new, positions):
+    """Write one chunk's keys/values (``[B, T, H, D]``, compute dtype)
+    into a layer's cache at ``positions`` [B, T]; quantizes on the way
+    in when the cache stores a codec dtype."""
+    codec = _codec_of(layer_cache)
+    start = positions[:, 0]
+    if codec is None:
+        dt = layer_cache["k"].dtype
+        return {"k": _row_write(layer_cache["k"], k_new.astype(dt), start),
+                "v": _row_write(layer_cache["v"], v_new.astype(dt), start)}
+    k_q, k_s = _quantize(k_new, codec)
+    v_q, v_s = _quantize(v_new, codec)
+    return {
+        "k": _row_write(layer_cache["k"], k_q, start),
+        "v": _row_write(layer_cache["v"], v_q, start),
+        "k_scale": _row_write(layer_cache["k_scale"], k_s, start),
+        "v_scale": _row_write(layer_cache["v_scale"], v_s, start),
+    }
+
+
+def read_kv(layer_cache, dtype):
+    """The full ``[B, S, H, D]`` key/value buffers in compute ``dtype``
+    (dequantized when stored quantized)."""
+    codec = _codec_of(layer_cache)
+    if codec is None:
+        return (layer_cache["k"].astype(dtype),
+                layer_cache["v"].astype(dtype))
+    return (_dequantize(layer_cache["k"], layer_cache["k_scale"], dtype),
+            _dequantize(layer_cache["v"], layer_cache["v_scale"], dtype))
+
+
+def cached_attention(q, k_new, v_new, layer_cache, positions,
+                     compute_dtype):
+    """Write this chunk's k/v, then attend over the whole cache row.
+
+    ``q``/``k_new``/``v_new``: ``[B, T, H, D]`` (T = 1 for a decode
+    step, ``prefill_chunk`` for a prefill chunk); ``positions``:
+    ``[B, T]`` absolute token positions, contiguous per row. Returns
+    ``(y [B, T, H, D], updated layer_cache)``.
+
+    The mask admits cache index ``s`` for the query at position ``p``
+    iff ``s <= p`` — the cached generalization of the training path's
+    ``tril(T, T)``: within a prefill chunk it reproduces the triangle,
+    across chunks it exposes exactly the already-written prefix, and
+    for padded chunk tails / recycled-row remnants it hides everything
+    until a real token overwrites the slot.
+    """
+    layer_cache = write_kv(layer_cache, k_new, v_new, positions)
+    k_full, v_full = read_kv(layer_cache, compute_dtype)
+    S = k_full.shape[1]
+    D = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, compute_dtype))
+    att = jnp.einsum("bthd,bshd->bhts", q, k_full) * scale
+    mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]
+    att = jnp.where(mask[:, None], att, jnp.finfo(att.dtype).min)
+    att = jax.nn.softmax(att.astype(jnp.float32),
+                         axis=-1).astype(compute_dtype)
+    y = jnp.einsum("bhts,bshd->bthd", att, v_full)
+    return y, layer_cache
+
+
+def slice_rows(cache, slot, stacked, rows=1):
+    """The ``rows``-row sub-cache starting at row ``slot`` (a traced
+    scalar is fine — this is how the prefill jit addresses its target
+    row without baking the slot into the compiled program)."""
+    axis = 1 if stacked else 0
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_slice_in_dim(a, slot, rows, axis=axis),
+        cache)
+
+
+def update_rows(cache, rows_tree, slot, stacked):
+    """Inverse of :func:`slice_rows`: write an updated row block back."""
+    axis = 1 if stacked else 0
+
+    def upd(a, r):
+        idx = [0] * a.ndim
+        idx[axis] = slot
+        return jax.lax.dynamic_update_slice(a, r, tuple(idx))
+
+    return jax.tree_util.tree_map(upd, cache, rows_tree)

@@ -1,0 +1,272 @@
+"""InferenceEngine: the two compiled programs of the serving path.
+
+Exactly two jits, compiled once each, reused for the whole serve:
+
+- **prefill** — one chunk of one prompt: ``[1, prefill_chunk]`` tokens
+  at explicit positions, written into cache row ``slot`` (a traced
+  scalar, so any row reuses the same program). Long prompts are a host
+  loop over same-shaped chunks — prompt length never reaches a jit
+  boundary, so it can't recompile the loop and a long prompt never
+  forces a fresh XLA program while decodes wait.
+- **decode** — one token for every row at once: ``[max_batch]`` tokens
+  at per-row positions over the full cache. Inactive rows compute
+  garbage at position 0 and the scheduler ignores them; their writes
+  land on free rows that prefill overwrites at admission.
+
+Everything shape-varying (number of live requests, prompt lengths, per
+-request sequence budgets a.k.a. ``seq_buckets``) is host-side
+bookkeeping padded to these two static shapes, which is the whole
+recompile contract: :meth:`compile_counts` must read ``{"prefill": 1,
+"decode": 1}`` from warmup to drain, and :meth:`recompile_findings`
+turns any growth into the PR 4 detector's error finding.
+
+With a mesh whose ``model`` axis is >1 the engine places params with
+the model's Megatron PartitionSpecs (`models/gpt2.py:
+gpt2_partition_specs` — the `parallel/tensor_parallel.py` layout) and
+the cache with head-sharded specs (`cache.kv_partition_specs`), so
+decode matmuls and attention run tensor-parallel with GSPMD inserting
+the row-parallel psums.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.analysis.audit import donated_jit
+from deepspeed_tpu.inference.cache import (
+    cache_dtype_census,
+    init_kv_cache,
+    kv_cache_nbytes,
+    kv_partition_specs,
+    slice_rows,
+    spec_for_model,
+    update_rows,
+)
+
+DEFAULT_MAX_BATCH = 8
+DEFAULT_SEQ_BUCKETS = (128, 512)
+DEFAULT_PREFILL_CHUNK = 32
+DEFAULT_MAX_NEW_TOKENS = 64
+
+
+def _cfg_get(config, key, default):
+    if config is None:
+        return default
+    if isinstance(config, dict):
+        v = config.get(key, default)
+    else:
+        v = getattr(config, key, default)
+    return default if v is None else v
+
+
+class InferenceEngine:
+    """Jitted autoregressive decode over a GPT-2 family model.
+
+    ``model`` is a :class:`~deepspeed_tpu.models.gpt2.GPT2LMHead`
+    (unrolled or ``scan_layers``); ``params`` its param tree (matching
+    layout). ``config`` is the validated ``inference`` block
+    (`runtime/config.py:InferenceConfig`) or a plain dict with the same
+    keys; ``session`` an optional
+    :class:`~deepspeed_tpu.telemetry.session.TelemetrySession` the
+    scheduler emits ``decode_step`` events through.
+    """
+
+    def __init__(self, model, params, config=None, mesh=None,
+                 session=None):
+        self.model = model
+        cfg = model.config
+        self.max_batch = int(_cfg_get(config, "max_batch",
+                                      DEFAULT_MAX_BATCH))
+        buckets = _cfg_get(config, "seq_buckets", DEFAULT_SEQ_BUCKETS)
+        self.seq_buckets = tuple(sorted(int(b) for b in buckets))
+        self.prefill_chunk = int(_cfg_get(config, "prefill_chunk",
+                                          DEFAULT_PREFILL_CHUNK))
+        self.kv_cache_dtype = _cfg_get(config, "kv_cache_dtype", None)
+        self.max_new_tokens = int(_cfg_get(config, "max_new_tokens",
+                                           DEFAULT_MAX_NEW_TOKENS))
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got "
+                             f"{self.max_batch}")
+        if not self.seq_buckets or min(self.seq_buckets) < 1:
+            raise ValueError(f"seq_buckets must be non-empty positive "
+                             f"ints, got {self.seq_buckets}")
+        if self.prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{self.prefill_chunk}")
+        for b in self.seq_buckets:
+            if b % self.prefill_chunk:
+                # buckets gate how far a row may fill; chunk-aligned
+                # buckets keep padded prefill writes inside the buffer.
+                raise ValueError(
+                    f"every seq bucket must be a multiple of "
+                    f"prefill_chunk={self.prefill_chunk}; got bucket {b}")
+        self.max_seq = max(self.seq_buckets)
+        self.spec = spec_for_model(cfg, self.max_batch, self.max_seq,
+                                   self.kv_cache_dtype)
+        self.mesh = mesh
+        self.session = session
+
+        self._cache_shardings = None
+        if mesh is not None and dict(mesh.shape).get("model", 1) > 1:
+            from jax.sharding import NamedSharding
+            from deepspeed_tpu.models.gpt2 import gpt2_partition_specs
+            params = jax.tree_util.tree_map(
+                lambda leaf, spec: jax.device_put(
+                    leaf, NamedSharding(mesh, spec)),
+                params, gpt2_partition_specs(params))
+            self._cache_shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec),
+                kv_partition_specs(self.spec),
+                is_leaf=lambda x: not isinstance(x, dict))
+            cache = jax.tree_util.tree_map(
+                jax.device_put, init_kv_cache(self.spec),
+                self._cache_shardings)
+        else:
+            cache = init_kv_cache(self.spec)
+        self.params = params
+        self.cache = cache
+
+        # cache (arg 1) is donated in both programs: the ring buffer
+        # updates in place instead of doubling HBM every step.
+        self._prefill = donated_jit(self._prefill_fn, donate_argnums=(1,))
+        self._decode = donated_jit(self._decode_fn, donate_argnums=(1,))
+
+    # -- compiled programs --------------------------------------------------
+
+    def _pin_cache(self, cache):
+        """Constrain the output cache to the same shardings the input
+        carries: without the pin GSPMD may pick a different output
+        layout, and the NEXT call's changed input shardings would cost
+        the recompile the whole engine exists to avoid."""
+        if self._cache_shardings is None:
+            return cache
+        return jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, cache,
+            self._cache_shardings)
+
+    def _prefill_fn(self, params, cache, tokens, positions, slot):
+        row = slice_rows(cache, slot, self.spec.stacked)
+        logits, new_row = self.model.apply(
+            {"params": params}, tokens, deterministic=True,
+            positions=positions, kv_cache=row)
+        cache = update_rows(cache, new_row, slot, self.spec.stacked)
+        # fp32 on the way out: host-side sampling/parity reads full
+        # precision regardless of compute dtype (a no-op for f32 models,
+        # so fp32 parity with the full forward stays bit-exact).
+        return logits.astype(jnp.float32), self._pin_cache(cache)
+
+    def _decode_fn(self, params, cache, tokens, positions):
+        logits, cache = self.model.apply(
+            {"params": params}, tokens[:, None], deterministic=True,
+            positions=positions[:, None], kv_cache=cache)
+        logits = logits[:, 0]
+        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return next_tokens, logits.astype(jnp.float32), \
+            self._pin_cache(cache)
+
+    # -- host API -----------------------------------------------------------
+
+    def prefill(self, slot, prompt):
+        """Chunked prefill of ``prompt`` (token ids) into cache row
+        ``slot``; returns the fp-logits at the last prompt token
+        (``[vocab]``, numpy) — what greedy sampling of the first
+        generated token reads."""
+        n = len(prompt)
+        if not 0 < n <= self.max_seq:
+            raise ValueError(
+                f"prompt length {n} outside (0, max_seq={self.max_seq}]")
+        chunk = self.prefill_chunk
+        padded = -(-n // chunk) * chunk
+        toks = np.zeros((1, padded), np.int32)
+        toks[0, :n] = np.asarray(prompt, np.int32)
+        last_chunk = (n - 1) // chunk
+        last = None
+        for ci in range(padded // chunk):
+            tc = jnp.asarray(toks[:, ci * chunk:(ci + 1) * chunk])
+            pc = jnp.arange(ci * chunk, (ci + 1) * chunk,
+                            dtype=jnp.int32)[None, :]
+            logits, self.cache = self._prefill(
+                self.params, self.cache, tc, pc,
+                jnp.asarray(slot, jnp.int32))
+            if ci == last_chunk:
+                last = np.asarray(logits[0, (n - 1) % chunk])
+        return last
+
+    def decode(self, tokens, positions):
+        """One decode step for every cache row at once. ``tokens`` /
+        ``positions``: ``[max_batch]`` int arrays (inactive rows padded
+        with zeros — their outputs are meaningless and ignored).
+        Returns ``(next_tokens [max_batch], logits [max_batch, vocab])``
+        as numpy; greedy argmax happens in-program so sampling costs no
+        extra device round trip."""
+        t = jnp.asarray(np.asarray(tokens, np.int32))
+        p = jnp.asarray(np.asarray(positions, np.int32))
+        nxt, logits, self.cache = self._decode(self.params, self.cache,
+                                               t, p)
+        return np.asarray(nxt), np.asarray(logits)
+
+    def reset(self):
+        """Zero the cache (rows all free). Compiled programs survive —
+        a reset must not cost a recompile."""
+        cache = init_kv_cache(self.spec)
+        if self._cache_shardings is not None:
+            cache = jax.tree_util.tree_map(
+                jax.device_put, cache, self._cache_shardings)
+        self.cache = cache
+
+    # -- recompile detector + audit surface ---------------------------------
+
+    def compile_counts(self):
+        """Jit-cache entry counts ``{"prefill": n, "decode": n}`` — the
+        serving analog of `analysis/audit.py:compiled_cache_size`. 1/1
+        after warmup and FOREVER after is the contract; growth means a
+        shape or dtype leaked into a compiled boundary."""
+        out = {}
+        for name, fn in (("prefill", self._prefill),
+                         ("decode", self._decode)):
+            cs = getattr(fn, "_cache_size", None)
+            try:
+                out[name] = int(cs()) if callable(cs) else None
+            except Exception:
+                out[name] = None
+        return out
+
+    def recompile_findings(self, baseline=1):
+        """In-engine recompile detector: error Findings when either
+        compiled program's cache outgrew ``baseline`` entries."""
+        from deepspeed_tpu.analysis.rules import SEV_ERROR, Finding
+        findings = []
+        for name, n in self.compile_counts().items():
+            if n is not None and n > baseline:
+                findings.append(Finding(
+                    "decode", SEV_ERROR,
+                    f"{name} program has {n} jit cache entries "
+                    f"(expected {baseline}) — the serving loop "
+                    f"recompiled mid-stream",
+                    {"program": name, "cache_size": n,
+                     "expected": baseline}))
+        return findings
+
+    def decode_lowering_args(self):
+        """The exact avals :meth:`decode` calls with — lowering through
+        these is a jit-cache hit, never a fresh compile."""
+        return (self.params, self.cache,
+                jnp.zeros((self.max_batch,), jnp.int32),
+                jnp.zeros((self.max_batch,), jnp.int32))
+
+    def decode_hlo(self):
+        """Compiled HLO text of the decode program (audit/bench food)."""
+        args = self.decode_lowering_args()
+        return self._decode.lower(*args).compile().as_text()
+
+    def cache_facts(self):
+        """Static cache facts for audits and the bench row."""
+        return {"bytes": kv_cache_nbytes(self.cache),
+                "dtype_census": cache_dtype_census(self.cache),
+                "kv_cache_dtype": self.kv_cache_dtype,
+                "max_batch": self.max_batch,
+                "max_seq": self.max_seq,
+                "seq_buckets": list(self.seq_buckets),
+                "prefill_chunk": self.prefill_chunk,
+                "stacked": self.spec.stacked}

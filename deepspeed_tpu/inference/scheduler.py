@@ -1,0 +1,198 @@
+"""Continuous batching: the host-side admit/evict/pad loop.
+
+The compiled decode step always runs the full ``[max_batch]`` row
+block; this scheduler is everything around it — an open-loop request
+queue, slot assignment (the ring: a finished request's row goes
+straight to the next arrival), per-request sequence budgets from
+``seq_buckets``, and the pad arrays that keep inactive rows
+shape-stable. None of it touches a jit boundary, so admission, buckets
+and eviction are recompile-free by construction (and the engine's
+compile counters prove it).
+
+Buckets: a request's budget is the smallest ``seq_bucket`` that fits
+``prompt + max_new_tokens`` (clamped to the largest). The bucket caps
+how far the row may fill — a metadata cap, deliberately NOT a compiled
+shape — so short requests get admission-control/accounting granularity
+without buying per-bucket XLA programs.
+
+Every decode step emits one ``decode_step`` telemetry event (tokens
+produced, live batch, occupancy, queue depth, host wall) through the
+session, feeding ``ds_tpu_metrics summary``'s serve mode and the
+registry's ``decode_*`` metric families.
+"""
+
+import collections
+import dataclasses
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``arrival_step``>0 makes the stream
+    open-loop: the scheduler won't admit the request before its decode
+    step count reaches it (deterministic synthetic load for benches and
+    tests)."""
+    rid: str
+    prompt: List[int]
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+    arrival_step: int = 0
+
+
+@dataclasses.dataclass
+class Completion:
+    rid: str
+    prompt_len: int
+    tokens: List[int]           # generated ids (includes eos when hit)
+    finish_reason: str          # "max_new_tokens" | "eos" | "length"
+    bucket: int
+    slot: int
+    steps: int                  # decode steps this request was live for
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request
+    bucket: int
+    next_pos: int               # position the pending token feeds at
+    pending: int                # last sampled token (next decode input)
+    generated: List[int]
+    admitted_step: int
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, engine, session=None):
+        self.engine = engine
+        self.session = session if session is not None else engine.session
+        self.queue = collections.deque()
+        self.slots = [None] * engine.max_batch
+        self.step_count = 0
+        self.completions = []
+
+    # -- request lifecycle --------------------------------------------------
+
+    def submit(self, request):
+        if not request.prompt:
+            raise ValueError(f"request {request.rid}: empty prompt")
+        if len(request.prompt) >= self.engine.max_seq:
+            raise ValueError(
+                f"request {request.rid}: prompt length "
+                f"{len(request.prompt)} does not fit the largest seq "
+                f"bucket {self.engine.max_seq}")
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"request {request.rid}: max_new_tokens must be >= 1")
+        self.queue.append(request)
+
+    def _bucket_for(self, request):
+        need = len(request.prompt) + request.max_new_tokens
+        for b in self.engine.seq_buckets:
+            if need <= b:
+                return b
+        return self.engine.max_seq      # clamp: generation truncates
+
+    def _finish(self, i, reason):
+        s = self.slots[i]
+        self.completions.append(Completion(
+            rid=s.request.rid, prompt_len=len(s.request.prompt),
+            tokens=list(s.generated), finish_reason=reason, bucket=s.bucket,
+            slot=i, steps=self.step_count - s.admitted_step))
+        self.slots[i] = None            # row back on the ring
+
+    def _check_finished(self, i):
+        s = self.slots[i]
+        if s.request.eos_id is not None and \
+                s.pending == s.request.eos_id:
+            self._finish(i, "eos")
+        elif len(s.generated) >= s.request.max_new_tokens:
+            self._finish(i, "max_new_tokens")
+        elif s.next_pos >= s.bucket:
+            # bucket budget exhausted: evict (truncated generation)
+            self._finish(i, "length")
+
+    def _admit(self):
+        for i in range(len(self.slots)):
+            if self.slots[i] is not None:
+                continue
+            if not self.queue or \
+                    self.queue[0].arrival_step > self.step_count:
+                break
+            req = self.queue.popleft()
+            last_logits = self.engine.prefill(i, req.prompt)
+            first = int(np.argmax(last_logits))
+            self.slots[i] = _Slot(
+                request=req, bucket=self._bucket_for(req),
+                next_pos=len(req.prompt), pending=first,
+                generated=[first], admitted_step=self.step_count)
+            self._check_finished(i)
+
+    # -- the decode loop ----------------------------------------------------
+
+    def step(self):
+        """Admit what the queue allows, then run one compiled decode
+        step over the live rows. Returns True while there is (or will
+        be) work left."""
+        self._admit()
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            self.step_count += 1        # idle tick (open-loop gap)
+            return bool(self.queue)
+        mb = self.engine.max_batch
+        tokens = np.zeros(mb, np.int32)
+        positions = np.zeros(mb, np.int32)
+        for i in active:
+            tokens[i] = self.slots[i].pending
+            positions[i] = self.slots[i].next_pos
+        t0 = time.perf_counter()
+        next_tokens, _ = self.engine.decode(tokens, positions)
+        wall = time.perf_counter() - t0
+        self.step_count += 1
+        for i in active:
+            s = self.slots[i]
+            s.next_pos += 1
+            s.pending = int(next_tokens[i])
+            s.generated.append(s.pending)
+            self._check_finished(i)
+        self._emit(len(active), wall)
+        return bool(self.queue) or any(s is not None for s in self.slots)
+
+    def run(self, requests=None, max_steps=100000):
+        """Drain ``requests`` (plus anything already queued) through the
+        decode loop; returns the completions in finish order."""
+        for r in requests or ():
+            self.submit(r)
+        steps = 0
+        while steps < max_steps:
+            if not self.step():
+                break
+            steps += 1
+        return list(self.completions)
+
+    # -- telemetry ----------------------------------------------------------
+
+    def occupancy(self):
+        live = sum(1 for s in self.slots if s is not None)
+        return live / float(self.engine.max_batch)
+
+    def _emit(self, batch, wall_s):
+        if self.session is None:
+            return
+        occ = batch / float(self.engine.max_batch)
+        self.session.emit(
+            "decode_step", step=self.step_count, tokens=batch,
+            batch=batch, occupancy=occ, queue_depth=len(self.queue),
+            wall_s=wall_s)
+        reg = self.session.registry
+        reg.histogram("decode_step_seconds",
+                      help="host wall per compiled decode step").observe(
+                          wall_s)
+        reg.counter("decode_tokens_total",
+                    help="tokens generated by decode steps").inc(batch)
+        reg.gauge("decode_batch_occupancy",
+                  help="live rows / max_batch").set(occ)
+        reg.gauge("decode_queue_depth",
+                  help="requests waiting for a cache row").set(
+                      len(self.queue))

@@ -1,0 +1,204 @@
+"""`ds_tpu_serve`: drive the serving engine against a request stream.
+
+    ds_tpu_serve --synthetic 8                # scripted open-loop stream
+    ds_tpu_serve --requests stream.jsonl      # one request per line
+    ds_tpu_serve --config ds_config.json      # inference block from config
+    ds_tpu_serve --scan-layers --kv-cache-dtype int8
+    ds_tpu_serve --expect-compiles 2 --json
+
+The model is the test-size GPT-2 with seeded random params — this CLI
+exists to exercise and measure the serving engine (CI smoke, bench
+rows, audits), not to ship checkpoints. A request line is
+``{"rid": "r0", "prompt": [1, 2, 3], "max_new_tokens": 8,
+"eos_id": null, "arrival_step": 0}`` (only ``prompt`` required).
+
+``--expect-compiles N`` makes the exit code enforce the recompile
+contract: after the stream drains, prefill + decode jit-cache entries
+must total exactly N (2 for any single-engine serve — one prefill, one
+decode — regardless of how many buckets the stream crossed).
+``--jsonl`` writes ``decode_step`` telemetry events for
+``ds_tpu_metrics summary`` serve mode.
+
+Exit codes: 0 ok, 1 compile-count violation or unfinished requests,
+2 usage errors.
+"""
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _build_requests(args, vocab_size):
+    from deepspeed_tpu.inference.scheduler import Request
+    if args.requests:
+        reqs = []
+        with open(args.requests) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                reqs.append(Request(
+                    rid=str(d.get("rid", f"r{i}")),
+                    prompt=[int(t) for t in d["prompt"]],
+                    max_new_tokens=int(
+                        d.get("max_new_tokens", args.max_new)),
+                    eos_id=d.get("eos_id"),
+                    arrival_step=int(d.get("arrival_step", 0))))
+        return reqs
+    # synthetic open-loop stream: varied prompt lengths spanning the
+    # buckets, staggered arrivals, deterministic under --seed.
+    rng = np.random.default_rng(args.seed)
+    reqs = []
+    for i in range(args.synthetic):
+        plen = int(rng.integers(2, max(3, args.synthetic_max_prompt)))
+        reqs.append(Request(
+            rid=f"s{i}",
+            prompt=rng.integers(0, vocab_size, plen).tolist(),
+            max_new_tokens=args.max_new,
+            arrival_step=int(i * args.arrival_every)))
+    return reqs
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ds_tpu_serve",
+        description="run the jitted serving engine over a request "
+                    "stream (continuous batching, bucketed KV cache)")
+    parser.add_argument("--config", default=None,
+                        help="DeepSpeed-style JSON config; its "
+                             "'inference' block configures the engine")
+    parser.add_argument("--scan-layers", action="store_true",
+                        help="serve the scan_layers model variant")
+    parser.add_argument("--kv-cache-dtype", default=None,
+                        help="override cache storage: bf16, f32, or a "
+                             "codec name (int8, f8e4m3fn, f8e5m2)")
+    parser.add_argument("--max-batch", type=int, default=None,
+                        help="override inference.max_batch")
+    parser.add_argument("--seq-buckets", default=None,
+                        help="override inference.seq_buckets, e.g. 16,32")
+    parser.add_argument("--prefill-chunk", type=int, default=None,
+                        help="override inference.prefill_chunk")
+    parser.add_argument("--requests", default=None,
+                        help="JSONL request stream (one request/line)")
+    parser.add_argument("--synthetic", type=int, default=0,
+                        help="generate N synthetic open-loop requests "
+                             "instead of --requests")
+    parser.add_argument("--synthetic-max-prompt", type=int, default=24,
+                        help="synthetic prompt length upper bound")
+    parser.add_argument("--arrival-every", type=float, default=1.0,
+                        help="synthetic arrival spacing in decode steps")
+    parser.add_argument("--max-new", type=int, default=8,
+                        help="default max_new_tokens per request")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="params + synthetic stream seed")
+    parser.add_argument("--expect-compiles", type=int, default=None,
+                        help="exit 1 unless total jit cache entries "
+                             "(prefill + decode) equal exactly this")
+    parser.add_argument("--jsonl", default=None,
+                        help="write decode_step telemetry events here "
+                             "(ds_tpu_metrics summary serve mode)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="print the result dict as JSON")
+    args = parser.parse_args(argv)
+
+    if not args.requests and not args.synthetic:
+        parser.error("one of --requests or --synthetic N is required")
+    if args.requests and args.synthetic:
+        parser.error("--requests and --synthetic are mutually exclusive")
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler)
+    from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
+    from deepspeed_tpu.telemetry.session import TelemetrySession
+
+    inf_cfg = {"max_batch": 2, "seq_buckets": (16, 32),
+               "prefill_chunk": 4}
+    if args.config:
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        with open(args.config) as f:
+            raw = json.load(f)
+        # a serving config needn't carry training batch sizes; give the
+        # validator trivial ones (world_size pinned to 1 — serving does
+        # no data parallelism) so only the inference block matters
+        raw.setdefault("train_batch_size", 1)
+        raw.setdefault("train_micro_batch_size_per_gpu", 1)
+        ds = DeepSpeedConfig(raw, world_size=1)
+        inf = ds.inference
+        inf_cfg = {"max_batch": inf.max_batch,
+                   "seq_buckets": inf.seq_buckets,
+                   "prefill_chunk": inf.prefill_chunk,
+                   "kv_cache_dtype": inf.kv_cache_dtype,
+                   "max_new_tokens": inf.max_new_tokens}
+    if args.max_batch is not None:
+        inf_cfg["max_batch"] = args.max_batch
+    if args.seq_buckets is not None:
+        inf_cfg["seq_buckets"] = tuple(
+            int(b) for b in args.seq_buckets.split(",") if b.strip())
+    if args.prefill_chunk is not None:
+        inf_cfg["prefill_chunk"] = args.prefill_chunk
+    if args.kv_cache_dtype is not None:
+        inf_cfg["kv_cache_dtype"] = args.kv_cache_dtype
+
+    session = None
+    if args.jsonl:
+        from deepspeed_tpu.telemetry.exporters import JsonlExporter
+        session = TelemetrySession(exporters=[JsonlExporter(args.jsonl)])
+
+    cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32,
+                    scan_layers=args.scan_layers)
+    model = GPT2LMHead(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(args.seed), toks)["params"]
+    engine = InferenceEngine(model, params, config=inf_cfg,
+                             session=session)
+    sched = ContinuousBatchingScheduler(engine)
+
+    requests = _build_requests(args, cfg.vocab_size)
+    completions = sched.run(requests)
+
+    counts = engine.compile_counts()
+    total_compiles = sum(n for n in counts.values() if n is not None)
+    result = {
+        "requests": len(requests),
+        "completions": [
+            {"rid": c.rid, "prompt_len": c.prompt_len,
+             "tokens": c.tokens, "finish_reason": c.finish_reason,
+             "bucket": c.bucket, "slot": c.slot, "steps": c.steps}
+            for c in completions],
+        "decode_steps": sched.step_count,
+        "compile_counts": counts,
+        "cache": engine.cache_facts(),
+    }
+    ok = len(completions) == len(requests)
+    if args.expect_compiles is not None:
+        result["expect_compiles"] = args.expect_compiles
+        ok = ok and total_compiles == args.expect_compiles
+    result["ok"] = ok
+
+    if args.as_json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+    else:
+        for c in completions:
+            print(f"{c.rid}: prompt {c.prompt_len} tokens -> "
+                  f"{len(c.tokens)} generated ({c.finish_reason}, "
+                  f"bucket {c.bucket}, slot {c.slot})")
+        print(f"{len(completions)}/{len(requests)} requests completed "
+              f"in {sched.step_count} decode step(s); compiles: "
+              f"prefill={counts['prefill']} decode={counts['decode']}")
+        if not ok:
+            print("FAIL: "
+                  + ("unfinished requests"
+                     if len(completions) != len(requests) else
+                     f"compile count {total_compiles} != expected "
+                     f"{args.expect_compiles}"), file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,10 +109,20 @@ def gpt2_tiny(**kw):
 
 
 class CausalSelfAttention(nn.Module):
+    """Causal attention; also the incremental-decode write/attend site.
+
+    ``kv_cache`` (a layer's ``{"k", "v"(, scales)}`` buffers from
+    `inference/cache.py`) switches to the cached path: this call's k/v
+    are written at explicit ``positions`` and attention runs over the
+    whole cache row under a position mask — the call then returns
+    ``(y, updated_cache)``. With ``kv_cache=None`` the training path is
+    untouched (same modules, same trace), so train and serve share
+    every parameter."""
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, positions=None,
+                 kv_cache=None):
         cfg = self.config
         B, T, C = x.shape
         H = cfg.n_head
@@ -123,7 +133,12 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(B, T, H, C // H)
         v = v.reshape(B, T, H, C // H)
 
-        if cfg.use_flash_attention:
+        new_cache = None
+        if kv_cache is not None:
+            from deepspeed_tpu.inference.cache import cached_attention
+            y, new_cache = cached_attention(q, k, v, kv_cache, positions,
+                                            compute_dtype=cfg.dtype)
+        elif cfg.use_flash_attention:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
             # Attention-prob dropout runs inside the kernels (counter-based
             # mask regenerated in the backward), so the flash path stays on
@@ -149,6 +164,8 @@ class CausalSelfAttention(nn.Module):
         y = nn.Dense(C, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      dot_general=_fp8_dot("c_proj"), name="c_proj")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        if kv_cache is not None:
+            return y, new_cache
         return y
 
 
@@ -184,12 +201,22 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic=True, pld_theta=None,
-                 layer_idx=None):
+                 layer_idx=None, positions=None, kv_cache=None):
         cfg = self.config
         attn = CausalSelfAttention(cfg, name="attn")
         mlp = MLP(cfg, name="mlp")
         ln1 = nn.LayerNorm(dtype=cfg.dtype, name="ln_1")
         ln2 = nn.LayerNorm(dtype=cfg.dtype, name="ln_2")
+
+        if kv_cache is not None:
+            # incremental decode: PLD never applies (serving is
+            # deterministic), and the attention call also returns the
+            # layer's updated cache.
+            a, new_cache = attn(ln1(x), deterministic,
+                                positions=positions, kv_cache=kv_cache)
+            x = x + a
+            x = x + mlp(ln2(x), deterministic)
+            return x, new_cache
 
         if pld_theta is None or deterministic:
             x = x + attn(ln1(x), deterministic)
@@ -230,15 +257,22 @@ class GPT2LMHead(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, deterministic=True, pld_theta=None,
-                 return_hidden=False):
+                 return_hidden=False, positions=None, kv_cache=None):
         cfg = self.config
         B, T = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
-        x = wte[input_ids].astype(cfg.dtype) + \
-            wpe[None, :T].astype(cfg.dtype)
+        if positions is None:
+            # training/full-context: positions ARE the sequence index.
+            pos_emb = wpe[None, :T]
+        else:
+            # incremental decode: a [B, T] chunk sits at explicit
+            # absolute positions (past the prefill), so the position
+            # embedding is a gather, not a prefix slice.
+            pos_emb = wpe[positions]
+        x = wte[input_ids].astype(cfg.dtype) + pos_emb.astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         block_cls = Block
@@ -254,7 +288,29 @@ class GPT2LMHead(nn.Module):
                     f"{sorted(policies)}")
             policy = policies[cfg.remat_policy]
             block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
-        if cfg.scan_layers:
+        new_kv = None
+        if cfg.scan_layers and kv_cache is not None:
+            # decode over the scanned stack: the per-layer cache slices
+            # ride the same lax.scan as the stacked params (in_axes=0
+            # over the (iota, cache) pair), and the updated slices come
+            # back as the scan's stacked ys.
+            def body(block, h, xs, det, pos):
+                idx, layer_cache = xs
+                h, new_c = block(h, det, None, layer_idx=idx,
+                                 positions=pos, kv_cache=layer_cache)
+                return h, new_c
+
+            scan = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True, "pld": True},
+                in_axes=(0, nn.broadcast, nn.broadcast),
+                length=cfg.n_layer)
+            x, new_h = scan(block_cls(cfg, n_layers=cfg.n_layer, name="h"),
+                            x, (jnp.arange(cfg.n_layer), kv_cache["h"]),
+                            deterministic, positions)
+            new_kv = {"h": new_h}
+        elif cfg.scan_layers:
             # One lax.scan over layer-stacked params instead of n_layer
             # unrolled Block copies: the lowered HLO carries a single
             # block body (trip-count-weighted by the audit), so trace and
@@ -274,6 +330,14 @@ class GPT2LMHead(nn.Module):
             x, _ = scan(block_cls(cfg, n_layers=cfg.n_layer, name="h"),
                         x, jnp.arange(cfg.n_layer), deterministic,
                         pld_theta)
+        elif kv_cache is not None:
+            new_kv = {}
+            for i in range(cfg.n_layer):
+                x, new_kv[f"h_{i}"] = block_cls(
+                    cfg, layer_idx=i, n_layers=cfg.n_layer,
+                    name=f"h_{i}")(x, deterministic, None,
+                                   positions=positions,
+                                   kv_cache=kv_cache[f"h_{i}"])
         else:
             for i in range(cfg.n_layer):
                 x = block_cls(cfg, layer_idx=i, n_layers=cfg.n_layer,
@@ -282,6 +346,8 @@ class GPT2LMHead(nn.Module):
         if return_hidden:
             return x        # chunked-loss path applies the head itself
         logits = x @ wte.T.astype(cfg.dtype)
+        if kv_cache is not None:
+            return logits, new_kv
         return logits
 
 

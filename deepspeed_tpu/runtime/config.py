@@ -634,6 +634,45 @@ class Fp8Config:
                 f"wire_chunk_size={self.wire_chunk_size})")
 
 
+class InferenceConfig:
+    """Typed view of the ``inference`` block: the jitted autoregressive
+    serving engine (`deepspeed_tpu/inference/`; docs/inference.md).
+
+    ``max_batch`` sizes the KV cache's row ring (= the compiled decode
+    batch); ``seq_buckets`` are host-side per-request length budgets
+    (the cache buffer is sized to their max — buckets are NOT compiled
+    shapes, so any bucket mix costs exactly one prefill + one decode
+    compile); ``prefill_chunk`` fixes the chunked-prefill shape;
+    ``kv_cache_dtype`` selects plain (``bf16``/``f32``) or codec
+    -quantized (``int8``/``f8e4m3fn``/``f8e5m2``) cache storage."""
+
+    KEYS = (INFERENCE_MAX_BATCH, INFERENCE_SEQ_BUCKETS,
+            INFERENCE_PREFILL_CHUNK, INFERENCE_KV_CACHE_DTYPE,
+            INFERENCE_MAX_NEW_TOKENS)
+
+    def __init__(self, param_dict):
+        sub = param_dict.get(INFERENCE, {}) or {}
+        self._given_keys = tuple(sub)
+        self.max_batch = get_scalar_param(sub, INFERENCE_MAX_BATCH,
+                                          INFERENCE_MAX_BATCH_DEFAULT)
+        buckets = get_scalar_param(sub, INFERENCE_SEQ_BUCKETS,
+                                   INFERENCE_SEQ_BUCKETS_DEFAULT)
+        self.seq_buckets = tuple(buckets) if buckets is not None else ()
+        self.prefill_chunk = get_scalar_param(
+            sub, INFERENCE_PREFILL_CHUNK, INFERENCE_PREFILL_CHUNK_DEFAULT)
+        self.kv_cache_dtype = get_scalar_param(
+            sub, INFERENCE_KV_CACHE_DTYPE, INFERENCE_KV_CACHE_DTYPE_DEFAULT)
+        self.max_new_tokens = get_scalar_param(
+            sub, INFERENCE_MAX_NEW_TOKENS, INFERENCE_MAX_NEW_TOKENS_DEFAULT)
+
+    def __repr__(self):
+        return (f"InferenceConfig(max_batch={self.max_batch}, "
+                f"seq_buckets={self.seq_buckets}, "
+                f"prefill_chunk={self.prefill_chunk}, "
+                f"kv_cache_dtype={self.kv_cache_dtype!r}, "
+                f"max_new_tokens={self.max_new_tokens})")
+
+
 class DeepSpeedConfig:
     def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
         if param_dict is None:
@@ -767,6 +806,7 @@ class DeepSpeedConfig:
         self.telemetry = TelemetryConfig(param_dict)
         self.tensor_parallel = TensorParallelConfig(param_dict)
         self.fp8 = Fp8Config(param_dict)
+        self.inference = InferenceConfig(param_dict)
         # Set by the elastic batch solver when the target batch cannot
         # factor exactly at this world size; the engine multiplies it
         # into the lr schedule.
@@ -914,6 +954,54 @@ class DeepSpeedConfig:
         self._check_tensor_parallel()
         self._check_zero3()
         self._check_fp8()
+        self._check_inference()
+
+    def _check_inference(self):
+        from deepspeed_tpu.runtime.comm.codecs import CODECS
+        inf = self.inference
+        unknown = sorted(set(inf._given_keys) - set(inf.KEYS))
+        if unknown:
+            raise ValueError(
+                f"inference: unknown key(s) {unknown}; "
+                f"allowed: {sorted(inf.KEYS)}")
+        mb = inf.max_batch
+        if isinstance(mb, bool) or not isinstance(mb, int) or mb < 1:
+            raise ValueError(
+                f"inference: max_batch must be an int >= 1, got {mb!r}")
+        pc = inf.prefill_chunk
+        if isinstance(pc, bool) or not isinstance(pc, int) or pc < 1:
+            raise ValueError(
+                f"inference: prefill_chunk must be an int >= 1, "
+                f"got {pc!r}")
+        buckets = inf.seq_buckets
+        if not buckets:
+            raise ValueError("inference: seq_buckets must be non-empty")
+        prev = 0
+        for b in buckets:
+            if isinstance(b, bool) or not isinstance(b, int) or b < 1:
+                raise ValueError(
+                    f"inference: seq_buckets must be positive ints, "
+                    f"got {b!r}")
+            if b <= prev:
+                raise ValueError(
+                    f"inference: seq_buckets must be strictly increasing,"
+                    f" got {list(buckets)}")
+            if b % pc:
+                raise ValueError(
+                    f"inference: every seq bucket must be a multiple of "
+                    f"prefill_chunk={pc}; got bucket {b}")
+            prev = b
+        kvd = inf.kv_cache_dtype
+        if kvd is not None and kvd not in ("bf16", "f32", "fp32") \
+                and kvd not in CODECS:
+            raise ValueError(
+                f"inference: kv_cache_dtype must be None, 'bf16', 'f32',"
+                f" or a codec name from {sorted(CODECS)}; got {kvd!r}")
+        mn = inf.max_new_tokens
+        if isinstance(mn, bool) or not isinstance(mn, int) or mn < 1:
+            raise ValueError(
+                f"inference: max_new_tokens must be an int >= 1, "
+                f"got {mn!r}")
 
     def _check_fp8(self):
         from deepspeed_tpu.runtime.comm.codecs import CODECS

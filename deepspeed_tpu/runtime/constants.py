@@ -433,3 +433,41 @@ TELEMETRY_ANOMALY_TRACE_WINDOW = "window"
 TELEMETRY_ANOMALY_TRACE_WINDOW_DEFAULT = 32
 TELEMETRY_ANOMALY_TRACE_CAPTURE_STEPS = "capture_steps"
 TELEMETRY_ANOMALY_TRACE_CAPTURE_STEPS_DEFAULT = 3
+
+#############################################
+# Inference / serving (deepspeed_tpu/inference/)
+#############################################
+# The jitted autoregressive serving engine: one chunked-prefill
+# program + one decode program over a bucketed ring-buffer KV cache,
+# driven by a host-side continuous-batching scheduler. See
+# docs/inference.md.
+INFERENCE = "inference"
+
+# Rows in the KV cache = the compiled decode batch. Every decode step
+# runs all rows; inactive rows are padding.
+INFERENCE_MAX_BATCH = "max_batch"
+INFERENCE_MAX_BATCH_DEFAULT = 8
+
+# Per-request sequence-length budgets (host-side admission control,
+# NOT compiled shapes): a request is assigned the smallest bucket that
+# fits prompt + max_new_tokens and is evicted at the bucket edge. The
+# cache buffer is sized to max(seq_buckets). Every bucket must be a
+# multiple of prefill_chunk.
+INFERENCE_SEQ_BUCKETS = "seq_buckets"
+INFERENCE_SEQ_BUCKETS_DEFAULT = (128, 512)
+
+# Prompts prefill in fixed [1, prefill_chunk] chunks so prompt length
+# never reaches a jit boundary.
+INFERENCE_PREFILL_CHUNK = "prefill_chunk"
+INFERENCE_PREFILL_CHUNK_DEFAULT = 32
+
+# KV cache storage: None = model compute dtype; "bf16"/"f32" = plain
+# storage; a codec name from runtime/comm/codecs.py ("int8",
+# "f8e4m3fn", "f8e5m2") = quantized storage with per-(row, position,
+# head) f32 absmax scales.
+INFERENCE_KV_CACHE_DTYPE = "kv_cache_dtype"
+INFERENCE_KV_CACHE_DTYPE_DEFAULT = None
+
+# Default generation budget for requests that don't specify one.
+INFERENCE_MAX_NEW_TOKENS = "max_new_tokens"
+INFERENCE_MAX_NEW_TOKENS_DEFAULT = 64

@@ -10,6 +10,11 @@ Five subcommands over the schema-versioned event log a run writes when
   = tokens/sec x flops/token; MFU = achieved / peak, default peak 197
   TFLOPS — one v5e chip's bf16 ceiling), plus recompile / health-guard /
   checkpoint event counts.
+  Serving logs (``decode_step`` events from the continuous-batching
+  scheduler, `inference/scheduler.py`) get a serve-mode summary
+  instead: tokens/sec, per-token latency p50/p95/p99 (each token's
+  latency is its decode step's host wall), mean batch occupancy, and
+  queue depth.
 - ``ds_tpu_metrics tail LOG -n 20`` — the last N events, one line each.
 - ``ds_tpu_metrics diff A B`` — per-metric regression table between two
   runs; ``--fail-over PCT`` exits 1 when mean step time regressed more.
@@ -106,6 +111,9 @@ def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
     log holds neither step events nor resilience events (a supervisor's
     log is all restarts and recoveries — still worth a summary)."""
     steps = [e for e in events if e.get("event") == "step"]
+    decode = [e for e in events if e.get("event") == "decode_step"]
+    if not steps and decode:
+        return _summarize_serve(decode)
     if not steps and not any(
             e.get("event") in ("restart", "recovery_ladder",
                                "checkpoint_fallback", "supervisor_done")
@@ -203,13 +211,91 @@ def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
     }
 
 
+def _summarize_serve(decode):
+    """Serve-mode summary over ``decode_step`` events. Per-token latency
+    samples: every token a decode step produced experienced that step's
+    host wall, so the sample list is each step's wall repeated
+    ``tokens`` times — the open-loop analog of per-request latency
+    without having to join request ids across events."""
+    walls = sorted(float(e["wall_s"]) for e in decode
+                   if e.get("wall_s") is not None)
+    total_s = sum(walls)
+    tokens = sum(int(e.get("tokens") or 0) for e in decode)
+    lat = sorted(x for e in decode if e.get("wall_s") is not None
+                 for x in [float(e["wall_s"])] * int(e.get("tokens") or 0))
+    occ = [float(e["occupancy"]) for e in decode
+           if e.get("occupancy") is not None]
+    qd = [float(e["queue_depth"]) for e in decode
+          if e.get("queue_depth") is not None]
+    return {
+        "schema": SCHEMA_VERSION,
+        "mode": "serve",
+        "flavor": "serve",
+        "steps": len(decode),
+        "wall_s": total_s,
+        "step_s": {
+            "mean": (total_s / len(walls)) if walls else None,
+            "p50": _percentile(walls, 0.50),
+            "p95": _percentile(walls, 0.95),
+            "min": walls[0] if walls else None,
+            "max": walls[-1] if walls else None,
+        },
+        "tokens": tokens or None,
+        "tokens_per_s": tokens / total_s if total_s and tokens else None,
+        "phases": {},   # serve steps have no train phases; diff expects the key
+        "latency_s": {
+            "mean": (sum(lat) / len(lat)) if lat else None,
+            "p50": _percentile(lat, 0.50),
+            "p95": _percentile(lat, 0.95),
+            "p99": _percentile(lat, 0.99),
+        },
+        "batch_occupancy": {
+            "mean": (sum(occ) / len(occ)) if occ else None,
+            "min": min(occ) if occ else None,
+            "max": max(occ) if occ else None,
+        },
+        "queue_depth": {
+            "mean": (sum(qd) / len(qd)) if qd else None,
+            "max": max(qd) if qd else None,
+        },
+        "mfu": None,
+    }
+
+
 def _fmt_s(v):
     if v is None:
         return "-"
     return f"{v * 1e3:.2f}ms" if v < 1.0 else f"{v:.3f}s"
 
 
+def print_serve_summary(s, out=None):
+    print(f"serve summary (schema {s['schema']})", file=out)
+    print(f"  decode steps {s['steps']}, wall {s['wall_s']:.3f}s, "
+          f"step time mean {_fmt_s(s['step_s']['mean'])} "
+          f"p50 {_fmt_s(s['step_s']['p50'])} "
+          f"p95 {_fmt_s(s['step_s']['p95'])}", file=out)
+    if s["tokens"]:
+        print(f"  tokens {s['tokens']}, throughput "
+              f"{s['tokens_per_s']:,.1f} tokens/s", file=out)
+    lat = s["latency_s"]
+    if lat["p50"] is not None:
+        print(f"  per-token latency p50 {_fmt_s(lat['p50'])} "
+              f"p95 {_fmt_s(lat['p95'])} p99 {_fmt_s(lat['p99'])}",
+              file=out)
+    occ = s["batch_occupancy"]
+    if occ["mean"] is not None:
+        print(f"  batch occupancy mean {occ['mean'] * 100:.1f}% "
+              f"(min {occ['min'] * 100:.0f}%, max {occ['max'] * 100:.0f}%)",
+              file=out)
+    qd = s["queue_depth"]
+    if qd["mean"] is not None:
+        print(f"  queue depth mean {qd['mean']:.2f}, max {qd['max']:.0f}",
+              file=out)
+
+
 def print_summary(s, out=None):
+    if s.get("mode") == "serve":
+        return print_serve_summary(s, out)
     print(f"run summary ({s['flavor'] or 'unknown'} flavor, schema "
           f"{s['schema']})", file=out)
     print(f"  steps {s['steps']}, wall {s['wall_s']:.3f}s, "

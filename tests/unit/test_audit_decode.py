@@ -1,0 +1,97 @@
+"""Decode audit flavor (`deepspeed_tpu/analysis/audit.py:audit_decode`
++ `analysis/rules.py:rule_decode`).
+
+The rule negatives are pure-python — a StepContext with faked compile
+counts / cache censuses, no jax programs — so every failure mode of
+the serving contract (mid-stream recompile, mixed cache dtypes,
+silently-skipped quantization) has a cheap pin. The real end-to-end
+audit (tiny engine, scripted stream, lowered decode HLO, full rule
+catalog → zero findings) is the PR's acceptance criterion and runs
+once plain plus once quantized.
+"""
+
+from deepspeed_tpu.analysis.audit import EXTRA_FLAVORS, audit_decode
+from deepspeed_tpu.analysis.rules import (
+    SEV_ERROR,
+    RULE_IDS,
+    StepContext,
+    rule_decode,
+)
+
+
+class TestRuleDecode:
+    def test_registered(self):
+        assert "decode" in RULE_IDS
+        assert "decode" in EXTRA_FLAVORS
+
+    def test_skips_when_no_decode_facts(self):
+        assert rule_decode(StepContext(hlo_text="")) == []
+
+    def test_clean_counts_and_census_pass(self):
+        ctx = StepContext(
+            hlo_text="", decode_compile_counts={"prefill": 1, "decode": 1},
+            decode_cache_census={"float32": 4})
+        assert rule_decode(ctx) == []
+
+    def test_midstream_recompile_is_error(self):
+        ctx = StepContext(
+            hlo_text="", decode_compile_counts={"prefill": 1, "decode": 3})
+        findings = rule_decode(ctx)
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.rule == "decode" and f.severity == SEV_ERROR
+        assert f.details["program"] == "decode"
+        assert f.details["cache_size"] == 3
+        assert "recompiled mid-stream" in f.message
+
+    def test_raised_expectation_tolerates_more_programs(self):
+        ctx = StepContext(
+            hlo_text="", decode_compile_counts={"prefill": 2, "decode": 2},
+            decode_expected_compiles=2)
+        assert rule_decode(ctx) == []
+
+    def test_unknown_count_not_flagged(self):
+        ctx = StepContext(
+            hlo_text="",
+            decode_compile_counts={"prefill": None, "decode": 1})
+        assert rule_decode(ctx) == []
+
+    def test_mixed_cache_dtypes_is_error(self):
+        ctx = StepContext(
+            hlo_text="",
+            decode_cache_census={"float32": 3, "bfloat16": 1})
+        findings = rule_decode(ctx)
+        assert [f.severity for f in findings] == [SEV_ERROR]
+
+    def test_skipped_quantization_is_error(self):
+        # configured int8 but the cache stores float32: the quantized
+        # path silently never engaged
+        ctx = StepContext(
+            hlo_text="", decode_kv_cache_dtype="int8",
+            decode_cache_census={"float32": 4})
+        findings = rule_decode(ctx)
+        assert len(findings) == 1
+        assert findings[0].severity == SEV_ERROR
+        assert "int8" in findings[0].message
+
+    def test_honoured_quantization_passes(self):
+        ctx = StepContext(
+            hlo_text="", decode_kv_cache_dtype="int8",
+            decode_cache_census={"int8": 4})
+        assert rule_decode(ctx) == []
+
+
+class TestAuditDecodeEndToEnd:
+    def test_zero_findings(self):
+        report = audit_decode()
+        assert report.findings == []
+        assert report.stats["compile_counts"] == \
+            {"prefill": 1, "decode": 1}
+        assert report.stats["completions"] == 5
+        assert set(report.stats["finish_reasons"]) >= \
+            {"max_new_tokens", "length"}
+
+    def test_zero_findings_quantized(self):
+        report = audit_decode(kv_cache_dtype="int8")
+        assert report.findings == []
+        assert report.stats["cache"]["dtype_census"] == {"int8": 4}

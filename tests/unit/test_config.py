@@ -258,3 +258,39 @@ def test_hot_checkpoint_config_validation():
             "train_batch_size": 16,
             "resilience": {"hot_checkpoint": {
                 "enabled": True, "capacity": 0}}})
+
+
+def test_inference_config_defaults_and_block():
+    cfg = make_config({"train_batch_size": 16})
+    inf = cfg.inference
+    assert inf.max_batch == 8
+    assert inf.seq_buckets == (128, 512)
+    assert inf.prefill_chunk == 32
+    assert inf.kv_cache_dtype is None
+    assert inf.max_new_tokens == 64
+
+    cfg = make_config({
+        "train_batch_size": 16,
+        "inference": {"max_batch": 4, "seq_buckets": [64, 256],
+                      "prefill_chunk": 16, "kv_cache_dtype": "int8",
+                      "max_new_tokens": 32}})
+    inf = cfg.inference
+    assert inf.max_batch == 4
+    assert inf.seq_buckets == (64, 256)   # list coerced to tuple
+    assert inf.kv_cache_dtype == "int8"
+
+
+def test_inference_config_validation():
+    def bad(block, match):
+        with pytest.raises(ValueError, match=match):
+            make_config({"train_batch_size": 16, "inference": block})
+
+    bad({"max_batch": 0}, "max_batch")
+    bad({"max_batch": True}, "max_batch")         # bools are not counts
+    bad({"prefill_chunk": 0}, "prefill_chunk")
+    bad({"seq_buckets": []}, "non-empty")
+    bad({"seq_buckets": [64, 64]}, "strictly increasing")
+    bad({"seq_buckets": [48, 64], "prefill_chunk": 32}, "multiple of")
+    bad({"kv_cache_dtype": "e5m2"}, "kv_cache_dtype")
+    bad({"max_new_tokens": 0}, "max_new_tokens")
+    bad({"max_batc": 4}, "unknown key")

@@ -1,0 +1,215 @@
+"""Serving engine + continuous-batching scheduler pins
+(`deepspeed_tpu/inference/engine.py`, `scheduler.py`).
+
+Two halves:
+
+- scheduler logic against a stub engine (no jax): bucket assignment,
+  slot recycling, eos/max_new/length finishes, open-loop arrival
+  gating, and the ``decode_step`` telemetry stream.
+- the real engine's recompile contract: one tiny-model engine driven
+  through admit/evict across BOTH seq buckets must hold
+  ``{"prefill": 1, "decode": 1}`` — the acceptance criterion the whole
+  bucketed-shapes design exists for — plus the in-engine detector's
+  negative case and config validation.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+)
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from deepspeed_tpu.telemetry.session import TelemetrySession
+
+
+class StubEngine:
+    """Scheduler-facing engine surface without jax: prefill returns
+    logits argmaxing to token 7; decode echoes position+1 as the next
+    token so generations are deterministic and inspectable."""
+
+    def __init__(self, max_batch=2, seq_buckets=(16, 32), session=None):
+        self.max_batch = max_batch
+        self.seq_buckets = tuple(sorted(seq_buckets))
+        self.max_seq = max(self.seq_buckets)
+        self.session = session
+        self.prefills = []
+        self.decodes = 0
+
+    def prefill(self, slot, prompt):
+        self.prefills.append((slot, tuple(prompt)))
+        logits = np.zeros(64, np.float32)
+        logits[7] = 1.0
+        return logits
+
+    def decode(self, tokens, positions):
+        self.decodes += 1
+        nxt = (np.asarray(positions) + 1).astype(np.int32)
+        return nxt, np.zeros((self.max_batch, 64), np.float32)
+
+
+class TestSchedulerLogic:
+    def test_bucket_assignment_smallest_fit_and_clamp(self):
+        eng = StubEngine(seq_buckets=(16, 32))
+        sched = ContinuousBatchingScheduler(eng)
+        assert sched._bucket_for(Request("a", [0] * 4,
+                                         max_new_tokens=4)) == 16
+        assert sched._bucket_for(Request("b", [0] * 13,
+                                         max_new_tokens=4)) == 32
+        # over the largest bucket: clamps (generation truncates there)
+        assert sched._bucket_for(Request("c", [0] * 30,
+                                         max_new_tokens=10)) == 32
+
+    def test_submit_validation(self):
+        sched = ContinuousBatchingScheduler(StubEngine())
+        with pytest.raises(ValueError, match="empty prompt"):
+            sched.submit(Request("a", []))
+        with pytest.raises(ValueError, match="does not fit"):
+            sched.submit(Request("b", [0] * 40))
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            sched.submit(Request("c", [0], max_new_tokens=0))
+
+    def test_max_new_tokens_finish_and_slot_recycling(self):
+        eng = StubEngine(max_batch=2)
+        sched = ContinuousBatchingScheduler(eng)
+        reqs = [Request(f"r{i}", [1, 2], max_new_tokens=3)
+                for i in range(4)]
+        comps = sched.run(reqs)
+        assert [c.rid for c in comps] == ["r0", "r1", "r2", "r3"]
+        assert all(c.finish_reason == "max_new_tokens" for c in comps)
+        assert all(len(c.tokens) == 3 for c in comps)
+        # 2 rows served 4 requests: later requests reused slots 0/1
+        assert {c.slot for c in comps} == {0, 1}
+
+    def test_eos_finish(self):
+        eng = StubEngine()
+        sched = ContinuousBatchingScheduler(eng)
+        # prefill's first sampled token is 7 -> immediate eos finish
+        comps = sched.run([Request("a", [1, 2], max_new_tokens=8,
+                                   eos_id=7)])
+        assert comps[0].finish_reason == "eos"
+        assert comps[0].tokens == [7]
+        assert eng.decodes == 0
+
+    def test_length_eviction_at_bucket_edge(self):
+        eng = StubEngine(seq_buckets=(16, 32))
+        sched = ContinuousBatchingScheduler(eng)
+        comps = sched.run([Request("a", [1] * 30, max_new_tokens=10)])
+        assert comps[0].finish_reason == "length"
+        assert comps[0].bucket == 32
+        # positions 30 and 31 were decodable; the prefill token plus
+        # two decode outputs landed before the budget ran out
+        assert len(comps[0].tokens) == 3
+
+    def test_open_loop_arrival_gating(self):
+        eng = StubEngine(max_batch=4)
+        sched = ContinuousBatchingScheduler(eng)
+        sched.submit(Request("later", [1, 2], max_new_tokens=2,
+                             arrival_step=5))
+        sched.step()
+        assert sched.slots == [None] * 4     # not admitted yet
+        assert sched.step_count == 1
+        comps = sched.run(max_steps=50)
+        assert comps[0].rid == "later"
+        assert comps[0].steps <= 2
+
+    def test_decode_step_events_and_metrics(self):
+        session = TelemetrySession()
+        eng = StubEngine(max_batch=2, session=session)
+        sched = ContinuousBatchingScheduler(eng)
+        sched.run([Request("a", [1, 2], max_new_tokens=3),
+                   Request("b", [3], max_new_tokens=2)])
+        evts = session.events.recent(event="decode_step")
+        assert evts and eng.decodes == len(evts)
+        for e in evts:
+            assert set(e) >= {"step", "tokens", "batch", "occupancy",
+                              "queue_depth", "wall_s"}
+        assert evts[0]["batch"] == 2 and evts[0]["occupancy"] == 1.0
+        assert session.registry.counter("decode_tokens_total").value > 0
+
+
+def _tiny_engine(**cfg_kw):
+    cfg = GPT2Config(vocab_size=64, n_positions=64, n_embd=32,
+                     n_layer=2, n_head=4, dtype=jnp.float32)
+    model = GPT2LMHead(cfg)
+    import jax
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    inf = {"max_batch": 2, "seq_buckets": (16, 32), "prefill_chunk": 4}
+    inf.update(cfg_kw)
+    return InferenceEngine(model, params, config=inf)
+
+
+class TestEngineValidation:
+    def test_bucket_chunk_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="multiple of"):
+            _tiny_engine(seq_buckets=(10, 32))
+
+    def test_bad_max_batch_rejected(self):
+        with pytest.raises(ValueError, match="max_batch"):
+            _tiny_engine(max_batch=0)
+
+    def test_empty_buckets_rejected(self):
+        with pytest.raises(ValueError, match="seq_buckets"):
+            _tiny_engine(seq_buckets=())
+
+    def test_prompt_length_bounds(self):
+        eng = _tiny_engine()
+        with pytest.raises(ValueError, match="prompt length"):
+            eng.prefill(0, [])
+        with pytest.raises(ValueError, match="prompt length"):
+            eng.prefill(0, [1] * 33)
+
+
+class TestRecompileContract:
+    def test_two_compiles_across_buckets_with_admit_evict(self):
+        """THE acceptance pin: a stream that exercises admission,
+        eviction, slot recycling, and both seq buckets compiles the
+        prefill and decode programs exactly once each."""
+        eng = _tiny_engine()
+        sched = ContinuousBatchingScheduler(eng)
+        rng = np.random.default_rng(0)
+        reqs = [
+            Request("small", rng.integers(0, 64, 3).tolist(),
+                    max_new_tokens=4),                    # bucket 16
+            Request("large", rng.integers(0, 64, 20).tolist(),
+                    max_new_tokens=6),                    # bucket 32
+            Request("late", rng.integers(0, 64, 2).tolist(),
+                    max_new_tokens=3, arrival_step=4),    # recycles a row
+            Request("clamped", rng.integers(0, 64, 30).tolist(),
+                    max_new_tokens=10),                   # length-evicts
+        ]
+        comps = sched.run(reqs)
+        assert len(comps) == 4
+        assert {c.bucket for c in comps} == {16, 32}
+        assert eng.compile_counts() == {"prefill": 1, "decode": 1}
+        assert eng.recompile_findings() == []
+        # reset must not cost a compile either
+        eng.reset()
+        more = ContinuousBatchingScheduler(eng).run(
+            [Request("again", [5, 6, 7], max_new_tokens=2)])
+        assert len(more) == 1
+        assert eng.compile_counts() == {"prefill": 1, "decode": 1}
+
+    def test_detector_negative_case(self):
+        """With baseline=0 every compiled program is a finding — the
+        detector actually reads the jit caches."""
+        eng = _tiny_engine()
+        ContinuousBatchingScheduler(eng).run(
+            [Request("a", [1, 2, 3], max_new_tokens=2)])
+        findings = eng.recompile_findings(baseline=0)
+        assert {f.details["program"] for f in findings} == \
+            {"prefill", "decode"}
+        assert all(f.severity == "error" for f in findings)
+
+    def test_cache_facts_shape(self):
+        eng = _tiny_engine(kv_cache_dtype="int8")
+        facts = eng.cache_facts()
+        assert facts["kv_cache_dtype"] == "int8"
+        assert facts["dtype_census"] == {"int8": 4}
+        assert facts["seq_buckets"] == [16, 32]
+        assert facts["max_seq"] == 32 and not facts["stacked"]

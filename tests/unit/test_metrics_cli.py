@@ -247,3 +247,51 @@ def test_postmortem_unreadable_dump_degrades(tmp_path):
     err = proc.stderr
     assert "no usable flight dump" in err
     assert "heartbeat" in err
+
+# ---------------------------------------------------------------------------
+# serve-mode summary (decode_step events from the serving scheduler)
+# ---------------------------------------------------------------------------
+
+def write_serve_log(path, steps=10, wall_s=0.02, batch=2, max_batch=2):
+    """A serving log: decode_step events only, no train steps — shaped
+    exactly like `inference/scheduler.py:_emit` writes them."""
+    session = TelemetrySession(exporters=[JsonlExporter(str(path))])
+    for i in range(steps):
+        session.emit("decode_step", step=i + 1, tokens=batch,
+                     batch=batch, occupancy=batch / max_batch,
+                     queue_depth=max(0, 3 - i), wall_s=wall_s)
+    session.close()
+    return path
+
+
+def test_serve_summary_text(tmp_path):
+    log = write_serve_log(tmp_path / "serve.jsonl")
+    proc = run_cli("summary", str(log))
+    out = proc.stdout
+    assert "serve" in out
+    assert "decode step" in out
+    assert "per-token latency" in out
+    assert "occupancy" in out
+    assert "tokens/s" in out
+
+
+def test_serve_summary_json_math(tmp_path):
+    log = write_serve_log(tmp_path / "serve.jsonl", steps=10,
+                          wall_s=0.02, batch=2, max_batch=2)
+    proc = run_cli("summary", str(log), "--json")
+    s = json.loads(proc.stdout)
+    assert s["mode"] == "serve" and s["flavor"] == "serve"
+    assert s["steps"] == 10
+    assert s["tokens"] == 20                     # 2 tokens x 10 steps
+    # every token's latency is its step's wall: constant 0.02
+    assert s["latency_s"]["p50"] == pytest.approx(0.02)
+    assert s["latency_s"]["p99"] == pytest.approx(0.02)
+    assert s["tokens_per_s"] == pytest.approx(20 / (10 * 0.02), rel=1e-6)
+    assert s["batch_occupancy"]["mean"] == pytest.approx(1.0)
+    assert s["queue_depth"]["max"] == 3
+    assert s["mfu"] is None                      # serve mode: no MFU
+
+    # diff still works across two serve runs (step_s keys are shared)
+    slower = write_serve_log(tmp_path / "b.jsonl", wall_s=0.03)
+    proc = run_cli("diff", str(log), str(slower), check=False)
+    assert "step_s.mean" in proc.stdout

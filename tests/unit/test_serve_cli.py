@@ -1,0 +1,87 @@
+"""`ds_tpu_serve` CLI end-to-end (`deepspeed_tpu/inference/serve.py`).
+
+In-process ``main(argv)`` calls (no subprocess — the CLI compiles a
+tiny model, and one interpreter amortizes jax startup): a synthetic
+open-loop stream with the compile-contract gate and telemetry JSONL
+that feeds ``ds_tpu_metrics summary`` serve mode, a request-file +
+config-file run, the --expect-compiles failure path, and the argparse
+usage errors."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.inference.serve import main
+from deepspeed_tpu.telemetry.cli import read_events, summarize
+
+
+class TestUsageErrors:
+    def test_stream_required(self):
+        with pytest.raises(SystemExit) as e:
+            main([])
+        assert e.value.code == 2
+
+    def test_streams_mutually_exclusive(self, tmp_path):
+        reqs = tmp_path / "r.jsonl"
+        reqs.write_text('{"prompt": [1]}\n')
+        with pytest.raises(SystemExit) as e:
+            main(["--requests", str(reqs), "--synthetic", "2"])
+        assert e.value.code == 2
+
+
+def test_synthetic_stream_end_to_end(tmp_path, capsys):
+    """One serve: all requests complete, exactly 2 compiles, and the
+    telemetry log summarizes in serve mode."""
+    log = tmp_path / "serve.jsonl"
+    rc = main(["--synthetic", "5", "--max-new", "4",
+               "--expect-compiles", "2", "--jsonl", str(log), "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["ok"] is True
+    assert result["requests"] == 5
+    assert len(result["completions"]) == 5
+    assert result["compile_counts"] == {"prefill": 1, "decode": 1}
+    assert all(c["tokens"] for c in result["completions"])
+    assert {c["bucket"] for c in result["completions"]} <= {16, 32}
+
+    events = read_events(str(log))
+    s = summarize(events)
+    assert s["mode"] == "serve"
+    assert s["steps"] == len(
+        [e for e in events if e.get("event") == "decode_step"])
+    assert s["tokens"] >= 5                   # >= one token per request
+    assert s["latency_s"]["p50"] is not None
+    assert 0.0 < s["batch_occupancy"]["mean"] <= 1.0
+    assert s["mfu"] is None                   # serve summaries skip MFU
+
+
+def test_requests_file_with_config(tmp_path, capsys):
+    cfg = tmp_path / "ds_config.json"
+    cfg.write_text(json.dumps({
+        "train_batch_size": 1,
+        "train_micro_batch_size_per_gpu": 1,
+        "inference": {"max_batch": 2, "seq_buckets": [16, 32],
+                      "prefill_chunk": 4, "max_new_tokens": 4}}))
+    reqs = tmp_path / "stream.jsonl"
+    reqs.write_text("\n".join([
+        json.dumps({"rid": "a", "prompt": [1, 2, 3],
+                    "max_new_tokens": 3}),
+        json.dumps({"prompt": list(range(20))}),      # bucket 32, defaults
+        json.dumps({"rid": "late", "prompt": [4, 5],
+                    "arrival_step": 3, "max_new_tokens": 2}),
+    ]) + "\n")
+    rc = main(["--config", str(cfg), "--requests", str(reqs)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "3/3 requests completed" in out
+    assert "prefill=1 decode=1" in out
+    assert "a: prompt 3 tokens -> 3 generated" in out
+
+
+def test_expect_compiles_violation_exits_nonzero(capsys):
+    rc = main(["--synthetic", "2", "--max-new", "2",
+               "--expect-compiles", "1"])
+    assert rc == 1
+    captured = capsys.readouterr()
+    assert "FAIL" in captured.err
+    assert "compile count 2 != expected 1" in captured.err
