@@ -635,7 +635,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.analysis import estimate_peak_memory
-from deepspeed_tpu.analysis.hlo import collective_bytes
+from deepspeed_tpu.analysis.hlo import collective_bytes, seq_sized_value_bytes
 from deepspeed_tpu.inference.engine import InferenceEngine
 from deepspeed_tpu.inference.scheduler import (ContinuousBatchingScheduler,
                                                Request)
@@ -643,14 +643,16 @@ from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
 from deepspeed_tpu.parallel.mesh import build_mesh
 
 
-def facts(kv_cache_dtype, mesh=None):
+def facts(kv_cache_dtype, mesh=None, attention_impl="dense"):
     cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32)
     model = GPT2LMHead(cfg)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     eng = InferenceEngine(model, params, config={
         "max_batch": 2, "seq_buckets": (16, 32), "prefill_chunk": 4,
-        "kv_cache_dtype": kv_cache_dtype}, mesh=mesh)
+        "kv_cache_dtype": kv_cache_dtype,
+        "attention_impl": attention_impl, "attention_block_k": 8},
+        mesh=mesh)
     rng = np.random.default_rng(0)
     reqs = [Request(f"r{i}",
                     rng.integers(0, cfg.vocab_size,
@@ -669,12 +671,46 @@ def facts(kv_cache_dtype, mesh=None):
                 estimate_peak_memory(hlo)["peak_bytes"]}
 
 
+def flash_ab(max_seq):
+    # dense-vs-flash decode program at a serving-sized cache, compile
+    # only (no stream): seq-sized value bytes are the HBM-traffic
+    # proxy the flash kernel must shrink, and the Pallas custom call
+    # is only present in a real TPU lowering (interpret mode inlines).
+    def one(impl):
+        cfg = gpt2_tiny(n_embd=32, n_positions=4096, dtype=jnp.float32)
+        model = GPT2LMHead(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        eng = InferenceEngine(model, params, config={
+            "max_batch": 2, "seq_buckets": (max_seq,),
+            "prefill_chunk": 4, "kv_cache_dtype": "int8",
+            "attention_impl": impl})
+        hlo = eng.decode_hlo()
+        return {"seq_sized_value_bytes":
+                    seq_sized_value_bytes(hlo, max_seq),
+                "est_peak_bytes": estimate_peak_memory(hlo)["peak_bytes"],
+                "pallas_custom_call": "tpu_custom_call" in hlo}
+    dense = one("dense")
+    flash = one("flash")
+    return {"max_seq": max_seq, "dense": dense, "flash": flash,
+            "flash_bytes_ratio":
+                flash["seq_sized_value_bytes"]
+                / max(dense["seq_sized_value_bytes"], 1),
+            "flash_below_dense":
+                flash["seq_sized_value_bytes"]
+                < dense["seq_sized_value_bytes"]}
+
+
 plain = facts(None)
 quant = facts("int8")
 tp = facts(None, mesh=build_mesh({"model": 4},
                                  devices=jax.devices()[:4]))
+flash_int8 = facts("int8", attention_impl="flash")
 out = {"n_devices": len(jax.devices()),
+       "platform": jax.devices()[0].platform,
        "plain": plain, "int8": quant, "tp4": tp,
+       "flash_int8": flash_int8,
+       "flash_ab": [flash_ab(512), flash_ab(4096)],
        "kv_bytes_ratio_int8":
            quant["cache_bytes"] / max(plain["cache_bytes"], 1)}
 print(json.dumps(out))
@@ -706,7 +742,7 @@ def inference_static_facts(timeout_s=900):
 
 
 def run_once_inference(jax, max_batch, n_requests,
-                       kv_cache_dtype=None):
+                       kv_cache_dtype=None, attention_impl="dense"):
     """GPT-2 125M greedy decode under a synthetic open-loop stream —
     tokens/sec and per-token latency percentiles from the scheduler's
     ``decode_step`` events (each token's latency = its decode step's
@@ -727,7 +763,8 @@ def run_once_inference(jax, max_batch, n_requests,
     session = TelemetrySession(history=1_000_000)
     engine = InferenceEngine(model, params, config={
         "max_batch": max_batch, "seq_buckets": (128, 512),
-        "prefill_chunk": 64, "kv_cache_dtype": kv_cache_dtype},
+        "prefill_chunk": 64, "kv_cache_dtype": kv_cache_dtype,
+        "attention_impl": attention_impl},
         session=session)
     sched = ContinuousBatchingScheduler(engine)
     rng = np.random.default_rng(0)
@@ -1687,6 +1724,12 @@ def main():
             facts = inference_static_facts()
         except Exception as e:
             facts = {"error": f"{type(e).__name__}: {e}"}
+        # flash-vs-dense decode program A/B at serving-sized caches:
+        # the 4096 ratio is the PR's headline static pin (flash must
+        # move strictly fewer cache-sized bytes than dense).
+        ab = {str(row["max_seq"]): row
+              for row in facts.get("flash_ab") or []}
+        ratio_4096 = (ab.get("4096") or {}).get("flash_bytes_ratio")
         if not on_tpu:
             cc = (facts.get("plain") or {}).get("compile_counts") or {}
             total = sum(v for v in cc.values() if v)
@@ -1696,6 +1739,9 @@ def main():
                              "programs)",
                    "value": total, "unit": "compiles",
                    "vs_baseline": 0.0,
+                   "flash_vs_dense_seq_bytes_ratio_4096":
+                       round(ratio_4096, 4)
+                       if ratio_4096 is not None else None,
                    "static_facts": facts, "live": False,
                    "note": "tokens/sec + latency percentiles require a "
                            f"TPU; backend is {platform!r} — "
@@ -1707,6 +1753,10 @@ def main():
             nreq = int(os.environ.get("BENCH_STEPS", "64"))
             res = run_once_inference(jax, max_batch=mb,
                                      n_requests=nreq)
+            flash = run_once_inference(jax, max_batch=mb,
+                                       n_requests=nreq,
+                                       kv_cache_dtype="int8",
+                                       attention_impl="flash")
             ndev = len(jax.devices())
             out = {"metric": "GPT-2 125M serving decode tokens/sec "
                              f"(greedy, continuous batching, max_batch "
@@ -1722,6 +1772,14 @@ def main():
                    "batch_occupancy": round(res["occupancy"], 3),
                    "requests": res["completions"],
                    "compile_counts": res["compiles"],
+                   "flash_int8_tokens_per_s":
+                       round(flash["tokens_per_s"], 1),
+                   "flash_speedup_vs_dense":
+                       round(flash["tokens_per_s"]
+                             / max(res["tokens_per_s"], 1e-9), 3),
+                   "flash_vs_dense_seq_bytes_ratio_4096":
+                       round(ratio_4096, 4)
+                       if ratio_4096 is not None else None,
                    "static_facts": facts, "live": True}
             save_tpu_result(out)
             emit(out)

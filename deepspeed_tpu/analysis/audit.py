@@ -612,7 +612,8 @@ def build_flavor_engine(flavor, config_overrides=None):
     return engine, _toy_batch()
 
 
-def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None):
+def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
+                 attention_impl="flash"):
     """Audit the serving engine's compiled decode program.
 
     Builds a tiny :class:`~deepspeed_tpu.inference.engine.
@@ -622,7 +623,10 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None):
     lowers the decode program through its live avals (a jit-cache hit)
     and runs the rule catalog over it — the `decode` rule pins zero
     in-loop recompiles and cache-dtype hygiene, the generic donation
-    rule pins that the ring-buffer KV cache actually aliases in place.
+    rule pins that the ring-buffer KV cache actually aliases in place,
+    and the `flash_decode` rule pins that the stock flash attention
+    path (``attention_impl="flash"``, the default) actually deleted the
+    dense full-cache contraction from the lowered program.
     """
     import jax.numpy as jnp
     from deepspeed_tpu.inference.cache import cache_dtype_census
@@ -637,7 +641,8 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None):
     toks = jnp.zeros((1, 8), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), toks)["params"]
     inf_cfg = {"max_batch": 2, "seq_buckets": (16, 32),
-               "prefill_chunk": 4, "kv_cache_dtype": kv_cache_dtype}
+               "prefill_chunk": 4, "kv_cache_dtype": kv_cache_dtype,
+               "attention_impl": attention_impl, "attention_block_k": 8}
     inf_cfg.update(config_overrides or {})
     engine = InferenceEngine(model, params, config=inf_cfg)
     sched = ContinuousBatchingScheduler(engine)
@@ -667,6 +672,11 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None):
         decode_compile_counts=engine.compile_counts(),
         decode_kv_cache_dtype=engine.kv_cache_dtype,
         decode_cache_census=census,
+        decode_attention_impl=engine.attention_impl,
+        decode_cache_payload_shape=(
+            engine.spec.max_batch, engine.spec.max_seq,
+            engine.spec.n_head, engine.spec.head_dim),
+        decode_platform=jax.devices()[0].platform,
         skip_rules={"recompile"})
     findings = run_rules(ctx, rules)
     findings.extend(engine.recompile_findings())
@@ -678,6 +688,8 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None):
     report.stats["finish_reasons"] = sorted(
         c.finish_reason for c in completions)
     report.stats["cache"] = engine.cache_facts()
+    report.stats["attention"] = {"impl": engine.attention_impl,
+                                 "block_k": engine.attention_block_k}
     report.stats["audit_wall_s"] = round(time.perf_counter() - t0, 3)
     return report
 

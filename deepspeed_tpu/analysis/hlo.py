@@ -660,6 +660,96 @@ def aliased_param_numbers(hlo_text):
     return {e["param_number"] for e in input_output_aliases(hlo_text)}
 
 
+def _dims_superset(dims, want):
+    """True iff multiset ``dims`` contains multiset ``want``."""
+    from collections import Counter
+    have = Counter(dims)
+    return all(have[d] >= n for d, n in Counter(want).items())
+
+
+def payload_shaped_dots(hlo_text, payload_dims):
+    """Dot ops touching a cache-payload-shaped array.
+
+    A dot line counts when any shape on it (output or operand) has a
+    dim MULTISET containing ``payload_dims`` — for the decode program
+    that is exactly a dense attention contraction over the full
+    ``[max_batch, max_seq, n_head, head_dim]`` KV buffer (the einsum's
+    batched layout permutes those dims, hence multiset, and no other
+    decode dot carries all four sizes at once). The flash-decode audit
+    pins this list empty: the Pallas kernel's dots only ever see
+    ``block_k``-sized cache slices.
+    """
+    out = []
+    want = tuple(int(d) for d in payload_dims)
+    for line in hlo_text.splitlines():
+        if " dot(" not in line:
+            continue
+        for _, dims in _SHAPE_RE.findall(line):
+            if not dims:
+                continue
+            ds = [int(x) for x in dims.split(",")]
+            if len(ds) >= len(want) and _dims_superset(ds, want):
+                out.append(line.strip())
+                break
+    return out
+
+
+def seq_sized_value_bytes(hlo_text, seq):
+    """Total bytes of value DEFINITIONS carrying a ``seq``-sized dim,
+    entry parameters excluded — a compile-time proxy for how much
+    cache-length-proportional data a decode step materializes. The
+    dense path defines attention-score rows, softmax temporaries and
+    (quantized) dequant copies all shaped ``[..., max_seq, ...]``; the
+    flash kernel's working set is ``block_k``-sized, so only the
+    written-back cache itself survives at this size. Parameters are
+    excluded because both paths take the identical cache buffers as
+    inputs — the A/B signal is in what the program CREATES.
+    """
+    total = 0
+    seq = int(seq)
+    for line in hlo_text.splitlines():
+        ls = line.strip()
+        if "parameter(" in ls or "=" not in ls:
+            continue
+        shape_text = ls.split("=", 1)[1]
+        # shape(s) sit between '=' and the op name; stop at the first
+        # opcode paren to avoid re-counting operand shapes.
+        op_at = shape_text.find("(")
+        if op_at >= 0:
+            shape_text = shape_text[:op_at]
+        for dtype, dims in _SHAPE_RE.findall(shape_text):
+            if dtype not in _DTYPE_BYTES or not dims:
+                continue
+            ds = [int(x) for x in dims.split(",")]
+            if seq not in ds:
+                continue
+            n = 1
+            for d in ds:
+                n *= d
+            total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def payload_shaped_values(hlo_text, dtype, payload_dims):
+    """Count value DEFINITIONS of ``dtype`` whose dims contain
+    ``payload_dims`` (multiset). With a quantized KV cache these are
+    full-precision cache-sized intermediates — the dense path's
+    dequantized copies; the per-head scale planes lack ``head_dim`` so
+    they never match. Zero under flash decode: dequantization happens
+    in-register on ``block_k`` slices."""
+    n = 0
+    want = tuple(int(d) for d in payload_dims)
+    defre = re.compile(r"=\s+" + re.escape(dtype) + r"\[([\d,]+)\]")
+    for line in hlo_text.splitlines():
+        m = defre.search(line)
+        if not m:
+            continue
+        ds = [int(x) for x in m.group(1).split(",")]
+        if len(ds) >= len(want) and _dims_superset(ds, want):
+            n += 1
+    return n
+
+
 # Custom-call targets that round-trip through the Python host (jax
 # pure_callback / io_callback / debug.callback lower to these).
 _HOST_CALLBACK_TARGETS = (

@@ -125,6 +125,17 @@ class StepContext:
     decode_expected_compiles: int = 1
     decode_kv_cache_dtype: str = None
     decode_cache_census: dict = None
+    # Flash-decode attention (`ops/pallas/flash_decode.py`):
+    # decode_attention_impl names the engine's configured decode
+    # attention ("dense" | "flash"; None = not a serving audit),
+    # decode_cache_payload_shape is one layer's k/v buffer shape
+    # (max_batch, max_seq, n_head, head_dim), and decode_platform is
+    # the backend the audited program lowered for — the Pallas
+    # custom-call pin only applies to real TPU lowerings (interpret
+    # mode inlines the kernel as plain HLO).
+    decode_attention_impl: str = None
+    decode_cache_payload_shape: tuple = None
+    decode_platform: str = None
     skip_rules: set = field(default_factory=set)
 
 
@@ -734,6 +745,67 @@ def rule_decode(ctx):
     return findings
 
 
+def rule_flash_decode(ctx):
+    """Flash decode actually deleted the dense attention work.
+
+    When the engine promises ``attention_impl="flash"`` the compiled
+    decode program must show it, not just route through a differently-
+    named Python function:
+
+    - on TPU the Pallas kernel lowers to a ``custom-call`` — its
+      absence means the kernel silently fell back to something XLA
+      made up (interpret mode off-TPU inlines the kernel as plain HLO,
+      so that pin is platform-gated);
+    - NO dot may touch a full cache-payload-shaped array
+      (`analysis/hlo.py:payload_shaped_dots`): one surviving
+      ``[max_batch, max_seq, n_head, head_dim]`` contraction means the
+      dense softmax is still running and the O(max_seq) HBM traffic
+      the kernel exists to delete is still being paid;
+    - with a quantized cache, NO f32 value may be cache-payload-shaped
+      (`payload_shaped_values`): such a value is the dense path's
+      dequantized HBM copy — flash dequantizes in-register per block.
+    """
+    if ctx.decode_attention_impl != "flash":
+        return []
+    findings = []
+    if ctx.decode_platform == "tpu" and "custom-call" not in ctx.hlo_text:
+        findings.append(Finding(
+            "flash_decode", SEV_ERROR,
+            "attention_impl='flash' on TPU but the decode program "
+            "contains no custom-call — the Pallas flash-decode kernel "
+            "never made it into the lowering",
+            {"platform": ctx.decode_platform}))
+    payload = ctx.decode_cache_payload_shape
+    if payload:
+        from deepspeed_tpu.analysis.hlo import (payload_shaped_dots,
+                                                payload_shaped_values)
+        dots = payload_shaped_dots(ctx.hlo_text, payload)
+        if dots:
+            findings.append(Finding(
+                "flash_decode", SEV_ERROR,
+                f"attention_impl='flash' but {len(dots)} dot(s) still "
+                f"contract over the full cache payload shape "
+                f"{tuple(payload)} — the dense attention softmax "
+                f"survived the rewrite",
+                {"payload_shape": tuple(payload),
+                 "dots": dots[:8]}))
+        from deepspeed_tpu.runtime.comm.codecs import CODECS
+        if ctx.decode_kv_cache_dtype in CODECS:
+            n = payload_shaped_values(ctx.hlo_text, "f32", payload)
+            if n:
+                findings.append(Finding(
+                    "flash_decode", SEV_ERROR,
+                    f"quantized KV cache "
+                    f"({ctx.decode_kv_cache_dtype!r}) but the decode "
+                    f"program materializes {n} f32 cache-payload-"
+                    f"shaped value(s) — a full-precision dequantized "
+                    f"cache copy is being written to HBM",
+                    {"payload_shape": tuple(payload),
+                     "f32_payload_values": n,
+                     "kv_cache_dtype": ctx.decode_kv_cache_dtype}))
+    return findings
+
+
 # Rule catalog: id -> rule. `recompile` is listed for config validation
 # but runs in the orchestrator (it needs live step objects, not HLO).
 RULES = {
@@ -748,6 +820,7 @@ RULES = {
     "peak_memory": rule_peak_memory,
     "fp8": rule_fp8,
     "decode": rule_decode,
+    "flash_decode": rule_flash_decode,
 }
 RULE_IDS = tuple(RULES) + ("recompile",)
 

@@ -224,14 +224,67 @@ def read_kv(layer_cache, dtype):
             _dequantize(layer_cache["v"], layer_cache["v_scale"], dtype))
 
 
+def attention_mask(layer_cache, positions):
+    """The dense path's ``[B, T, S]`` position mask (cache index ``s``
+    visible to the query at position ``p`` iff ``s <= p``). Exposed so
+    callers running several layers per step (`models/gpt2.py`) can
+    compute it ONCE and pass it down — rebuilt per layer it is the
+    compiled decode program's only per-layer iota."""
+    S = layer_cache["k"].shape[-3]
+    return jnp.arange(S)[None, None, :] <= positions[:, :, None]
+
+
+def _flash_attend(q, layer_cache, positions, block_k, mesh):
+    """Flash split-K attention straight over the STORAGE buffers —
+    quantized caches stream int8/f8 payloads + f32 scales into the
+    kernel (`ops/pallas/flash_decode.py`) and never materialize a
+    dequantized ``[B, S, H, D]`` copy. With a TP ``mesh`` the call runs
+    under ``shard_map`` over the head axis, matching
+    :func:`kv_partition_specs` — each shard's kernel sees only its
+    local heads, collective-free."""
+    from deepspeed_tpu.ops.pallas.flash_decode import flash_decode
+
+    pos = positions[:, 0]
+    scales = ()
+    if "k_scale" in layer_cache:
+        scales = (layer_cache["k_scale"], layer_cache["v_scale"])
+
+    if mesh is None:
+        return flash_decode(q, layer_cache["k"], layer_cache["v"], pos,
+                            *scales, block_k=block_k)
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    head = P(None, None, "model", None)
+    in_specs = (head, head, head, P(None)) + \
+        ((P(None, None, "model"),) * 2 if scales else ())
+    sharded = shard_map(
+        lambda q_, k_, v_, p_, *s_: flash_decode(q_, k_, v_, p_, *s_,
+                                                 block_k=block_k),
+        mesh=mesh, in_specs=in_specs, out_specs=head, check_rep=False)
+    return sharded(q, layer_cache["k"], layer_cache["v"], pos, *scales)
+
+
 def cached_attention(q, k_new, v_new, layer_cache, positions,
-                     compute_dtype):
+                     compute_dtype, impl="dense", block_k=128,
+                     mesh=None, mask=None):
     """Write this chunk's k/v, then attend over the whole cache row.
 
     ``q``/``k_new``/``v_new``: ``[B, T, H, D]`` (T = 1 for a decode
     step, ``prefill_chunk`` for a prefill chunk); ``positions``:
     ``[B, T]`` absolute token positions, contiguous per row. Returns
     ``(y [B, T, H, D], updated layer_cache)``.
+
+    ``impl="flash"`` routes decode steps (T == 1) through the Pallas
+    split-K kernel (`ops/pallas/flash_decode.py`): online-softmax over
+    ``block_k``-sized cache blocks with past-occupancy blocks skipped,
+    and quantized storage dequantized IN-kernel (scales as a side
+    input — no fp32 cache copy). Prefill chunks (T > 1) always use the
+    dense path, which stays the parity oracle. ``mesh``: a TP mesh
+    whose ``model`` axis shards the cache's head dim — the flash call
+    then runs under ``shard_map`` per local head shard. ``mask``: a
+    precomputed :func:`attention_mask` (dense path only) so multi-layer
+    callers hoist it out of the per-layer body.
 
     The mask admits cache index ``s`` for the query at position ``p``
     iff ``s <= p`` — the cached generalization of the training path's
@@ -241,12 +294,15 @@ def cached_attention(q, k_new, v_new, layer_cache, positions,
     until a real token overwrites the slot.
     """
     layer_cache = write_kv(layer_cache, k_new, v_new, positions)
+    if impl == "flash" and q.shape[1] == 1:
+        y = _flash_attend(q, layer_cache, positions, block_k, mesh)
+        return y.astype(compute_dtype), layer_cache
     k_full, v_full = read_kv(layer_cache, compute_dtype)
-    S = k_full.shape[1]
     D = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, compute_dtype))
     att = jnp.einsum("bthd,bshd->bhts", q, k_full) * scale
-    mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]
+    if mask is None:
+        mask = attention_mask(layer_cache, positions)
     att = jnp.where(mask[:, None], att, jnp.finfo(att.dtype).min)
     att = jax.nn.softmax(att.astype(jnp.float32),
                          axis=-1).astype(compute_dtype)

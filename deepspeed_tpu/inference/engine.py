@@ -48,6 +48,7 @@ DEFAULT_MAX_BATCH = 8
 DEFAULT_SEQ_BUCKETS = (128, 512)
 DEFAULT_PREFILL_CHUNK = 32
 DEFAULT_MAX_NEW_TOKENS = 64
+DEFAULT_ATTENTION_BLOCK_K = 128
 
 
 def _cfg_get(config, key, default):
@@ -85,6 +86,26 @@ class InferenceEngine:
         self.kv_cache_dtype = _cfg_get(config, "kv_cache_dtype", None)
         self.max_new_tokens = int(_cfg_get(config, "max_new_tokens",
                                            DEFAULT_MAX_NEW_TOKENS))
+        self.attention_impl = str(_cfg_get(config, "attention_impl",
+                                           "dense"))
+        self.attention_block_k = int(_cfg_get(config, "attention_block_k",
+                                              DEFAULT_ATTENTION_BLOCK_K))
+        self.temperature = float(_cfg_get(config, "temperature", 0.0))
+        self.top_k = int(_cfg_get(config, "top_k", 0))
+        self.top_p = float(_cfg_get(config, "top_p", 1.0))
+        self.sampling_seed = int(_cfg_get(config, "sampling_seed", 0))
+        if self.attention_impl not in ("dense", "flash"):
+            raise ValueError(
+                f"inference.attention.impl must be 'dense' or 'flash', "
+                f"got {self.attention_impl!r}")
+        if self.temperature < 0.0:
+            raise ValueError(f"sampling temperature must be >= 0, got "
+                             f"{self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got "
+                             f"{self.top_p}")
         if self.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got "
                              f"{self.max_batch}")
@@ -102,14 +123,29 @@ class InferenceEngine:
                     f"every seq bucket must be a multiple of "
                     f"prefill_chunk={self.prefill_chunk}; got bucket {b}")
         self.max_seq = max(self.seq_buckets)
+        # flash block size clamps to the cache length and must tile it
+        # (the kernel's grid is max_seq / block_k blocks per row).
+        self.attention_block_k = min(self.attention_block_k, self.max_seq)
+        if self.attention_block_k < 1 or \
+                self.max_seq % self.attention_block_k:
+            raise ValueError(
+                f"attention block_k {self.attention_block_k} must be a "
+                f"positive divisor of max_seq {self.max_seq}")
         self.spec = spec_for_model(cfg, self.max_batch, self.max_seq,
                                    self.kv_cache_dtype)
         self.mesh = mesh
         self.session = session
+        self._sample_key = jax.random.PRNGKey(self.sampling_seed)
 
         self._cache_shardings = None
         if mesh is not None and dict(mesh.shape).get("model", 1) > 1:
-            from jax.sharding import NamedSharding
+            from jax.sharding import NamedSharding, PartitionSpec
+            # commit the sampling key (replicated) up front: an
+            # uncommitted first-call key would compile the decode
+            # program once, come back committed, and recompile on the
+            # second step — breaking the 2-program contract under TP.
+            self._sample_key = jax.device_put(
+                self._sample_key, NamedSharding(mesh, PartitionSpec()))
             from deepspeed_tpu.models.gpt2 import gpt2_partition_specs
             params = jax.tree_util.tree_map(
                 lambda leaf, spec: jax.device_put(
@@ -156,13 +192,22 @@ class InferenceEngine:
         # so fp32 parity with the full forward stays bit-exact).
         return logits.astype(jnp.float32), self._pin_cache(cache)
 
-    def _decode_fn(self, params, cache, tokens, positions):
+    def _decode_fn(self, params, cache, tokens, positions, key):
+        # attention impl / block size / sampling knobs are static (read
+        # off self at trace time): they select the traced graph, never
+        # ride as runtime values — changing them means a new engine.
+        mesh = self.mesh if self._cache_shardings is not None else None
         logits, cache = self.model.apply(
             {"params": params}, tokens[:, None], deterministic=True,
-            positions=positions[:, None], kv_cache=cache)
+            positions=positions[:, None], kv_cache=cache,
+            attn_impl=self.attention_impl,
+            attn_block_k=self.attention_block_k, attn_mesh=mesh)
         logits = logits[:, 0]
-        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return next_tokens, logits.astype(jnp.float32), \
+        from deepspeed_tpu.inference.sampling import sample_logits
+        next_tokens, key = sample_logits(
+            logits, key, temperature=self.temperature,
+            top_k=self.top_k, top_p=self.top_p)
+        return next_tokens, logits.astype(jnp.float32), key, \
             self._pin_cache(cache)
 
     # -- host API -----------------------------------------------------------
@@ -198,13 +243,27 @@ class InferenceEngine:
         ``positions``: ``[max_batch]`` int arrays (inactive rows padded
         with zeros — their outputs are meaningless and ignored).
         Returns ``(next_tokens [max_batch], logits [max_batch, vocab])``
-        as numpy; greedy argmax happens in-program so sampling costs no
+        as numpy; sampling (greedy argmax, or temperature/top-k/top-p
+        with the threaded PRNG key) happens in-program so it costs no
         extra device round trip."""
         t = jnp.asarray(np.asarray(tokens, np.int32))
         p = jnp.asarray(np.asarray(positions, np.int32))
-        nxt, logits, self.cache = self._decode(self.params, self.cache,
-                                               t, p)
+        nxt, logits, self._sample_key, self.cache = self._decode(
+            self.params, self.cache, t, p, self._sample_key)
         return np.asarray(nxt), np.asarray(logits)
+
+    def sample_first(self, last_logits):
+        """Sample the FIRST generated token from prefill's last-prompt-
+        token logits (``[vocab]`` numpy) with the same temperature /
+        top-k / top-p pipeline the compiled decode step uses — one tiny
+        eager call at admission time, sharing the decode key stream so
+        a fixed request stream samples reproducibly."""
+        from deepspeed_tpu.inference.sampling import sample_logits
+        tok, self._sample_key = sample_logits(
+            jnp.asarray(last_logits, jnp.float32), self._sample_key,
+            temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p)
+        return int(tok)
 
     def reset(self):
         """Zero the cache (rows all free). Compiled programs survive —
@@ -253,7 +312,8 @@ class InferenceEngine:
         these is a jit-cache hit, never a fresh compile."""
         return (self.params, self.cache,
                 jnp.zeros((self.max_batch,), jnp.int32),
-                jnp.zeros((self.max_batch,), jnp.int32))
+                jnp.zeros((self.max_batch,), jnp.int32),
+                self._sample_key)
 
     def decode_hlo(self):
         """Compiled HLO text of the decode program (audit/bench food)."""

@@ -1,0 +1,78 @@
+"""In-program token sampling for the decode step.
+
+With flash decode the attention math stops dominating the step, and
+the old host-side sampling round trip (logits → host → argmax → next
+token back to device) becomes the cost floor. This module keeps the
+whole temperature / top-k / top-p pipeline INSIDE the compiled decode
+program: the knobs are static Python values baked into the trace, and
+randomness threads a JAX PRNG key through the program (key in, fresh
+key out), so the serving loop stays at exactly the same two compiled
+programs — sampling adds zero device round trips and zero jit cache
+entries.
+
+``temperature == 0.0`` is a static greedy path: plain argmax, bit-for-
+bit the pre-sampling behavior, key passed through untouched (so a
+greedy serve consumes no randomness and stays reproducible regardless
+of seed).
+"""
+
+import jax
+import jax.numpy as jnp
+
+# Additive knockout for filtered logits: exp() underflows to exactly
+# 0.0 in fp32, so a filtered token's probability is exactly zero.
+_FILTERED = -1e30
+
+
+def _apply_top_k(logits, top_k):
+    """Keep the ``top_k`` largest logits per row; knock out the rest.
+    ``top_k`` static; 0 (or >= vocab) disables the filter."""
+    vocab = logits.shape[-1]
+    if not top_k or top_k >= vocab:
+        return logits
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits >= kth, logits, _FILTERED)
+
+
+def _apply_top_p(logits, top_p):
+    """Nucleus filter: keep the smallest set of tokens whose cumulative
+    probability reaches ``top_p`` (static; 1.0 disables). The top token
+    always survives (its exclusive cumulative mass is 0 < top_p)."""
+    if top_p >= 1.0:
+        return logits
+    sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    # exclusive cumulative mass BEFORE each token: the nucleus is every
+    # token whose predecessors haven't already covered top_p.
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    keep = cum < top_p
+    cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits >= cutoff, logits, _FILTERED)
+
+
+def sample_logits(logits, key, temperature=0.0, top_k=0, top_p=1.0):
+    """Sample next tokens from ``[..., vocab]`` logits.
+
+    Returns ``(tokens int32 [...], new_key)``. ``temperature`` /
+    ``top_k`` / ``top_p`` are STATIC Python numbers (they select the
+    traced graph; changing them mid-serve would be a recompile — the
+    engine pins them at construction). Filter order is the standard
+    temperature → top-k → top-p, sampling via Gumbel trick
+    (``jax.random.categorical``) over the filtered logits.
+    """
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if temperature == 0.0:
+        # static greedy path: no randomness consumed, key untouched
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
+    key, sub = jax.random.split(key)
+    scaled = logits.astype(jnp.float32) / float(temperature)
+    scaled = _apply_top_k(scaled, int(top_k))
+    scaled = _apply_top_p(scaled, float(top_p))
+    tokens = jax.random.categorical(sub, scaled, axis=-1)
+    return tokens.astype(jnp.int32), key

@@ -122,7 +122,7 @@ class ContinuousBatchingScheduler:
                 break
             req = self.queue.popleft()
             last_logits = self.engine.prefill(i, req.prompt)
-            first = int(np.argmax(last_logits))
+            first = self.engine.sample_first(last_logits)
             self.slots[i] = _Slot(
                 request=req, bucket=self._bucket_for(req),
                 next_pos=len(req.prompt), pending=first,

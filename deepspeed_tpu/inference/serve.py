@@ -81,6 +81,21 @@ def main(argv=None):
                         help="override inference.seq_buckets, e.g. 16,32")
     parser.add_argument("--prefill-chunk", type=int, default=None,
                         help="override inference.prefill_chunk")
+    parser.add_argument("--attention", default=None,
+                        choices=("dense", "flash"),
+                        help="decode attention impl: dense softmax or "
+                             "the Pallas flash-decode kernel")
+    parser.add_argument("--block-k", type=int, default=None,
+                        help="flash-decode KV block size (must divide "
+                             "max(seq_buckets))")
+    parser.add_argument("--temperature", type=float, default=None,
+                        help="sampling temperature (0 = greedy argmax, "
+                             "the default)")
+    parser.add_argument("--top-k", type=int, default=None,
+                        help="keep only the k most likely tokens "
+                             "(0 = disabled)")
+    parser.add_argument("--top-p", type=float, default=None,
+                        help="nucleus sampling mass (1.0 = disabled)")
     parser.add_argument("--requests", default=None,
                         help="JSONL request stream (one request/line)")
     parser.add_argument("--synthetic", type=int, default=0,
@@ -134,7 +149,13 @@ def main(argv=None):
                    "seq_buckets": inf.seq_buckets,
                    "prefill_chunk": inf.prefill_chunk,
                    "kv_cache_dtype": inf.kv_cache_dtype,
-                   "max_new_tokens": inf.max_new_tokens}
+                   "max_new_tokens": inf.max_new_tokens,
+                   "attention_impl": inf.attention_impl,
+                   "attention_block_k": inf.attention_block_k,
+                   "temperature": inf.temperature,
+                   "top_k": inf.top_k,
+                   "top_p": inf.top_p,
+                   "sampling_seed": inf.sampling_seed}
     if args.max_batch is not None:
         inf_cfg["max_batch"] = args.max_batch
     if args.seq_buckets is not None:
@@ -144,6 +165,21 @@ def main(argv=None):
         inf_cfg["prefill_chunk"] = args.prefill_chunk
     if args.kv_cache_dtype is not None:
         inf_cfg["kv_cache_dtype"] = args.kv_cache_dtype
+    if args.attention is not None:
+        inf_cfg["attention_impl"] = args.attention
+    if args.block_k is not None:
+        inf_cfg["attention_block_k"] = args.block_k
+    if args.temperature is not None:
+        inf_cfg["temperature"] = args.temperature
+    if args.top_k is not None:
+        inf_cfg["top_k"] = args.top_k
+    if args.top_p is not None:
+        inf_cfg["top_p"] = args.top_p
+    # --seed doubles as the sampling seed: one knob pins params, the
+    # synthetic stream, AND the in-program sampler, so a serve is
+    # reproducible end to end (a non-default --seed beats the config).
+    if args.seed != 0 or "sampling_seed" not in inf_cfg:
+        inf_cfg["sampling_seed"] = args.seed
 
     session = None
     if args.jsonl:
@@ -174,6 +210,11 @@ def main(argv=None):
         "decode_steps": sched.step_count,
         "compile_counts": counts,
         "cache": engine.cache_facts(),
+        "attention": {"impl": engine.attention_impl,
+                      "block_k": engine.attention_block_k},
+        "sampling": {"temperature": engine.temperature,
+                     "top_k": engine.top_k, "top_p": engine.top_p,
+                     "seed": engine.sampling_seed},
     }
     ok = len(completions) == len(requests)
     if args.expect_compiles is not None:

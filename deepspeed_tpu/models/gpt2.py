@@ -122,7 +122,8 @@ class CausalSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic=True, positions=None,
-                 kv_cache=None):
+                 kv_cache=None, attn_impl="dense", attn_block_k=128,
+                 attn_mesh=None, attn_mask=None):
         cfg = self.config
         B, T, C = x.shape
         H = cfg.n_head
@@ -137,7 +138,11 @@ class CausalSelfAttention(nn.Module):
         if kv_cache is not None:
             from deepspeed_tpu.inference.cache import cached_attention
             y, new_cache = cached_attention(q, k, v, kv_cache, positions,
-                                            compute_dtype=cfg.dtype)
+                                            compute_dtype=cfg.dtype,
+                                            impl=attn_impl,
+                                            block_k=attn_block_k,
+                                            mesh=attn_mesh,
+                                            mask=attn_mask)
         elif cfg.use_flash_attention:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
             # Attention-prob dropout runs inside the kernels (counter-based
@@ -201,7 +206,9 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic=True, pld_theta=None,
-                 layer_idx=None, positions=None, kv_cache=None):
+                 layer_idx=None, positions=None, kv_cache=None,
+                 attn_impl="dense", attn_block_k=128, attn_mesh=None,
+                 attn_mask=None):
         cfg = self.config
         attn = CausalSelfAttention(cfg, name="attn")
         mlp = MLP(cfg, name="mlp")
@@ -213,7 +220,10 @@ class Block(nn.Module):
             # deterministic), and the attention call also returns the
             # layer's updated cache.
             a, new_cache = attn(ln1(x), deterministic,
-                                positions=positions, kv_cache=kv_cache)
+                                positions=positions, kv_cache=kv_cache,
+                                attn_impl=attn_impl,
+                                attn_block_k=attn_block_k,
+                                attn_mesh=attn_mesh, attn_mask=attn_mask)
             x = x + a
             x = x + mlp(ln2(x), deterministic)
             return x, new_cache
@@ -257,7 +267,8 @@ class GPT2LMHead(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, deterministic=True, pld_theta=None,
-                 return_hidden=False, positions=None, kv_cache=None):
+                 return_hidden=False, positions=None, kv_cache=None,
+                 attn_impl="dense", attn_block_k=128, attn_mesh=None):
         cfg = self.config
         B, T = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
@@ -289,26 +300,39 @@ class GPT2LMHead(nn.Module):
             policy = policies[cfg.remat_policy]
             block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
         new_kv = None
+        attn_mask = None
+        if kv_cache is not None and attn_impl == "dense":
+            # Hoist the dense cached-attention position mask: computed
+            # once here and broadcast to every layer, instead of each
+            # layer rebuilding the same [B, T, max_seq] iota-compare
+            # inside the compiled decode program (the flash path masks
+            # in-kernel from the positions scalar and needs none).
+            from deepspeed_tpu.inference.cache import attention_mask
+            layer0 = kv_cache["h" if cfg.scan_layers else "h_0"]
+            attn_mask = attention_mask(layer0, positions)
         if cfg.scan_layers and kv_cache is not None:
             # decode over the scanned stack: the per-layer cache slices
             # ride the same lax.scan as the stacked params (in_axes=0
             # over the (iota, cache) pair), and the updated slices come
             # back as the scan's stacked ys.
-            def body(block, h, xs, det, pos):
+            def body(block, h, xs, det, pos, mask):
                 idx, layer_cache = xs
                 h, new_c = block(h, det, None, layer_idx=idx,
-                                 positions=pos, kv_cache=layer_cache)
+                                 positions=pos, kv_cache=layer_cache,
+                                 attn_impl=attn_impl,
+                                 attn_block_k=attn_block_k,
+                                 attn_mesh=attn_mesh, attn_mask=mask)
                 return h, new_c
 
             scan = nn.scan(
                 body,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True, "pld": True},
-                in_axes=(0, nn.broadcast, nn.broadcast),
+                in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast),
                 length=cfg.n_layer)
             x, new_h = scan(block_cls(cfg, n_layers=cfg.n_layer, name="h"),
                             x, (jnp.arange(cfg.n_layer), kv_cache["h"]),
-                            deterministic, positions)
+                            deterministic, positions, attn_mask)
             new_kv = {"h": new_h}
         elif cfg.scan_layers:
             # One lax.scan over layer-stacked params instead of n_layer
@@ -337,7 +361,11 @@ class GPT2LMHead(nn.Module):
                     cfg, layer_idx=i, n_layers=cfg.n_layer,
                     name=f"h_{i}")(x, deterministic, None,
                                    positions=positions,
-                                   kv_cache=kv_cache[f"h_{i}"])
+                                   kv_cache=kv_cache[f"h_{i}"],
+                                   attn_impl=attn_impl,
+                                   attn_block_k=attn_block_k,
+                                   attn_mesh=attn_mesh,
+                                   attn_mask=attn_mask)
         else:
             for i in range(cfg.n_layer):
                 x = block_cls(cfg, layer_idx=i, n_layers=cfg.n_layer,

@@ -1,0 +1,198 @@
+"""Flash-decode: split-K attention over the serving ring-buffer cache.
+
+The decode step of the serving engine (`inference/engine.py`) attends
+one query token per row over the full ``[max_batch, max_seq]`` KV
+cache. The dense path dequantizes the whole cache to compute dtype and
+runs a ``[1, max_seq]`` softmax per head — O(max_seq) HBM traffic per
+token no matter how short the active requests are. This kernel is the
+FlashDecoding-style fix, specialized for the ring buffer:
+
+- **split-K online softmax**: the cache row streams through VMEM in
+  ``block_k``-sized KV blocks; partial max/sum accumulators merge
+  across blocks in scratch (the cross-block log-sum-exp merge), so the
+  ``[1, max_seq]`` score row never materializes.
+- **active-length block skipping**: each cache row's occupancy is its
+  ``positions[b]`` scalar, prefetched into SMEM before the grid runs.
+  Blocks entirely past a row's position are predicated off with
+  ``pl.when`` AND their index map clamps to the last active block —
+  Pallas skips the DMA when consecutive grid steps ask for the same
+  block, so HBM traffic scales with the *occupied* cache, not
+  ``max_seq``.
+- **fused KV dequantization**: int8/f8e4m3fn/f8e5m2 cache blocks
+  (`inference/cache.py` codec storage) enter the kernel in their
+  storage dtype with the per-(row, position, head) scales streamed as
+  a side input; scores and probs are rescaled in-register. The
+  quantized cache never materializes an fp32 copy in HBM — the dense
+  path's ``read_kv`` dequant is exactly what this deletes.
+- **head folding**: heads fold into the grid's leading dim
+  (``[B, S, H, D] → [B*H, S, D]``, the `flash_attention.py` layout),
+  so a tensor-parallel head shard (`cache.kv_partition_specs`) runs
+  the same kernel over its local heads under ``shard_map`` — the
+  block-spec arithmetic never sees the global head count.
+
+Off-TPU the kernel runs in Pallas interpret mode (CPU test meshes);
+the dense cached-attention path stays available as the parity oracle
+behind ``inference.attention.impl``.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.pallas.flash_attention import DEFAULT_MASK_VALUE
+
+DEFAULT_BLOCK_K = 128
+
+
+def _fold_heads(x):
+    """[B, S, H, D] → [B*H, S, D] (heads into the grid's leading dim)."""
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
+def _flash_decode_kernel(H, D, block_k, n_kb, quant):
+    """Kernel factory: one (row*head, kv-block) grid step.
+
+    Scalar-prefetch arg 0 is the ``[B]`` positions vector (SMEM);
+    scratch carries the online-softmax state (acc [1, D], running max
+    and sum [1, 1]) across the sequential kv-block dim.
+    """
+
+    def kernel(pos_ref, q_ref, k_ref, v_ref, *refs):
+        refs = list(refs)
+        ks_ref = refs.pop(0) if quant else None
+        vs_ref = refs.pop(0) if quant else None
+        o_ref, acc_ref, m_ref, l_ref = refs
+        bh = pl.program_id(0)
+        ki = pl.program_id(1)
+        p = pos_ref[bh // H]
+
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[:] = jnp.zeros_like(l_ref)
+
+        # Block-level active-length predicate: a block whose first
+        # position is past the row's occupancy contributes nothing —
+        # skip the whole grid step (its DMA was already elided by the
+        # clamped index map).
+        run = (ki * block_k) <= p
+
+        @pl.when(run)
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32)              # [1, D]
+            kb = k_ref[0].astype(jnp.float32)              # [bk, D]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [1, bk]
+            if quant:
+                # fused dequant: scale the SCORES by the key scales
+                # (dot distributes over the per-position scalar) —
+                # the kb block itself stays in storage dtype.
+                s = s * ks_ref[0][:, 0][None, :]
+            s = s * (D ** -0.5)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(k_pos <= p, s, DEFAULT_MASK_VALUE)
+            m_prev = m_ref[0, 0]
+            m_new = jnp.maximum(m_prev, s.max())
+            pr = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_ref[0, 0] = l_ref[0, 0] * corr + pr.sum()
+            m_ref[0, 0] = m_new
+            if quant:
+                # value scales fold into the probs the same way
+                pr = pr * vs_ref[0][:, 0][None, :]
+            vb = v_ref[0].astype(jnp.float32)              # [bk, D]
+            acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+                pr, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(ki == n_kb - 1)
+        def _finish():
+            o_ref[0] = (acc_ref[:] /
+                        jnp.maximum(l_ref[0, 0], 1e-30)).astype(o_ref.dtype)
+
+    return kernel
+
+
+def flash_decode(q, k, v, positions, k_scale=None, v_scale=None,
+                 block_k=DEFAULT_BLOCK_K, interpret=None):
+    """Split-K flash decode over one layer's cache buffers.
+
+    ``q``: ``[B, 1, H, D]`` compute-dtype query (the decode step's
+    single token per row). ``k``/``v``: ``[B, S, H, D]`` cache buffers
+    in STORAGE dtype — compute dtype, or a codec dtype
+    (int8/f8e4m3fn/f8e5m2) with ``k_scale``/``v_scale`` ``[B, S, H]``
+    f32 absmax scales (`inference/cache.py` layout). ``positions``:
+    ``[B]`` int32, each row's current write position (the mask admits
+    cache index ``s`` iff ``s <= positions[b]`` — identical to the
+    dense oracle's). Returns ``[B, 1, H, D]`` in ``q.dtype``.
+
+    ``interpret=None`` auto-selects: compiled kernel on TPU, Pallas
+    interpret mode elsewhere. Under tensor parallelism call through
+    ``shard_map`` with the head axis sharded (`cache.kv_partition_
+    specs`); the kernel only ever sees local heads.
+    """
+    B, S, H, D = k.shape
+    if q.shape != (B, 1, H, D):
+        raise ValueError(
+            f"flash_decode takes one query token per row: q shape "
+            f"{q.shape} != {(B, 1, H, D)}")
+    block_k = min(int(block_k), S)
+    if S % block_k:
+        raise ValueError(
+            f"max_seq {S} must be a multiple of attention block_k "
+            f"{block_k}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    quant = k_scale is not None
+    n_kb = S // block_k
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    qh = q.transpose(0, 2, 1, 3).reshape(B * H, 1, D)
+    kh = _fold_heads(k)
+    vh = _fold_heads(v)
+
+    def q_map(bh, ki, pos_ref):
+        return (bh, 0, 0)
+
+    def kv_map(bh, ki, pos_ref):
+        # Clamp past-occupancy block indices to the row's last active
+        # block: consecutive grid steps then request the SAME block and
+        # Pallas elides the DMA — the skipped blocks cost no HBM reads.
+        return (bh, jnp.minimum(ki, pos_ref[bh // H] // block_k), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, D), q_map),
+        pl.BlockSpec((1, block_k, D), kv_map),
+        pl.BlockSpec((1, block_k, D), kv_map),
+    ]
+    args = [qh, kh, vh]
+    if quant:
+        in_specs += [pl.BlockSpec((1, block_k, 1), kv_map),
+                     pl.BlockSpec((1, block_k, 1), kv_map)]
+        args += [k_scale.transpose(0, 2, 1).reshape(B * H, S, 1),
+                 v_scale.transpose(0, 2, 1).reshape(B * H, S, 1)]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B * H, n_kb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((1, D), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        _flash_decode_kernel(H, D, block_k, n_kb, quant),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, 1, D), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(positions, jnp.int32), *args)
+    return out.reshape(B, H, 1, D).transpose(0, 2, 1, 3)

@@ -648,7 +648,9 @@ class InferenceConfig:
 
     KEYS = (INFERENCE_MAX_BATCH, INFERENCE_SEQ_BUCKETS,
             INFERENCE_PREFILL_CHUNK, INFERENCE_KV_CACHE_DTYPE,
-            INFERENCE_MAX_NEW_TOKENS)
+            INFERENCE_MAX_NEW_TOKENS, INFERENCE_ATTENTION_IMPL,
+            INFERENCE_ATTENTION_BLOCK_K, INFERENCE_TEMPERATURE,
+            INFERENCE_TOP_K, INFERENCE_TOP_P, INFERENCE_SAMPLING_SEED)
 
     def __init__(self, param_dict):
         sub = param_dict.get(INFERENCE, {}) or {}
@@ -664,13 +666,31 @@ class InferenceConfig:
             sub, INFERENCE_KV_CACHE_DTYPE, INFERENCE_KV_CACHE_DTYPE_DEFAULT)
         self.max_new_tokens = get_scalar_param(
             sub, INFERENCE_MAX_NEW_TOKENS, INFERENCE_MAX_NEW_TOKENS_DEFAULT)
+        self.attention_impl = get_scalar_param(
+            sub, INFERENCE_ATTENTION_IMPL, INFERENCE_ATTENTION_IMPL_DEFAULT)
+        self.attention_block_k = get_scalar_param(
+            sub, INFERENCE_ATTENTION_BLOCK_K,
+            INFERENCE_ATTENTION_BLOCK_K_DEFAULT)
+        self.temperature = get_scalar_param(
+            sub, INFERENCE_TEMPERATURE, INFERENCE_TEMPERATURE_DEFAULT)
+        self.top_k = get_scalar_param(sub, INFERENCE_TOP_K,
+                                      INFERENCE_TOP_K_DEFAULT)
+        self.top_p = get_scalar_param(sub, INFERENCE_TOP_P,
+                                      INFERENCE_TOP_P_DEFAULT)
+        self.sampling_seed = get_scalar_param(
+            sub, INFERENCE_SAMPLING_SEED, INFERENCE_SAMPLING_SEED_DEFAULT)
 
     def __repr__(self):
         return (f"InferenceConfig(max_batch={self.max_batch}, "
                 f"seq_buckets={self.seq_buckets}, "
                 f"prefill_chunk={self.prefill_chunk}, "
                 f"kv_cache_dtype={self.kv_cache_dtype!r}, "
-                f"max_new_tokens={self.max_new_tokens})")
+                f"max_new_tokens={self.max_new_tokens}, "
+                f"attention_impl={self.attention_impl!r}, "
+                f"attention_block_k={self.attention_block_k}, "
+                f"temperature={self.temperature}, top_k={self.top_k}, "
+                f"top_p={self.top_p}, "
+                f"sampling_seed={self.sampling_seed})")
 
 
 class DeepSpeedConfig:
@@ -1002,6 +1022,34 @@ class DeepSpeedConfig:
             raise ValueError(
                 f"inference: max_new_tokens must be an int >= 1, "
                 f"got {mn!r}")
+        if inf.attention_impl not in ("dense", "flash"):
+            raise ValueError(
+                f"inference: attention_impl must be 'dense' or 'flash', "
+                f"got {inf.attention_impl!r}")
+        bk = inf.attention_block_k
+        if isinstance(bk, bool) or not isinstance(bk, int) or bk < 1:
+            raise ValueError(
+                f"inference: attention_block_k must be an int >= 1, "
+                f"got {bk!r}")
+        temp = inf.temperature
+        if isinstance(temp, bool) or \
+                not isinstance(temp, (int, float)) or temp < 0:
+            raise ValueError(
+                f"inference: temperature must be a number >= 0, "
+                f"got {temp!r}")
+        tk = inf.top_k
+        if isinstance(tk, bool) or not isinstance(tk, int) or tk < 0:
+            raise ValueError(
+                f"inference: top_k must be an int >= 0, got {tk!r}")
+        tp = inf.top_p
+        if isinstance(tp, bool) or not isinstance(tp, (int, float)) \
+                or not 0 < tp <= 1:
+            raise ValueError(
+                f"inference: top_p must be in (0, 1], got {tp!r}")
+        seed = inf.sampling_seed
+        if isinstance(seed, bool) or not isinstance(seed, int):
+            raise ValueError(
+                f"inference: sampling_seed must be an int, got {seed!r}")
 
     def _check_fp8(self):
         from deepspeed_tpu.runtime.comm.codecs import CODECS

@@ -471,3 +471,28 @@ INFERENCE_KV_CACHE_DTYPE_DEFAULT = None
 # Default generation budget for requests that don't specify one.
 INFERENCE_MAX_NEW_TOKENS = "max_new_tokens"
 INFERENCE_MAX_NEW_TOKENS_DEFAULT = 64
+
+# Decode attention implementation: "dense" = full-cache softmax (the
+# parity oracle), "flash" = the Pallas split-K flash-decode kernel
+# (ops/pallas/flash_decode.py) with active-length block skipping and
+# in-kernel KV dequantization. Prefill always runs dense.
+INFERENCE_ATTENTION_IMPL = "attention_impl"
+INFERENCE_ATTENTION_IMPL_DEFAULT = "dense"
+
+# Flash-decode KV block size: the kernel streams the cache row in
+# [block_k, head_dim] blocks. Clamped to max(seq_buckets), which it
+# must divide.
+INFERENCE_ATTENTION_BLOCK_K = "attention_block_k"
+INFERENCE_ATTENTION_BLOCK_K_DEFAULT = 128
+
+# In-program sampling knobs (static: they select the traced decode
+# graph). temperature 0.0 = greedy argmax (consumes no randomness);
+# top_k 0 and top_p 1.0 disable those filters.
+INFERENCE_TEMPERATURE = "temperature"
+INFERENCE_TEMPERATURE_DEFAULT = 0.0
+INFERENCE_TOP_K = "top_k"
+INFERENCE_TOP_K_DEFAULT = 0
+INFERENCE_TOP_P = "top_p"
+INFERENCE_TOP_P_DEFAULT = 1.0
+INFERENCE_SAMPLING_SEED = "sampling_seed"
+INFERENCE_SAMPLING_SEED_DEFAULT = 0

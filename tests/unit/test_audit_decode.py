@@ -1,14 +1,17 @@
 """Decode audit flavor (`deepspeed_tpu/analysis/audit.py:audit_decode`
-+ `analysis/rules.py:rule_decode`).
++ `analysis/rules.py:rule_decode` + ``rule_flash_decode``).
 
 The rule negatives are pure-python — a StepContext with faked compile
-counts / cache censuses, no jax programs — so every failure mode of
-the serving contract (mid-stream recompile, mixed cache dtypes,
-silently-skipped quantization) has a cheap pin. The real end-to-end
-audit (tiny engine, scripted stream, lowered decode HLO, full rule
-catalog → zero findings) is the PR's acceptance criterion and runs
-once plain plus once quantized.
+counts / cache censuses / HLO snippets, no jax programs — so every
+failure mode of the serving contract (mid-stream recompile, mixed
+cache dtypes, silently-skipped quantization, a dense attention dot
+surviving a flash rewrite) has a cheap pin. The real end-to-end audit
+(tiny engine, scripted stream, lowered decode HLO, full rule catalog →
+zero findings) is the PR's acceptance criterion and runs plain,
+quantized, and on the dense fallback.
 """
+
+import pytest
 
 from deepspeed_tpu.analysis.audit import EXTRA_FLAVORS, audit_decode
 from deepspeed_tpu.analysis.rules import (
@@ -16,6 +19,7 @@ from deepspeed_tpu.analysis.rules import (
     RULE_IDS,
     StepContext,
     rule_decode,
+    rule_flash_decode,
 )
 
 
@@ -81,6 +85,75 @@ class TestRuleDecode:
         assert rule_decode(ctx) == []
 
 
+_PAYLOAD = (2, 32, 4, 8)
+# A dense decode attention contraction: an operand dim multiset
+# containing every cache payload dim (max_batch, max_seq, n_head,
+# head_dim) in einsum-permuted order.
+_DENSE_DOT = ("%dot.1 = f32[2,4,1,32]{3,2,1,0} dot(f32[2,4,1,8]{3,2,1,0} "
+              "%a, f32[2,4,8,32]{3,2,1,0} %b), lhs_batch_dims={0,1}")
+# A kernel-sized dot: block_k slices never carry all four payload dims.
+_BLOCK_DOT = ("%dot.2 = f32[1,8]{1,0} dot(f32[1,8]{1,0} %q, "
+              "f32[8,8]{1,0} %k)")
+
+
+class TestRuleFlashDecode:
+    def test_registered(self):
+        assert "flash_decode" in RULE_IDS
+
+    def test_skips_unless_flash_promised(self):
+        ctx = StepContext(hlo_text=_DENSE_DOT,
+                          decode_attention_impl="dense",
+                          decode_cache_payload_shape=_PAYLOAD)
+        assert rule_flash_decode(ctx) == []
+
+    def test_surviving_dense_dot_is_error(self):
+        ctx = StepContext(hlo_text=_DENSE_DOT + "\n" + _BLOCK_DOT,
+                          decode_attention_impl="flash",
+                          decode_cache_payload_shape=_PAYLOAD)
+        findings = rule_flash_decode(ctx)
+        assert [f.severity for f in findings] == [SEV_ERROR]
+        assert "dense attention softmax survived" in findings[0].message
+        assert findings[0].details["dots"] == [_DENSE_DOT]
+
+    def test_block_sized_dots_pass(self):
+        ctx = StepContext(hlo_text=_BLOCK_DOT,
+                          decode_attention_impl="flash",
+                          decode_cache_payload_shape=_PAYLOAD)
+        assert rule_flash_decode(ctx) == []
+
+    def test_f32_cache_copy_under_quantization_is_error(self):
+        # a dequantized full-cache f32 value (dims ⊇ payload multiset)
+        hlo = "%convert.9 = f32[2,32,4,8]{3,2,1,0} convert(s8[2,32,4,8] %c)"
+        ctx = StepContext(hlo_text=hlo, decode_attention_impl="flash",
+                          decode_kv_cache_dtype="int8",
+                          decode_cache_payload_shape=_PAYLOAD)
+        findings = rule_flash_decode(ctx)
+        assert [f.severity for f in findings] == [SEV_ERROR]
+        assert findings[0].details["f32_payload_values"] == 1
+
+    def test_scale_planes_are_not_flagged(self):
+        # per-head scales are f32[B, S, H] — no head_dim, not a copy
+        hlo = "%p.3 = f32[2,32,4]{2,1,0} parameter(3)"
+        ctx = StepContext(hlo_text=hlo, decode_attention_impl="flash",
+                          decode_kv_cache_dtype="int8",
+                          decode_cache_payload_shape=_PAYLOAD)
+        assert rule_flash_decode(ctx) == []
+
+    def test_missing_custom_call_only_errors_on_tpu(self):
+        ctx_cpu = StepContext(hlo_text=_BLOCK_DOT,
+                              decode_attention_impl="flash",
+                              decode_platform="cpu",
+                              decode_cache_payload_shape=_PAYLOAD)
+        assert rule_flash_decode(ctx_cpu) == []
+        ctx_tpu = StepContext(hlo_text=_BLOCK_DOT,
+                              decode_attention_impl="flash",
+                              decode_platform="tpu",
+                              decode_cache_payload_shape=_PAYLOAD)
+        findings = rule_flash_decode(ctx_tpu)
+        assert [f.severity for f in findings] == [SEV_ERROR]
+        assert "custom-call" in findings[0].message
+
+
 class TestAuditDecodeEndToEnd:
     def test_zero_findings(self):
         report = audit_decode()
@@ -90,8 +163,34 @@ class TestAuditDecodeEndToEnd:
         assert report.stats["completions"] == 5
         assert set(report.stats["finish_reasons"]) >= \
             {"max_new_tokens", "length"}
+        # the stock decode flavor serves flash attention
+        assert report.stats["attention"]["impl"] == "flash"
 
     def test_zero_findings_quantized(self):
         report = audit_decode(kv_cache_dtype="int8")
         assert report.findings == []
         assert report.stats["cache"]["dtype_census"] == {"int8": 4}
+
+    @pytest.mark.slow
+    def test_dense_fallback_still_audits_clean(self):
+        # the oracle path keeps working under the same catalog — the
+        # flash_decode rule is inert when dense is what was promised
+        report = audit_decode(attention_impl="dense")
+        assert report.findings == []
+        assert report.stats["attention"]["impl"] == "dense"
+
+    @pytest.mark.slow
+    def test_flash_lowering_deleted_the_dense_work(self):
+        """The acceptance pin, measured off the real lowered programs:
+        dense decode carries payload-shaped attention dots (and, when
+        quantized, f32 cache-sized dequant values); flash carries
+        neither."""
+        from deepspeed_tpu.analysis.hlo import (payload_shaped_dots,
+                                                payload_shaped_values)
+        dense = audit_decode(kv_cache_dtype="int8",
+                             attention_impl="dense")
+        flash = audit_decode(kv_cache_dtype="int8")
+        assert len(payload_shaped_dots(dense.hlo_text, _PAYLOAD)) > 0
+        assert payload_shaped_values(dense.hlo_text, "f32", _PAYLOAD) > 0
+        assert payload_shaped_dots(flash.hlo_text, _PAYLOAD) == []
+        assert payload_shaped_values(flash.hlo_text, "f32", _PAYLOAD) == 0

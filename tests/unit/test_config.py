@@ -268,16 +268,28 @@ def test_inference_config_defaults_and_block():
     assert inf.prefill_chunk == 32
     assert inf.kv_cache_dtype is None
     assert inf.max_new_tokens == 64
+    assert inf.attention_impl == "dense"
+    assert inf.attention_block_k == 128
+    assert inf.temperature == 0.0
+    assert inf.top_k == 0
+    assert inf.top_p == 1.0
+    assert inf.sampling_seed == 0
 
     cfg = make_config({
         "train_batch_size": 16,
         "inference": {"max_batch": 4, "seq_buckets": [64, 256],
                       "prefill_chunk": 16, "kv_cache_dtype": "int8",
-                      "max_new_tokens": 32}})
+                      "max_new_tokens": 32, "attention_impl": "flash",
+                      "attention_block_k": 64, "temperature": 0.8,
+                      "top_k": 40, "top_p": 0.95, "sampling_seed": 7}})
     inf = cfg.inference
     assert inf.max_batch == 4
     assert inf.seq_buckets == (64, 256)   # list coerced to tuple
     assert inf.kv_cache_dtype == "int8"
+    assert inf.attention_impl == "flash"
+    assert inf.attention_block_k == 64
+    assert inf.temperature == 0.8
+    assert (inf.top_k, inf.top_p, inf.sampling_seed) == (40, 0.95, 7)
 
 
 def test_inference_config_validation():
@@ -293,4 +305,11 @@ def test_inference_config_validation():
     bad({"seq_buckets": [48, 64], "prefill_chunk": 32}, "multiple of")
     bad({"kv_cache_dtype": "e5m2"}, "kv_cache_dtype")
     bad({"max_new_tokens": 0}, "max_new_tokens")
+    bad({"attention_impl": "sparse"}, "attention_impl")
+    bad({"attention_block_k": 0}, "attention_block_k")
+    bad({"temperature": -0.5}, "temperature")
+    bad({"top_k": -1}, "top_k")
+    bad({"top_p": 0.0}, "top_p")
+    bad({"top_p": 1.5}, "top_p")
+    bad({"sampling_seed": "abc"}, "sampling_seed")
     bad({"max_batc": 4}, "unknown key")

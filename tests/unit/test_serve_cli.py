@@ -85,3 +85,72 @@ def test_expect_compiles_violation_exits_nonzero(capsys):
     captured = capsys.readouterr()
     assert "FAIL" in captured.err
     assert "compile count 2 != expected 1" in captured.err
+
+
+def test_flash_attention_and_sampling_flags(capsys):
+    """Flash decode + quantized cache + hot sampling still hold the
+    2-compile contract, and the knobs land in the result dict."""
+    rc = main(["--synthetic", "4", "--max-new", "3",
+               "--attention", "flash", "--block-k", "8",
+               "--kv-cache-dtype", "int8",
+               "--temperature", "0.8", "--top-k", "16",
+               "--top-p", "0.9", "--seed", "3",
+               "--expect-compiles", "2", "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["ok"] is True
+    assert len(result["completions"]) == 4
+    assert result["compile_counts"] == {"prefill": 1, "decode": 1}
+    assert result["attention"] == {"impl": "flash", "block_k": 8}
+    assert result["sampling"] == {"temperature": 0.8, "top_k": 16,
+                                  "top_p": 0.9, "seed": 3}
+
+
+def test_sampling_config_keys_and_seed_precedence(tmp_path, capsys):
+    """attention/sampling knobs flow through --config, and a
+    non-default --seed overrides the config's sampling_seed."""
+    cfg = tmp_path / "ds_config.json"
+    cfg.write_text(json.dumps({
+        "train_batch_size": 1,
+        "train_micro_batch_size_per_gpu": 1,
+        "inference": {"max_batch": 2, "seq_buckets": [16, 32],
+                      "prefill_chunk": 4, "max_new_tokens": 3,
+                      "attention_impl": "flash",
+                      "attention_block_k": 8,
+                      "temperature": 0.5, "top_k": 8,
+                      "sampling_seed": 99}}))
+    rc = main(["--config", str(cfg), "--synthetic", "3", "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["attention"]["impl"] == "flash"
+    assert result["sampling"]["temperature"] == 0.5
+    assert result["sampling"]["seed"] == 99      # config wins at --seed 0
+    rc = main(["--config", str(cfg), "--synthetic", "3", "--seed", "7",
+               "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["sampling"]["seed"] == 7       # explicit --seed wins
+
+
+def test_greedy_serve_is_sampling_invariant(tmp_path, capsys):
+    """temperature 0 (the default) never consumes the PRNG key: serves
+    whose configs differ ONLY in sampling_seed emit identical token
+    streams (--seed stays 0 so the synthetic prompts are shared)."""
+    streams = []
+    for sampling_seed in (1, 2):
+        cfg = tmp_path / f"cfg{sampling_seed}.json"
+        cfg.write_text(json.dumps({
+            "train_batch_size": 1,
+            "train_micro_batch_size_per_gpu": 1,
+            "inference": {"max_batch": 2, "seq_buckets": [16, 32],
+                          "prefill_chunk": 4,
+                          "attention_impl": "flash",
+                          "attention_block_k": 8,
+                          "sampling_seed": sampling_seed}}))
+        rc = main(["--config", str(cfg), "--synthetic", "3",
+                   "--max-new", "4", "--json"])
+        assert rc == 0
+        result = json.loads(capsys.readouterr().out)
+        assert result["sampling"]["seed"] == sampling_seed
+        streams.append([c["tokens"] for c in result["completions"]])
+    assert streams[0] == streams[1]
