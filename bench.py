@@ -643,7 +643,8 @@ from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
 from deepspeed_tpu.parallel.mesh import build_mesh
 
 
-def facts(kv_cache_dtype, mesh=None, attention_impl="dense"):
+def facts(kv_cache_dtype, mesh=None, attention_impl="dense",
+          kv_layout="ring"):
     cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32)
     model = GPT2LMHead(cfg)
     params = model.init(jax.random.PRNGKey(0),
@@ -651,7 +652,8 @@ def facts(kv_cache_dtype, mesh=None, attention_impl="dense"):
     eng = InferenceEngine(model, params, config={
         "max_batch": 2, "seq_buckets": (16, 32), "prefill_chunk": 4,
         "kv_cache_dtype": kv_cache_dtype,
-        "attention_impl": attention_impl, "attention_block_k": 8},
+        "attention_impl": attention_impl, "attention_block_k": 8,
+        "kv_layout": kv_layout},
         mesh=mesh)
     rng = np.random.default_rng(0)
     reqs = [Request(f"r{i}",
@@ -701,16 +703,82 @@ def flash_ab(max_seq):
                 < dense["seq_sized_value_bytes"]}
 
 
+def paged_ab():
+    # paged-vs-ring serving A/B over the SAME shared-prefix stream:
+    # a ring session always owns a full max_seq row, a paged session
+    # only the pages its tokens occupy — report cache bytes/session,
+    # sessions admittable at fixed HBM, and the prefill chunks the
+    # radix prefix cache let admissions skip. Greedy outputs must
+    # match bit-for-bit (keyed by rid; paged may reorder under pool
+    # pressure).
+    cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32)
+    model = GPT2LMHead(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, cfg.vocab_size, 12).tolist()
+
+    def stream():
+        r = np.random.default_rng(1)
+        return [Request(f"r{i}",
+                        base + r.integers(0, cfg.vocab_size,
+                                          int(r.integers(2, 8))).tolist(),
+                        max_new_tokens=4)
+                for i in range(6)]
+
+    def build(layout):
+        return InferenceEngine(model, params, config={
+            "max_batch": 2, "seq_buckets": (16, 32),
+            "prefill_chunk": 4, "kv_layout": layout})
+
+    ring = build("ring")
+    ring_comps = ContinuousBatchingScheduler(ring).run(stream())
+    paged = build("paged")
+    sched = ContinuousBatchingScheduler(paged)
+    comps = sched.run(stream())
+    pg = sched.paging.facts()
+    ps, pb = pg["page_size"], pg["page_bytes"]
+    kv_lens = [c.prompt_len + len(c.tokens) - 1 for c in comps]
+    pages = [-(-n // ps) for n in kv_lens]
+    paged_bps = pb * sum(pages) / len(pages)
+    ring_bps = ring.cache_facts()["bytes"] / ring.max_batch
+    pool = paged.cache_facts()["bytes"]
+    run = sum(c.prefill_chunks for c in comps)
+    skipped = sum(c.prefill_chunks_skipped for c in comps)
+    ring_by_rid = {c.rid: c.tokens for c in ring_comps}
+    return {
+        "page_size": ps, "n_pages": pg["n_pages"],
+        "ring_cache_bytes_per_session": ring_bps,
+        "paged_cache_bytes_per_session": paged_bps,
+        "cache_bytes_ratio": paged_bps / max(ring_bps, 1),
+        "paged_below_ring": paged_bps < ring_bps,
+        "sessions_at_fixed_hbm": {
+            "hbm_bytes": pool,
+            "ring": int(pool // max(ring_bps, 1)),
+            "paged": int(pool // max(paged_bps, 1))},
+        "prefix_hits": pg["prefix_hits"],
+        "prefill_chunks_run": run,
+        "prefill_chunks_skipped": skipped,
+        "prefill_skip_fraction": skipped / max(run + skipped, 1),
+        "compile_counts": paged.compile_counts(),
+        "greedy_outputs_match":
+            all(ring_by_rid[c.rid] == c.tokens for c in comps)}
+
+
 plain = facts(None)
 quant = facts("int8")
 tp = facts(None, mesh=build_mesh({"model": 4},
                                  devices=jax.devices()[:4]))
 flash_int8 = facts("int8", attention_impl="flash")
+paged_flash_int8 = facts("int8", attention_impl="flash",
+                         kv_layout="paged")
 out = {"n_devices": len(jax.devices()),
        "platform": jax.devices()[0].platform,
        "plain": plain, "int8": quant, "tp4": tp,
        "flash_int8": flash_int8,
+       "paged_flash_int8": paged_flash_int8,
        "flash_ab": [flash_ab(512), flash_ab(4096)],
+       "paged_ab": paged_ab(),
        "kv_bytes_ratio_int8":
            quant["cache_bytes"] / max(plain["cache_bytes"], 1)}
 print(json.dumps(out))
@@ -720,11 +788,12 @@ print(json.dumps(out))
 def inference_static_facts(timeout_s=900):
     """Compile-time facts for the serving engine — the 2-program
     compile contract after a continuous-batching stream crossed both
-    seq buckets (plain, int8-quantized KV, and 4-way TP variants), the
-    decode program's collective bytes (zero single-device; the TP
-    variant carries the row-parallel psums), KV cache dtype census and
-    int8 compression ratio, and the decode static peak — from a CPU
-    subprocess (backend-independent compile artifacts)."""
+    seq buckets (plain, int8-quantized KV, 4-way TP, and paged-pool
+    variants), the decode program's collective bytes (zero
+    single-device; the TP variant carries the row-parallel psums), KV
+    cache dtype census and int8 compression ratio, the paged-vs-ring
+    cache-bytes/session + prefill-skip A/B, and the decode static peak
+    — from a CPU subprocess (backend-independent compile artifacts)."""
     import subprocess
 
     env = dict(os.environ)
@@ -1730,6 +1799,7 @@ def main():
         ab = {str(row["max_seq"]): row
               for row in facts.get("flash_ab") or []}
         ratio_4096 = (ab.get("4096") or {}).get("flash_bytes_ratio")
+        pab = facts.get("paged_ab") or {}
         if not on_tpu:
             cc = (facts.get("plain") or {}).get("compile_counts") or {}
             total = sum(v for v in cc.values() if v)
@@ -1742,6 +1812,14 @@ def main():
                    "flash_vs_dense_seq_bytes_ratio_4096":
                        round(ratio_4096, 4)
                        if ratio_4096 is not None else None,
+                   "paged_vs_ring_cache_bytes_ratio":
+                       round(pab["cache_bytes_ratio"], 4)
+                       if pab.get("cache_bytes_ratio") is not None
+                       else None,
+                   "paged_prefill_skip_fraction":
+                       round(pab["prefill_skip_fraction"], 4)
+                       if pab.get("prefill_skip_fraction") is not None
+                       else None,
                    "static_facts": facts, "live": False,
                    "note": "tokens/sec + latency percentiles require a "
                            f"TPU; backend is {platform!r} — "

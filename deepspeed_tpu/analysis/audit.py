@@ -613,7 +613,7 @@ def build_flavor_engine(flavor, config_overrides=None):
 
 
 def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
-                 attention_impl="flash"):
+                 attention_impl="flash", kv_layout="ring"):
     """Audit the serving engine's compiled decode program.
 
     Builds a tiny :class:`~deepspeed_tpu.inference.engine.
@@ -627,6 +627,15 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
     and the `flash_decode` rule pins that the stock flash attention
     path (``attention_impl="flash"``, the default) actually deleted the
     dense full-cache contraction from the lowered program.
+
+    With ``kv_layout="paged"`` the scripted stream additionally churns
+    the page allocator end to end: shared-prefix admissions (radix
+    hits), a pool-pressure request that rides the eviction ladder, a
+    parked session that the pressure evacuates to host RAM, and a
+    follow-up that pages it back in and resumes mid-prompt — then the
+    `decode` rule pins that the post-churn program still lowered zero
+    host transfers and the jit caches never grew past the 2-compile
+    contract.
     """
     import jax.numpy as jnp
     from deepspeed_tpu.inference.cache import cache_dtype_census
@@ -642,27 +651,75 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
     params = model.init(jax.random.PRNGKey(0), toks)["params"]
     inf_cfg = {"max_batch": 2, "seq_buckets": (16, 32),
                "prefill_chunk": 4, "kv_cache_dtype": kv_cache_dtype,
-               "attention_impl": attention_impl, "attention_block_k": 8}
+               "attention_impl": attention_impl, "attention_block_k": 8,
+               "kv_layout": kv_layout}
     inf_cfg.update(config_overrides or {})
     engine = InferenceEngine(model, params, config=inf_cfg)
     sched = ContinuousBatchingScheduler(engine)
     rng = np.random.default_rng(0)
-    # 5 requests over 2 rows: slot recycling, both buckets, a clamped
-    # over-budget request that length-evicts, and an open-loop arrival.
-    stream = [Request("r0", rng.integers(0, cfg.vocab_size, 3).tolist(),
-                      max_new_tokens=4),
-              Request("r1", rng.integers(0, cfg.vocab_size, 20).tolist(),
-                      max_new_tokens=6),
-              Request("r2", rng.integers(0, cfg.vocab_size, 2).tolist(),
-                      max_new_tokens=3, arrival_step=3),
-              Request("r3", rng.integers(0, cfg.vocab_size, 30).tolist(),
-                      max_new_tokens=10),
-              Request("r4", rng.integers(0, cfg.vocab_size, 6).tolist(),
-                      max_new_tokens=5)]
-    completions = sched.run(stream)
+    paged = engine.kv_layout == "paged"
+    if paged:
+        # Allocator-churn stream: r0/r1 share a >page_size prefix (r1
+        # is a radix hit on r0's interned pages), r2 parks its pages
+        # under a session id, r3's 30-token prompt squeezes the pool
+        # (pressure ladder: radix eviction, then host evacuation of
+        # r2's parked pages), r4 re-hits the shared prefix open-loop.
+        base = rng.integers(0, cfg.vocab_size, 12).tolist()
+        stream = [
+            Request("r0", base + rng.integers(
+                0, cfg.vocab_size, 3).tolist(), max_new_tokens=4),
+            Request("r1", base + rng.integers(
+                0, cfg.vocab_size, 5).tolist(), max_new_tokens=5),
+            Request("r2", rng.integers(0, cfg.vocab_size, 6).tolist(),
+                    max_new_tokens=4, session_id="s0"),
+            Request("r3", rng.integers(0, cfg.vocab_size, 30).tolist(),
+                    max_new_tokens=10),
+            Request("r4", base + rng.integers(
+                0, cfg.vocab_size, 2).tolist(), max_new_tokens=3,
+                    arrival_step=3)]
+        completions = sched.run(stream)
+        # Session resume: extend s0's history (prompt + every token
+        # that fed a decode step) so admission pages the parked KV
+        # back in and restarts prefill mid-prompt.
+        s0 = {c.rid: c for c in completions}["r2"]
+        follow = stream[2].prompt + s0.tokens + rng.integers(
+            0, cfg.vocab_size, 2).tolist()
+        completions = sched.run([Request("r5", follow, max_new_tokens=3,
+                                         session_id="s0")])
+    else:
+        # 5 requests over 2 rows: slot recycling, both buckets, a
+        # clamped over-budget request that length-evicts, and an
+        # open-loop arrival.
+        stream = [Request("r0",
+                          rng.integers(0, cfg.vocab_size, 3).tolist(),
+                          max_new_tokens=4),
+                  Request("r1",
+                          rng.integers(0, cfg.vocab_size, 20).tolist(),
+                          max_new_tokens=6),
+                  Request("r2",
+                          rng.integers(0, cfg.vocab_size, 2).tolist(),
+                          max_new_tokens=3, arrival_step=3),
+                  Request("r3",
+                          rng.integers(0, cfg.vocab_size, 30).tolist(),
+                          max_new_tokens=10),
+                  Request("r4",
+                          rng.integers(0, cfg.vocab_size, 6).tolist(),
+                          max_new_tokens=5)]
+        completions = sched.run(stream)
     hlo_text, expected, pinfo = _lower_step(engine._decode,
                                             engine.decode_lowering_args())
     census = cache_dtype_census(engine.cache)
+    if paged:
+        payload_shape = (engine.spec.n_pages, engine.spec.page_size,
+                         engine.spec.n_head, engine.spec.head_dim)
+        page_facts = {"page_size": engine.page_size,
+                      "n_pages": engine.n_pages,
+                      "pages_per_row": engine.pages_per_row,
+                      "max_seq": engine.max_seq}
+    else:
+        payload_shape = (engine.spec.max_batch, engine.spec.max_seq,
+                         engine.spec.n_head, engine.spec.head_dim)
+        page_facts = None
     ctx = StepContext(
         hlo_text=hlo_text, flavor="decode",
         compute_dtype="f32" if cfg.dtype == jnp.float32 else "bf16",
@@ -673,10 +730,10 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
         decode_kv_cache_dtype=engine.kv_cache_dtype,
         decode_cache_census=census,
         decode_attention_impl=engine.attention_impl,
-        decode_cache_payload_shape=(
-            engine.spec.max_batch, engine.spec.max_seq,
-            engine.spec.n_head, engine.spec.head_dim),
+        decode_cache_payload_shape=payload_shape,
         decode_platform=jax.devices()[0].platform,
+        decode_kv_layout=engine.kv_layout,
+        decode_page_facts=page_facts,
         skip_rules={"recompile"})
     findings = run_rules(ctx, rules)
     findings.extend(engine.recompile_findings())
@@ -690,6 +747,8 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
     report.stats["cache"] = engine.cache_facts()
     report.stats["attention"] = {"impl": engine.attention_impl,
                                  "block_k": engine.attention_block_k}
+    if paged:
+        report.stats["paging"] = sched.paging.facts()
     report.stats["audit_wall_s"] = round(time.perf_counter() - t0, 3)
     return report
 

@@ -136,6 +136,18 @@ class StepContext:
     decode_attention_impl: str = None
     decode_cache_payload_shape: tuple = None
     decode_platform: str = None
+    # Paged KV cache (`inference/paging.py`): decode_kv_layout names the
+    # engine's cache layout ("ring" | "paged"; None = not a serving
+    # audit). For a paged engine the page tables are fixed-shape int32
+    # DATA inputs — allocator churn, prefix sharing and host-tier
+    # parking are host-side bookkeeping that must never lower a host
+    # transfer into the steady-state decode program (parking runs
+    # OUTSIDE the compiled step, through `engine.gather_pages`).
+    # decode_page_facts is the engine's `cache_facts()` geometry
+    # (page_size / n_pages / pages_per_row / max_seq) for the
+    # internal-consistency pins.
+    decode_kv_layout: str = None
+    decode_page_facts: dict = None
     skip_rules: set = field(default_factory=set)
 
 
@@ -703,10 +715,56 @@ def rule_decode(ctx):
     and when ``kv_cache_dtype`` names a codec it must be that codec's
     dtype — a mixed or full-precision census means some layer's cache
     silently skipped quantization and the promised HBM saving is gone.
+
+    Paged layout (``decode_kv_layout == "paged"``): the page tables are
+    fixed-shape device data — steady-state decode must lower ZERO host
+    transfer ops (a page gather routed through infeed/outfeed or a host
+    callback stalls every step; host-tier parking runs outside the
+    compiled programs), and the pool geometry must be internally
+    consistent (page 0 is the reserved trash page, so ``n_pages >= 2``;
+    ``pages_per_row * page_size`` must cover ``max_seq`` exactly, else
+    some row positions have no page-table entry and decode reads the
+    trash page as live KV).
     """
-    if ctx.decode_compile_counts is None and ctx.decode_cache_census is None:
+    if ctx.decode_compile_counts is None and \
+            ctx.decode_cache_census is None and \
+            ctx.decode_kv_layout is None:
         return []
     findings = []
+    if ctx.decode_kv_layout == "paged":
+        hits = host_transfer_ops(ctx.hlo_text) if ctx.hlo_text else []
+        if hits:
+            kinds = sorted({h["kind"] for h in hits})
+            findings.append(Finding(
+                "decode", SEV_ERROR,
+                f"paged decode program lowers {len(hits)} host transfer "
+                f"op(s) ({', '.join(kinds)}) — page-table gathers must "
+                f"stay on device; a host round-trip in steady-state "
+                f"decode stalls every step",
+                {"count": len(hits), "kinds": kinds,
+                 "ops": [h["line"][:200] for h in hits[:8]]}))
+        pf = ctx.decode_page_facts or {}
+        ps = pf.get("page_size", 0)
+        n_pg = pf.get("n_pages", 0)
+        ppr = pf.get("pages_per_row", 0)
+        max_seq = pf.get("max_seq", 0)
+        if pf:
+            if ps < 1 or n_pg < 2 or ppr < 1:
+                findings.append(Finding(
+                    "decode", SEV_ERROR,
+                    f"paged cache geometry is degenerate (page_size="
+                    f"{ps}, n_pages={n_pg}, pages_per_row={ppr}) — the "
+                    f"pool needs >= 2 pages (page 0 is the reserved "
+                    f"trash page) and a positive page size",
+                    {"page_facts": dict(pf)}))
+            elif max_seq and ppr * ps != max_seq:
+                findings.append(Finding(
+                    "decode", SEV_ERROR,
+                    f"paged cache geometry mismatch: pages_per_row="
+                    f"{ppr} x page_size={ps} = {ppr * ps} does not "
+                    f"cover max_seq={max_seq} — positions past the "
+                    f"table read the trash page as live KV",
+                    {"page_facts": dict(pf)}))
     for prog, n in sorted((ctx.decode_compile_counts or {}).items()):
         if n is not None and n > ctx.decode_expected_compiles:
             findings.append(Finding(

@@ -31,6 +31,15 @@ model-side dimensions (remat policy, ``scan_layers``) apply to the toy
 GPT-2 the CLI builds — they ride the report's ``model`` section rather
 than the engine config JSON.
 
+``--serving`` swaps the space and the evaluator: the dimensions become
+the paged KV cache's knobs (``inference.page_size`` multiples of the
+prefill chunk, ``inference.host_park_threshold``), and each candidate
+compiles through `audit_decode`'s allocator-churn stream instead of a
+train step — so a page size that breaks the 2-compile contract, lowers
+a host transfer, or fails the engine's divisibility checks is rejected
+with a typed reason, and the survivors are scored on the decode
+program's roofline cost.
+
 Outputs: the tuned config JSON (``--output``) and an expected-vs-
 measured telemetry log (``--expected-log``) — synthetic ``compile`` +
 ``step`` events in the `ds-tpu-telemetry/1` schema carrying the
@@ -55,7 +64,8 @@ from deepspeed_tpu.analysis.cost import (PLATFORMS, REJECT_PEAK_MEMORY,
 __all__ = ["REJECT_BUILD_ERROR", "REJECT_RULE_FINDINGS",
            "REJECT_PEAK_MEMORY", "Choice", "CandidateResult",
            "TuneResult", "deep_merge", "default_dimensions",
-           "build_toy_gpt2_engine", "evaluate_candidate", "tune",
+           "serving_dimensions", "build_toy_gpt2_engine",
+           "evaluate_candidate", "evaluate_serving_candidate", "tune",
            "expected_events", "write_expected_log", "main"]
 
 # Typed rejection reasons (cost.py owns REJECT_PEAK_MEMORY).
@@ -63,6 +73,7 @@ REJECT_BUILD_ERROR = "candidate_build_error"
 REJECT_RULE_FINDINGS = "audit_rule_findings"
 
 DIMENSION_NAMES = ("zero", "fp8", "overlap", "batch", "remat", "scan")
+SERVING_DIMENSION_NAMES = ("page", "park")
 
 
 def deep_merge(base, overrides):
@@ -152,6 +163,32 @@ def default_dimensions(base_config, world_size=1):
     ]
     dims = [("zero", zero), ("fp8", fp8), ("overlap", overlap),
             ("batch", batch), ("remat", remat), ("scan", scan)]
+    return [(name, choices) for name, choices in dims if choices]
+
+
+def serving_dimensions(base_config):
+    """The ``--serving`` search space: paged-KV knobs over the config's
+    ``inference`` block.
+
+    ``page`` sweeps page_size as multiples of the prefill chunk (only
+    multiples can keep prefill chunk-aligned; a size that doesn't also
+    divide the largest seq bucket is still offered — the engine rejects
+    it at build and the tuner reports the typed rejection instead of
+    silently skipping the point). ``park`` sweeps the host-RAM
+    evacuation threshold: 0 never parks to host, higher values trade
+    host-copy wall for device pages under session churn.
+    """
+    inf = base_config.get("inference") or {}
+    pc = int(inf.get("prefill_chunk", 4))
+    buckets = inf.get("seq_buckets") or (16, 32)
+    max_seq = max(int(b) for b in buckets)
+    page = [Choice(f"page{pc * mult}",
+                   {"inference": {"page_size": pc * mult}})
+            for mult in (1, 2, 4) if pc * mult <= max_seq]
+    park = [Choice(f"park{int(t * 100)}",
+                   {"inference": {"host_park_threshold": t}})
+            for t in (0.0, 0.25, 0.5)]
+    dims = [("page", page), ("park", park)]
     return [(name, choices) for name, choices in dims if choices]
 
 
@@ -280,6 +317,64 @@ def evaluate_candidate(config, model_overrides, *, build=None,
     return res
 
 
+def evaluate_serving_candidate(config, model_overrides=None, *,
+                               build=None, platform="tpu_v5e",
+                               peak_budget_bytes=None, rules=None,
+                               label="candidate", dimension="base"):
+    """Compile one paged-serving candidate through ``audit_decode``'s
+    allocator-churn stream and score its decode program.
+
+    The candidate's ``inference`` block configures the engine
+    (``kv_layout`` forced to "paged" — this mode tunes the paged
+    knobs); the full rule catalog runs over the post-churn decode HLO,
+    so a page_size that breaks the 2-compile contract or lowers a host
+    transfer comes back as a typed rejection, never a score. Drop-in
+    for :func:`evaluate_candidate` in the greedy driver
+    (``model_overrides``/``build`` are accepted and ignored).
+    """
+    from deepspeed_tpu.analysis.audit import audit_decode
+    from deepspeed_tpu.analysis.rules import SEV_ERROR
+
+    inf = dict(config.get("inference") or {})
+    inf.pop("kv_layout", None)
+    res = CandidateResult(label=label, dimension=dimension,
+                          config=config, model={})
+    t0 = time.perf_counter()
+    try:
+        report = audit_decode(config_overrides=inf, rules=rules,
+                              kv_layout="paged")
+    except Exception as exc:
+        res.reject_reason = REJECT_BUILD_ERROR
+        res.reject_detail = f"{type(exc).__name__}: {exc}"
+        res.audit_wall_s = round(time.perf_counter() - t0, 3)
+        return res
+    res.audit_wall_s = round(time.perf_counter() - t0, 3)
+    res.flavor = report.flavor
+    res.findings = len(report.findings)
+    # one decode step produces max_batch tokens — the serving analog of
+    # the train step's batch tokens for the cost model's per-token view
+    res.tokens = int((report.stats.get("cache") or {}).get(
+        "max_batch", 0))
+    res.collective_bytes_by_dtype = \
+        report.stats.get("collective_bytes_by_dtype") or {}
+    errors = [f for f in report.findings if f.severity == SEV_ERROR]
+    if errors:
+        res.reject_reason = REJECT_RULE_FINDINGS
+        res.reject_detail = "; ".join(
+            f"{f.rule}: {f.message}" for f in errors[:4])
+        return res
+    cost = estimate_step_cost(
+        report.hlo_text, n_devices=1, platform=platform,
+        peak_budget_bytes=peak_budget_bytes)
+    res.cost = cost
+    if cost.reject_reason:
+        res.reject_reason = cost.reject_reason
+        res.reject_detail = (
+            f"static peak {cost.peak_bytes} B > budget "
+            f"{cost.peak_budget_bytes} B")
+    return res
+
+
 # ---------------------------------------------------------------------------
 # the greedy search driver
 # ---------------------------------------------------------------------------
@@ -320,23 +415,28 @@ class TuneResult:
 
 
 def tune(base_config, *, build=None, dimensions=None, platform="tpu_v5e",
-         peak_budget_bytes=None, rules=None, max_candidates=0, log=None):
+         peak_budget_bytes=None, rules=None, max_candidates=0, log=None,
+         evaluate=None):
     """Greedy coordinate-descent search (see module docstring).
 
     ``dimensions`` defaults to :func:`default_dimensions`;
     ``max_candidates`` (0 = unbounded) caps compiles after the base.
-    Returns a :class:`TuneResult`; the base config itself is always the
-    first candidate, so ``result.improved`` compares against it.
+    ``evaluate`` swaps the candidate evaluator (default
+    :func:`evaluate_candidate`; ``--serving`` passes
+    :func:`evaluate_serving_candidate`). Returns a :class:`TuneResult`;
+    the base config itself is always the first candidate, so
+    ``result.improved`` compares against it.
     """
     import jax
 
     platform = resolve_platform(platform)
     say = log or (lambda msg: None)
+    evaluate = evaluate or evaluate_candidate
     if dimensions is None:
         dimensions = default_dimensions(base_config, jax.device_count())
 
     say(f"tune: base config on platform {platform.name}")
-    base = evaluate_candidate(
+    base = evaluate(
         base_config, {}, build=build, platform=platform,
         peak_budget_bytes=peak_budget_bytes, rules=rules,
         label="base", dimension="base")
@@ -355,7 +455,7 @@ def tune(base_config, *, build=None, dimensions=None, platform="tpu_v5e",
                 skipped += 1
                 continue
             seen.add(key)
-            res = evaluate_candidate(
+            res = evaluate(
                 cand_cfg, cand_model, build=build, platform=platform,
                 peak_budget_bytes=peak_budget_bytes, rules=rules,
                 label=choice.label, dimension=dim_name)
@@ -487,10 +587,18 @@ def main(argv=None):
                              f"(known: {sorted(PLATFORMS)}; default: "
                              "the config's analysis.platform, else "
                              "tpu_v5e)")
+    parser.add_argument("--serving", action="store_true",
+                        help="tune the paged serving engine instead of "
+                             "the train step: sweep inference.page_size "
+                             "and host_park_threshold, each candidate "
+                             "compiled through the ds_tpu_audit decode "
+                             "churn stream (contract-breaking configs "
+                             "are rejected, not scored)")
     parser.add_argument("--dimensions", default=None,
                         help="comma-separated subset of the search "
                              f"dimensions (default: all of "
-                             f"{list(DIMENSION_NAMES)})")
+                             f"{list(DIMENSION_NAMES)}; with --serving: "
+                             f"{list(SERVING_DIMENSION_NAMES)})")
     parser.add_argument("--peak-budget-mb", type=float, default=None,
                         help="reject candidates whose static peak "
                              "exceeds this budget (default: "
@@ -552,15 +660,22 @@ def main(argv=None):
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.0)
 
-    dimensions = None
+    known_names = SERVING_DIMENSION_NAMES if args.serving \
+        else DIMENSION_NAMES
+    if args.serving:
+        dimensions = serving_dimensions(base_config)
+    else:
+        dimensions = None
     if args.dimensions:
         wanted = [d.strip() for d in args.dimensions.split(",")
                   if d.strip()]
-        unknown = sorted(set(wanted) - set(DIMENSION_NAMES))
+        unknown = sorted(set(wanted) - set(known_names))
         if unknown:
             parser.error(f"unknown dimension(s) {unknown}; known: "
-                         f"{list(DIMENSION_NAMES)}")
-        stock = dict(default_dimensions(base_config, jax.device_count()))
+                         f"{list(known_names)}")
+        stock = dict(serving_dimensions(base_config)) if args.serving \
+            else dict(default_dimensions(base_config,
+                                         jax.device_count()))
         dimensions = [(name, stock[name]) for name in wanted
                       if name in stock]
 
@@ -576,7 +691,9 @@ def main(argv=None):
     result = tune(base_config, dimensions=dimensions, platform=platform,
                   peak_budget_bytes=peak_budget_bytes,
                   max_candidates=args.max_candidates,
-                  log=lambda msg: print(msg, file=sys.stderr))
+                  log=lambda msg: print(msg, file=sys.stderr),
+                  evaluate=evaluate_serving_candidate if args.serving
+                  else None)
 
     if args.output:
         with open(args.output, "w") as f:

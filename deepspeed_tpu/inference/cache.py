@@ -1,4 +1,4 @@
-"""Bucketed ring-buffer KV cache.
+"""Bucketed ring-buffer KV cache, and its paged generalization.
 
 One cache = one statically-shaped buffer per layer, ``[max_batch,
 max_seq, n_head, head_dim]`` for keys and values (``scan_layers``
@@ -7,6 +7,19 @@ models stack a leading layer axis so the whole cache rides the same
 row is handed to the next admitted request and simply overwritten —
 admission/eviction never changes a compiled shape, which is what keeps
 the decode loop at exactly one compile (`engine.compile_counts`).
+
+The **paged** layout (``KVCacheSpec.page_size > 0``) replaces the
+per-row ring with one pool of fixed-size pages per layer,
+``[n_pages, page_size, n_head, head_dim]``, addressed through per-row
+page tables (``[B, pages_per_row]`` int32) that enter the compiled
+programs as plain data. The pool shape and the table shape are both
+static, so page allocation, freeing, prefix sharing and host-tier
+park/resume are pure host-side metadata churn — the same 2-compile
+contract as the ring, with capacity decoupled from ``max_batch *
+max_seq``. Physical page 0 is the TRASH page: the allocator never
+hands it out, unallocated table entries point at it, and inactive
+decode rows write their garbage token there, so every gather/scatter
+stays in-bounds without per-row branches.
 
 Causality comes from explicit positions, not shapes: every write lands
 at the token's absolute position and every read masks cache index
@@ -43,12 +56,26 @@ class KVCacheSpec:
     dtype: Any = jnp.bfloat16       # storage dtype (codec dtype when quantized)
     codec: Optional[str] = None     # None | "int8" | "f8e4m3fn" | "f8e5m2"
     stacked: bool = False           # scan_layers layout (leading layer axis)
+    page_size: int = 0              # 0 = ring layout; >0 = paged pool
+    n_pages: int = 0                # pool pages incl. the trash page
+
+    @property
+    def paged(self):
+        return self.page_size > 0
+
+    @property
+    def pages_per_row(self):
+        """Page-table width: pages covering one row's max_seq span."""
+        return self.max_seq // self.page_size if self.paged else 0
 
 
-def spec_for_model(cfg, max_batch, max_seq, kv_cache_dtype=None):
+def spec_for_model(cfg, max_batch, max_seq, kv_cache_dtype=None,
+                   page_size=0, n_pages=0):
     """Resolve a :class:`KVCacheSpec` from a ``GPT2Config`` and the
     ``inference.kv_cache_dtype`` knob (None = model compute dtype,
-    "bf16"/"f32" = plain storage, a codec name = quantized storage)."""
+    "bf16"/"f32" = plain storage, a codec name = quantized storage).
+    ``page_size > 0`` selects the paged pool layout; ``n_pages=0`` then
+    defaults to ring-capacity parity plus the trash page."""
     codec = None
     if kv_cache_dtype is None:
         dtype = cfg.dtype
@@ -67,15 +94,34 @@ def spec_for_model(cfg, max_batch, max_seq, kv_cache_dtype=None):
         raise ValueError(
             f"max seq bucket {max_seq} exceeds the model's n_positions "
             f"{cfg.n_positions}")
+    page_size, n_pages = int(page_size), int(n_pages)
+    if page_size:
+        if max_seq % page_size:
+            raise ValueError(
+                f"page_size {page_size} must divide max_seq {max_seq}")
+        if not n_pages:
+            # ring-capacity parity: every row can still fill its full
+            # max_seq span concurrently, plus the reserved trash page.
+            n_pages = int(max_batch) * (int(max_seq) // page_size) + 1
+        if n_pages < 2:
+            raise ValueError(
+                f"n_pages must be >= 2 (page 0 is the trash page), "
+                f"got {n_pages}")
     return KVCacheSpec(
         n_layer=cfg.n_layer, max_batch=int(max_batch),
         max_seq=int(max_seq), n_head=cfg.n_head,
         head_dim=cfg.n_embd // cfg.n_head, dtype=dtype, codec=codec,
-        stacked=bool(cfg.scan_layers))
+        stacked=bool(cfg.scan_layers), page_size=page_size,
+        n_pages=n_pages if page_size else 0)
 
 
 def _layer_leaves(spec):
-    shape = (spec.max_batch, spec.max_seq, spec.n_head, spec.head_dim)
+    if spec.paged:
+        shape = (spec.n_pages, spec.page_size, spec.n_head,
+                 spec.head_dim)
+    else:
+        shape = (spec.max_batch, spec.max_seq, spec.n_head,
+                 spec.head_dim)
     leaves = {"k": jnp.zeros(shape, spec.dtype),
               "v": jnp.zeros(shape, spec.dtype)}
     if spec.codec is not None:
@@ -122,7 +168,9 @@ def kv_partition_specs(spec, model_axis="model"):
     axis — the cache analog of the model's Megatron column-parallel QKV
     (`models/gpt2.py:gpt2_partition_specs`): each TP shard holds the
     heads it computes, so decode attention runs collective-free and the
-    row-parallel ``c_proj`` psum GSPMD inserts is the only combine."""
+    row-parallel ``c_proj`` psum GSPMD inserts is the only combine.
+    The ring row axis and the paged pool's page axis sit in the same
+    slot (axis 0 / axis 1 stacked), so one spec covers both layouts."""
     from jax.sharding import PartitionSpec as P
     lead = (None,) if spec.stacked else ()
     # no trailing None after the sharded head axis: jit keys compiled
@@ -224,14 +272,104 @@ def read_kv(layer_cache, dtype):
             _dequantize(layer_cache["v"], layer_cache["v_scale"], dtype))
 
 
-def attention_mask(layer_cache, positions):
+def attention_mask(layer_cache, positions, page_table=None):
     """The dense path's ``[B, T, S]`` position mask (cache index ``s``
     visible to the query at position ``p`` iff ``s <= p``). Exposed so
     callers running several layers per step (`models/gpt2.py`) can
     compute it ONCE and pass it down — rebuilt per layer it is the
-    compiled decode program's only per-layer iota."""
-    S = layer_cache["k"].shape[-3]
+    compiled decode program's only per-layer iota. With a paged cache
+    the buffer no longer carries the sequence length (``shape[-3]`` is
+    ``page_size``); ``S`` is ``pages_per_row * page_size`` off the page
+    table instead — the mask itself is layout-independent."""
+    if page_table is not None:
+        S = page_table.shape[-1] * layer_cache["k"].shape[-3]
+    else:
+        S = layer_cache["k"].shape[-3]
     return jnp.arange(S)[None, None, :] <= positions[:, :, None]
+
+
+# ---------------------------------------------------------------------------
+# paged pool ops
+# ---------------------------------------------------------------------------
+
+def paged_write_kv(layer_cache, k_new, v_new, positions, page_table):
+    """Write one chunk's keys/values into the page pool through a
+    page table. ``layer_cache`` holds ``[n_pages, page_size, H, D]``
+    pool leaves; ``page_table`` is ``[B, pages_per_row]`` int32 of
+    physical page ids (0 = trash for unallocated slots); positions are
+    contiguous per row as in :func:`write_kv`. Two shapes exist:
+
+    - decode (``T == 1``): a scatter of one ``[H, D]`` vector per row
+      at ``(table[b, p // page_size], p % page_size)``. Inactive rows
+      sit at position 0 with table entry 0 and collide harmlessly on
+      the trash page.
+    - prefill (``B == 1``): one ``dynamic_update_slice`` of the whole
+      chunk into a single page — the engine pins ``page_size %
+      prefill_chunk == 0`` so a chunk never straddles pages.
+
+    Quantization on the way in mirrors :func:`write_kv`: the pool's
+    per-(page, slot, head) scales are exactly the ring's per-(row,
+    position, head) scales under the page mapping, which is what lets
+    the flash kernel's fused dequant carry over unchanged.
+    """
+    codec = _codec_of(layer_cache)
+    page_size = layer_cache["k"].shape[-3]
+    B, T = positions.shape
+    start = positions[:, 0]
+
+    if T == 1:
+        pp = jnp.take_along_axis(
+            page_table, (start // page_size)[:, None], axis=1)[:, 0]
+        off = start % page_size
+
+        def scatter(buf, vals):
+            return buf.at[pp, off].set(vals[:, 0].astype(buf.dtype))
+    elif B == 1:
+        pp = page_table[0, start[0] // page_size]
+        off = start[0] % page_size
+
+        def scatter(buf, vals):
+            idx = (pp, off) + (0,) * (buf.ndim - 2)
+            return jax.lax.dynamic_update_slice(
+                buf, vals.astype(buf.dtype), idx)
+    else:
+        raise ValueError(
+            f"paged_write_kv handles decode (T==1) or single-row "
+            f"prefill (B==1); got B={B}, T={T}")
+
+    if codec is None:
+        return {"k": scatter(layer_cache["k"], k_new),
+                "v": scatter(layer_cache["v"], v_new)}
+    k_q, k_s = _quantize(k_new, codec)
+    v_q, v_s = _quantize(v_new, codec)
+    return {
+        "k": scatter(layer_cache["k"], k_q),
+        "v": scatter(layer_cache["v"], v_q),
+        "k_scale": scatter(layer_cache["k_scale"], k_s),
+        "v_scale": scatter(layer_cache["v_scale"], v_s),
+    }
+
+
+def paged_read_kv(layer_cache, page_table, dtype):
+    """Gather each row's pages into contiguous ``[B, S, H, D]``
+    key/value buffers in compute ``dtype`` (S = pages_per_row *
+    page_size) — the dense oracle's view of the paged pool. Trash /
+    unallocated entries gather page 0's garbage, which the position
+    mask hides exactly like ring remnants."""
+    codec = _codec_of(layer_cache)
+
+    def gather(buf):
+        g = jnp.take(buf, page_table, axis=0)   # [B, n_pt, ps, ...]
+        B, n_pt, ps = g.shape[:3]
+        return g.reshape((B, n_pt * ps) + g.shape[3:])
+
+    if codec is None:
+        return (gather(layer_cache["k"]).astype(dtype),
+                gather(layer_cache["v"]).astype(dtype))
+    return (_dequantize(gather(layer_cache["k"]),
+                        gather(layer_cache["k_scale"]), dtype),
+            _dequantize(gather(layer_cache["v"]),
+                        gather(layer_cache["v_scale"]), dtype))
 
 
 def _flash_attend(q, layer_cache, positions, block_k, mesh):
@@ -265,9 +403,42 @@ def _flash_attend(q, layer_cache, positions, block_k, mesh):
     return sharded(q, layer_cache["k"], layer_cache["v"], pos, *scales)
 
 
+def _flash_attend_paged(q, layer_cache, positions, page_table, block_k,
+                        mesh):
+    """Paged twin of :func:`_flash_attend`: the kernel gathers KV
+    blocks straight out of the pool through the scalar-prefetched page
+    table (`ops/pallas/flash_decode.py:flash_decode_paged`) — no
+    pool-sized gather/copy ever materializes. The pool's head axis
+    shards exactly like the ring's, so the TP ``shard_map`` only swaps
+    in the replicated page-table spec."""
+    from deepspeed_tpu.ops.pallas.flash_decode import flash_decode_paged
+
+    pos = positions[:, 0]
+    scales = ()
+    if "k_scale" in layer_cache:
+        scales = (layer_cache["k_scale"], layer_cache["v_scale"])
+
+    if mesh is None:
+        return flash_decode_paged(q, layer_cache["k"], layer_cache["v"],
+                                  pos, page_table, *scales,
+                                  block_k=block_k)
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    head = P(None, None, "model", None)
+    in_specs = (head, head, head, P(None), P(None, None)) + \
+        ((P(None, None, "model"),) * 2 if scales else ())
+    sharded = shard_map(
+        lambda q_, k_, v_, p_, t_, *s_: flash_decode_paged(
+            q_, k_, v_, p_, t_, *s_, block_k=block_k),
+        mesh=mesh, in_specs=in_specs, out_specs=head, check_rep=False)
+    return sharded(q, layer_cache["k"], layer_cache["v"], pos,
+                   page_table, *scales)
+
+
 def cached_attention(q, k_new, v_new, layer_cache, positions,
                      compute_dtype, impl="dense", block_k=128,
-                     mesh=None, mask=None):
+                     mesh=None, mask=None, page_table=None):
     """Write this chunk's k/v, then attend over the whole cache row.
 
     ``q``/``k_new``/``v_new``: ``[B, T, H, D]`` (T = 1 for a decode
@@ -292,12 +463,31 @@ def cached_attention(q, k_new, v_new, layer_cache, positions,
     across chunks it exposes exactly the already-written prefix, and
     for padded chunk tails / recycled-row remnants it hides everything
     until a real token overwrites the slot.
+
+    ``page_table`` (``[B, pages_per_row]`` int32) switches the layout:
+    writes route through :func:`paged_write_kv`, the dense path attends
+    over :func:`paged_read_kv`'s gathered view, and flash decode steps
+    run the page-gather kernel. The attention math itself is layout-
+    blind — pages only change where bytes live, never what the mask
+    admits — which is what makes the ring the paged path's oracle.
     """
-    layer_cache = write_kv(layer_cache, k_new, v_new, positions)
-    if impl == "flash" and q.shape[1] == 1:
-        y = _flash_attend(q, layer_cache, positions, block_k, mesh)
-        return y.astype(compute_dtype), layer_cache
-    k_full, v_full = read_kv(layer_cache, compute_dtype)
+    if page_table is None:
+        layer_cache = write_kv(layer_cache, k_new, v_new, positions)
+        if impl == "flash" and q.shape[1] == 1:
+            y = _flash_attend(q, layer_cache, positions, block_k, mesh)
+            return y.astype(compute_dtype), layer_cache
+        k_full, v_full = read_kv(layer_cache, compute_dtype)
+    else:
+        layer_cache = paged_write_kv(layer_cache, k_new, v_new,
+                                     positions, page_table)
+        if impl == "flash" and q.shape[1] == 1:
+            y = _flash_attend_paged(q, layer_cache, positions,
+                                    page_table, block_k, mesh)
+            return y.astype(compute_dtype), layer_cache
+        if mask is None:
+            mask = attention_mask(layer_cache, positions, page_table)
+        k_full, v_full = paged_read_kv(layer_cache, page_table,
+                                       compute_dtype)
     D = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, compute_dtype))
     att = jnp.einsum("bthd,bshd->bhts", q, k_full) * scale

@@ -20,6 +20,13 @@ recompile contract: :meth:`compile_counts` must read ``{"prefill": 1,
 "decode": 1}`` from warmup to drain, and :meth:`recompile_findings`
 turns any growth into the PR 4 detector's error finding.
 
+``kv_layout="paged"`` swaps the ring rows for a page pool
+(`inference/cache.py` paged layout): both programs take fixed-shape
+int32 page tables as plain data, so page allocation, prefix sharing
+and host-tier park/resume (`inference/paging.py`) are admission-time
+metadata under the SAME 2-compile contract — the pool shape and table
+shape never change, only their contents.
+
 With a mesh whose ``model`` axis is >1 the engine places params with
 the model's Megatron PartitionSpecs (`models/gpt2.py:
 gpt2_partition_specs` — the `parallel/tensor_parallel.py` layout) and
@@ -49,6 +56,7 @@ DEFAULT_SEQ_BUCKETS = (128, 512)
 DEFAULT_PREFILL_CHUNK = 32
 DEFAULT_MAX_NEW_TOKENS = 64
 DEFAULT_ATTENTION_BLOCK_K = 128
+DEFAULT_HOST_PARK_THRESHOLD = 0.25
 
 
 def _cfg_get(config, key, default):
@@ -94,10 +102,24 @@ class InferenceEngine:
         self.top_k = int(_cfg_get(config, "top_k", 0))
         self.top_p = float(_cfg_get(config, "top_p", 1.0))
         self.sampling_seed = int(_cfg_get(config, "sampling_seed", 0))
+        self.kv_layout = str(_cfg_get(config, "kv_layout", "ring"))
+        self.page_size = int(_cfg_get(config, "page_size", 0))
+        self.n_pages = int(_cfg_get(config, "n_pages", 0))
+        self.prefix_cache = bool(_cfg_get(config, "prefix_cache", True))
+        self.host_park_threshold = float(_cfg_get(
+            config, "host_park_threshold", DEFAULT_HOST_PARK_THRESHOLD))
         if self.attention_impl not in ("dense", "flash"):
             raise ValueError(
                 f"inference.attention.impl must be 'dense' or 'flash', "
                 f"got {self.attention_impl!r}")
+        if self.kv_layout not in ("ring", "paged"):
+            raise ValueError(
+                f"inference.kv_layout must be 'ring' or 'paged', got "
+                f"{self.kv_layout!r}")
+        if not 0.0 <= self.host_park_threshold < 1.0:
+            raise ValueError(
+                f"host_park_threshold must be in [0, 1), got "
+                f"{self.host_park_threshold}")
         if self.temperature < 0.0:
             raise ValueError(f"sampling temperature must be >= 0, got "
                              f"{self.temperature}")
@@ -131,8 +153,39 @@ class InferenceEngine:
             raise ValueError(
                 f"attention block_k {self.attention_block_k} must be a "
                 f"positive divisor of max_seq {self.max_seq}")
+        if self.kv_layout == "paged":
+            if not self.page_size:
+                # auto: two prefill chunks per page — fine-grained
+                # enough for the bytes/session win, coarse enough that
+                # page tables stay short.
+                self.page_size = min(2 * self.prefill_chunk,
+                                     self.max_seq)
+            if self.page_size % self.prefill_chunk:
+                # a prefill chunk must land inside ONE page (the paged
+                # prefill write is a single dynamic_update_slice).
+                raise ValueError(
+                    f"page_size {self.page_size} must be a multiple of "
+                    f"prefill_chunk {self.prefill_chunk}")
+            if self.max_seq % self.page_size:
+                raise ValueError(
+                    f"page_size {self.page_size} must divide max_seq "
+                    f"{self.max_seq}")
+            # a flash KV block must not straddle a page boundary
+            self.attention_block_k = min(self.attention_block_k,
+                                         self.page_size)
+            if self.page_size % self.attention_block_k:
+                raise ValueError(
+                    f"attention block_k {self.attention_block_k} must "
+                    f"divide page_size {self.page_size}")
+        else:
+            self.page_size = 0
+            self.n_pages = 0
         self.spec = spec_for_model(cfg, self.max_batch, self.max_seq,
-                                   self.kv_cache_dtype)
+                                   self.kv_cache_dtype,
+                                   page_size=self.page_size,
+                                   n_pages=self.n_pages)
+        self.n_pages = self.spec.n_pages
+        self.pages_per_row = self.spec.pages_per_row
         self.mesh = mesh
         self.session = session
         self._sample_key = jax.random.PRNGKey(self.sampling_seed)
@@ -163,10 +216,21 @@ class InferenceEngine:
         self.params = params
         self.cache = cache
 
-        # cache (arg 1) is donated in both programs: the ring buffer
-        # updates in place instead of doubling HBM every step.
-        self._prefill = donated_jit(self._prefill_fn, donate_argnums=(1,))
-        self._decode = donated_jit(self._decode_fn, donate_argnums=(1,))
+        # cache (arg 1) is donated in both programs: the ring buffer /
+        # page pool updates in place instead of doubling HBM every
+        # step. Layout picks which trace to compile — page tables are
+        # plain int32 DATA inputs with a fixed shape, so allocator
+        # churn never reaches a jit boundary.
+        if self.kv_layout == "paged":
+            self._prefill = donated_jit(self._prefill_fn_paged,
+                                        donate_argnums=(1,))
+            self._decode = donated_jit(self._decode_fn_paged,
+                                       donate_argnums=(1,))
+        else:
+            self._prefill = donated_jit(self._prefill_fn,
+                                        donate_argnums=(1,))
+            self._decode = donated_jit(self._decode_fn,
+                                       donate_argnums=(1,))
 
     # -- compiled programs --------------------------------------------------
 
@@ -210,13 +274,50 @@ class InferenceEngine:
         return next_tokens, logits.astype(jnp.float32), key, \
             self._pin_cache(cache)
 
+    def _prefill_fn_paged(self, params, cache, tokens, positions,
+                          page_table):
+        # the paged prefill addresses the POOL through the chunk's
+        # page table — no row slice/unslice; the whole cache flows
+        # through so donation still updates it in place.
+        logits, cache = self.model.apply(
+            {"params": params}, tokens, deterministic=True,
+            positions=positions, kv_cache=cache,
+            kv_page_table=page_table)
+        return logits.astype(jnp.float32), self._pin_cache(cache)
+
+    def _decode_fn_paged(self, params, cache, tokens, positions,
+                         page_tables, key):
+        mesh = self.mesh if self._cache_shardings is not None else None
+        logits, cache = self.model.apply(
+            {"params": params}, tokens[:, None], deterministic=True,
+            positions=positions[:, None], kv_cache=cache,
+            attn_impl=self.attention_impl,
+            attn_block_k=self.attention_block_k, attn_mesh=mesh,
+            kv_page_table=page_tables)
+        logits = logits[:, 0]
+        from deepspeed_tpu.inference.sampling import sample_logits
+        next_tokens, key = sample_logits(
+            logits, key, temperature=self.temperature,
+            top_k=self.top_k, top_p=self.top_p)
+        return next_tokens, logits.astype(jnp.float32), key, \
+            self._pin_cache(cache)
+
     # -- host API -----------------------------------------------------------
 
-    def prefill(self, slot, prompt):
+    def prefill(self, slot, prompt, page_table=None, start=0):
         """Chunked prefill of ``prompt`` (token ids) into cache row
         ``slot``; returns the fp-logits at the last prompt token
         (``[vocab]``, numpy) — what greedy sampling of the first
-        generated token reads."""
+        generated token reads.
+
+        Paged layout: ``page_table`` (``[pages_per_row]`` ints, pages
+        covering the prompt allocated by the scheduler) addresses the
+        pool instead of ``slot``, and ``start`` (chunk-aligned) resumes
+        mid-prompt — a prefix-cache hit skips the chunks the shared
+        pages already hold; a parked-session resume restarts at the
+        session's frontier. The skipped span's KV is bit-identical by
+        construction: prefill is deterministic, so re-running it would
+        write the same bytes the shared pages already carry."""
         n = len(prompt)
         if not 0 < n <= self.max_seq:
             raise ValueError(
@@ -226,31 +327,96 @@ class InferenceEngine:
         toks = np.zeros((1, padded), np.int32)
         toks[0, :n] = np.asarray(prompt, np.int32)
         last_chunk = (n - 1) // chunk
+        paged = self.kv_layout == "paged"
+        if paged:
+            if page_table is None:
+                raise ValueError("paged prefill requires a page_table")
+            pt = jnp.asarray(
+                np.asarray(page_table, np.int32).reshape(1, -1))
+        # the last chunk always runs (it produces the logits the first
+        # sampled token reads), so a resume start clamps to it.
+        start = min(int(start), last_chunk * chunk) if paged else 0
+        if start % chunk:
+            raise ValueError(
+                f"prefill start {start} must be chunk-aligned "
+                f"(chunk={chunk})")
         last = None
-        for ci in range(padded // chunk):
+        for ci in range(start // chunk, padded // chunk):
             tc = jnp.asarray(toks[:, ci * chunk:(ci + 1) * chunk])
             pc = jnp.arange(ci * chunk, (ci + 1) * chunk,
                             dtype=jnp.int32)[None, :]
-            logits, self.cache = self._prefill(
-                self.params, self.cache, tc, pc,
-                jnp.asarray(slot, jnp.int32))
+            if paged:
+                logits, self.cache = self._prefill(
+                    self.params, self.cache, tc, pc, pt)
+            else:
+                logits, self.cache = self._prefill(
+                    self.params, self.cache, tc, pc,
+                    jnp.asarray(slot, jnp.int32))
             if ci == last_chunk:
                 last = np.asarray(logits[0, (n - 1) % chunk])
         return last
 
-    def decode(self, tokens, positions):
+    def decode(self, tokens, positions, page_tables=None):
         """One decode step for every cache row at once. ``tokens`` /
         ``positions``: ``[max_batch]`` int arrays (inactive rows padded
         with zeros — their outputs are meaningless and ignored).
         Returns ``(next_tokens [max_batch], logits [max_batch, vocab])``
         as numpy; sampling (greedy argmax, or temperature/top-k/top-p
         with the threaded PRNG key) happens in-program so it costs no
-        extra device round trip."""
+        extra device round trip. Paged layout additionally takes the
+        ``[max_batch, pages_per_row]`` page tables (inactive rows all
+        zeros — their garbage token lands on the trash page)."""
         t = jnp.asarray(np.asarray(tokens, np.int32))
         p = jnp.asarray(np.asarray(positions, np.int32))
-        nxt, logits, self._sample_key, self.cache = self._decode(
-            self.params, self.cache, t, p, self._sample_key)
+        if self.kv_layout == "paged":
+            if page_tables is None:
+                raise ValueError("paged decode requires page_tables")
+            pt = jnp.asarray(np.asarray(page_tables, np.int32))
+            nxt, logits, self._sample_key, self.cache = self._decode(
+                self.params, self.cache, t, p, pt, self._sample_key)
+        else:
+            nxt, logits, self._sample_key, self.cache = self._decode(
+                self.params, self.cache, t, p, self._sample_key)
         return np.asarray(nxt), np.asarray(logits)
+
+    # -- host-RAM page tier (paged layout only) -----------------------------
+
+    def gather_pages(self, page_ids):
+        """Snapshot the given physical pages to host RAM: a per-layer
+        ``{"k": [n, page_size, H, D], ...}`` numpy pytree, copied with
+        the hot-checkpoint snapshot-isolation discipline
+        (`runtime/resilience/hotckpt.py:_snapshot_to_host` — the
+        compiled steps donate the pool, so host views must never alias
+        live device memory). Runs OUTSIDE the two compiled programs:
+        parking is host-side admission work, the steady-state decode
+        program stays transfer-free."""
+        from deepspeed_tpu.runtime.resilience.hotckpt import (
+            _snapshot_to_host)
+        ids = jnp.asarray(np.asarray(page_ids, np.int32))
+        axis = 1 if self.spec.stacked else 0
+        gathered = jax.tree_util.tree_map(
+            lambda leaf: jnp.take(leaf, ids, axis=axis), self.cache)
+        return _snapshot_to_host(gathered)
+
+    def scatter_pages(self, page_ids, host_pages):
+        """Inverse of :meth:`gather_pages`: write a host page snapshot
+        back into (freshly allocated) physical pages — the resume half
+        of the host tier."""
+        ids = np.asarray(page_ids, np.int32)
+        axis = 1 if self.spec.stacked else 0
+
+        def upd(leaf, vals):
+            vals = jnp.asarray(vals, leaf.dtype)
+            if axis == 0:
+                return leaf.at[ids].set(vals)
+            return leaf.at[:, ids].set(vals)
+
+        self.cache = jax.tree_util.tree_map(upd, self.cache, host_pages)
+        if self._cache_shardings is not None:
+            # eager .at updates drop the committed sharding; re-place
+            # so the next compiled call sees the pinned layout.
+            self.cache = jax.tree_util.tree_map(
+                jax.device_put, self.cache, self._cache_shardings)
 
     def sample_first(self, last_logits):
         """Sample the FIRST generated token from prefill's last-prompt-
@@ -310,6 +476,13 @@ class InferenceEngine:
     def decode_lowering_args(self):
         """The exact avals :meth:`decode` calls with — lowering through
         these is a jit-cache hit, never a fresh compile."""
+        if self.kv_layout == "paged":
+            return (self.params, self.cache,
+                    jnp.zeros((self.max_batch,), jnp.int32),
+                    jnp.zeros((self.max_batch,), jnp.int32),
+                    jnp.zeros((self.max_batch, self.pages_per_row),
+                              jnp.int32),
+                    self._sample_key)
         return (self.params, self.cache,
                 jnp.zeros((self.max_batch,), jnp.int32),
                 jnp.zeros((self.max_batch,), jnp.int32),
@@ -322,11 +495,17 @@ class InferenceEngine:
 
     def cache_facts(self):
         """Static cache facts for audits and the bench row."""
-        return {"bytes": kv_cache_nbytes(self.cache),
-                "dtype_census": cache_dtype_census(self.cache),
-                "kv_cache_dtype": self.kv_cache_dtype,
-                "max_batch": self.max_batch,
-                "max_seq": self.max_seq,
-                "seq_buckets": list(self.seq_buckets),
-                "prefill_chunk": self.prefill_chunk,
-                "stacked": self.spec.stacked}
+        facts = {"bytes": kv_cache_nbytes(self.cache),
+                 "dtype_census": cache_dtype_census(self.cache),
+                 "kv_cache_dtype": self.kv_cache_dtype,
+                 "kv_layout": self.kv_layout,
+                 "max_batch": self.max_batch,
+                 "max_seq": self.max_seq,
+                 "seq_buckets": list(self.seq_buckets),
+                 "prefill_chunk": self.prefill_chunk,
+                 "stacked": self.spec.stacked}
+        if self.kv_layout == "paged":
+            facts.update(page_size=self.page_size,
+                         n_pages=self.n_pages,
+                         pages_per_row=self.pages_per_row)
+        return facts

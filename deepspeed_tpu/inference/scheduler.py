@@ -19,6 +19,15 @@ Every decode step emits one ``decode_step`` telemetry event (tokens
 produced, live batch, occupancy, queue depth, host wall) through the
 session, feeding ``ds_tpu_metrics summary``'s serve mode and the
 registry's ``decode_*`` metric families.
+
+With a paged engine (``inference.kv_layout = "paged"``) the scheduler
+delegates page mapping to `inference/paging.py:PagedCacheManager`:
+admission walks the radix prefix cache (shared pages mapped, prefill
+resumed mid-prompt), each decode step grows rows' mappings page by
+page, and a finished request carrying a ``session_id`` parks its pages
+(device first, host RAM under pressure) instead of freeing them. All
+of it stays host-side: the compiled decode step just receives the
+``[max_batch, pages_per_row]`` tables the manager maintains.
 """
 
 import collections
@@ -34,12 +43,15 @@ class Request:
     """One generation request. ``arrival_step``>0 makes the stream
     open-loop: the scheduler won't admit the request before its decode
     step count reaches it (deterministic synthetic load for benches and
-    tests)."""
+    tests). ``session_id`` (paged engines) parks the request's KV pages
+    at completion so a follow-up request on the same session resumes
+    without re-prefilling its history."""
     rid: str
     prompt: List[int]
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
     arrival_step: int = 0
+    session_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -51,6 +63,10 @@ class Completion:
     bucket: int
     slot: int
     steps: int                  # decode steps this request was live for
+    prefix_hit: bool = False    # admitted on shared radix pages
+    resumed: bool = False       # admitted by resuming a parked session
+    prefill_chunks: int = 0     # prefill chunks actually run
+    prefill_chunks_skipped: int = 0
 
 
 @dataclasses.dataclass
@@ -61,6 +77,7 @@ class _Slot:
     pending: int                # last sampled token (next decode input)
     generated: List[int]
     admitted_step: int
+    paging: object = None       # RowPaging when the engine is paged
 
 
 class ContinuousBatchingScheduler:
@@ -71,6 +88,10 @@ class ContinuousBatchingScheduler:
         self.slots = [None] * engine.max_batch
         self.step_count = 0
         self.completions = []
+        self.paging = None
+        if getattr(engine, "kv_layout", "ring") == "paged":
+            from deepspeed_tpu.inference.paging import PagedCacheManager
+            self.paging = PagedCacheManager(engine, session=self.session)
 
     # -- request lifecycle --------------------------------------------------
 
@@ -96,10 +117,22 @@ class ContinuousBatchingScheduler:
 
     def _finish(self, i, reason):
         s = self.slots[i]
-        self.completions.append(Completion(
+        comp = Completion(
             rid=s.request.rid, prompt_len=len(s.request.prompt),
             tokens=list(s.generated), finish_reason=reason, bucket=s.bucket,
-            slot=i, steps=self.step_count - s.admitted_step))
+            slot=i, steps=self.step_count - s.admitted_step)
+        if s.paging is not None:
+            comp.prefix_hit = s.paging.prefix_hit
+            comp.resumed = s.paging.resumed
+            comp.prefill_chunks = s.paging.prefill_chunks
+            comp.prefill_chunks_skipped = s.paging.prefill_chunks_skipped
+            # KV on the pages covers the prompt plus every generated
+            # token that fed a later decode step (the LAST sampled
+            # token was never written — nothing attended past it).
+            kv_tokens = list(s.request.prompt) + s.generated[:-1]
+            self.paging.release(s.paging, kv_tokens=kv_tokens,
+                                session_id=s.request.session_id)
+        self.completions.append(comp)
         self.slots[i] = None            # row back on the ring
 
     def _check_finished(self, i):
@@ -120,13 +153,31 @@ class ContinuousBatchingScheduler:
             if not self.queue or \
                     self.queue[0].arrival_step > self.step_count:
                 break
-            req = self.queue.popleft()
-            last_logits = self.engine.prefill(i, req.prompt)
+            req = self.queue[0]
+            row = None
+            if self.paging is not None:
+                row = self.paging.admit(req.prompt,
+                                        session_id=req.session_id)
+                if row is None:
+                    # pool can't back the prompt right now even after
+                    # the eviction ladder — leave the request queued
+                    # and let running rows finish and free pages.
+                    break
+                self.queue.popleft()
+                last_logits = self.engine.prefill(
+                    i, req.prompt,
+                    page_table=row.table(self.paging.pages_per_row),
+                    start=row.start)
+                self.paging.after_prefill(row, req.prompt)
+            else:
+                self.queue.popleft()
+                last_logits = self.engine.prefill(i, req.prompt)
             first = self.engine.sample_first(last_logits)
             self.slots[i] = _Slot(
                 request=req, bucket=self._bucket_for(req),
                 next_pos=len(req.prompt), pending=first,
-                generated=[first], admitted_step=self.step_count)
+                generated=[first], admitted_step=self.step_count,
+                paging=row)
             self._check_finished(i)
 
     # -- the decode loop ----------------------------------------------------
@@ -136,6 +187,16 @@ class ContinuousBatchingScheduler:
         step over the live rows. Returns True while there is (or will
         be) work left."""
         self._admit()
+        if self.paging is not None:
+            # grow each live row's page mapping to cover this step's
+            # write BEFORE building the tables; a row the pool can't
+            # grow even after the eviction ladder is length-finished
+            # (same truncation contract as a bucket edge).
+            for i, s in enumerate(self.slots):
+                if s is not None and \
+                        not self.paging.ensure_position(s.paging,
+                                                        s.next_pos):
+                    self._finish(i, "length")
         active = [i for i, s in enumerate(self.slots) if s is not None]
         if not active:
             self.step_count += 1        # idle tick (open-loop gap)
@@ -146,8 +207,19 @@ class ContinuousBatchingScheduler:
         for i in active:
             tokens[i] = self.slots[i].pending
             positions[i] = self.slots[i].next_pos
+        page_tables = None
+        if self.paging is not None:
+            page_tables = np.zeros((mb, self.paging.pages_per_row),
+                                   np.int32)
+            for i in active:
+                page_tables[i] = self.slots[i].paging.table(
+                    self.paging.pages_per_row)
         t0 = time.perf_counter()
-        next_tokens, _ = self.engine.decode(tokens, positions)
+        if page_tables is None:
+            next_tokens, _ = self.engine.decode(tokens, positions)
+        else:
+            next_tokens, _ = self.engine.decode(tokens, positions,
+                                                page_tables=page_tables)
         wall = time.perf_counter() - t0
         self.step_count += 1
         for i in active:
@@ -181,10 +253,20 @@ class ContinuousBatchingScheduler:
         if self.session is None:
             return
         occ = batch / float(self.engine.max_batch)
+        extra = {}
+        if self.paging is not None:
+            pg = self.paging
+            extra = {"pages_free": pg.allocator.free_pages,
+                     "pages_resident": pg.allocator.resident_pages,
+                     "prefix_hits": pg.prefix_hits,
+                     "prefix_misses": pg.prefix_misses,
+                     "sessions_admitted": pg.sessions_admitted,
+                     "sessions_parked_host": len(pg.host_store),
+                     "cache_bytes": pg.page_bytes() * pg.engine.n_pages}
         self.session.emit(
             "decode_step", step=self.step_count, tokens=batch,
             batch=batch, occupancy=occ, queue_depth=len(self.queue),
-            wall_s=wall_s)
+            wall_s=wall_s, **extra)
         reg = self.session.registry
         reg.histogram("decode_step_seconds",
                       help="host wall per compiled decode step").observe(
@@ -196,3 +278,19 @@ class ContinuousBatchingScheduler:
         reg.gauge("decode_queue_depth",
                   help="requests waiting for a cache row").set(
                       len(self.queue))
+        if self.paging is not None:
+            pg = self.paging
+            reg.gauge("kv_pages_free",
+                      help="unallocated pool pages").set(
+                          pg.allocator.free_pages)
+            reg.gauge("kv_pages_resident",
+                      help="allocated pool pages (live + parked + "
+                           "interned)").set(pg.allocator.resident_pages)
+            hits = reg.counter("prefix_hits",
+                               help="admissions that mapped shared "
+                                    "radix pages")
+            hits.inc(pg.prefix_hits - hits.value)
+            misses = reg.counter("prefix_misses",
+                                 help="admissions with no interned "
+                                      "prefix")
+            misses.inc(pg.prefix_misses - misses.value)

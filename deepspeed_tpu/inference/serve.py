@@ -5,6 +5,8 @@
     ds_tpu_serve --config ds_config.json      # inference block from config
     ds_tpu_serve --scan-layers --kv-cache-dtype int8
     ds_tpu_serve --expect-compiles 2 --json
+    ds_tpu_serve --synthetic 8 --kv-layout paged --shared-prefix 12 \
+                 --expect-prefix-hits 1   # radix prefix-cache smoke
 
 The model is the test-size GPT-2 with seeded random params — this CLI
 exists to exercise and measure the serving engine (CI smoke, bench
@@ -30,7 +32,7 @@ import sys
 import numpy as np
 
 
-def _build_requests(args, vocab_size):
+def _build_requests(args, vocab_size, max_seq):
     from deepspeed_tpu.inference.scheduler import Request
     if args.requests:
         reqs = []
@@ -46,17 +48,25 @@ def _build_requests(args, vocab_size):
                     max_new_tokens=int(
                         d.get("max_new_tokens", args.max_new)),
                     eos_id=d.get("eos_id"),
-                    arrival_step=int(d.get("arrival_step", 0))))
+                    arrival_step=int(d.get("arrival_step", 0)),
+                    session_id=d.get("session_id")))
         return reqs
     # synthetic open-loop stream: varied prompt lengths spanning the
-    # buckets, staggered arrivals, deterministic under --seed.
+    # buckets, staggered arrivals, deterministic under --seed. With
+    # --shared-prefix N every prompt opens with the same N tokens (a
+    # common system prompt) so a paged engine's radix cache gets hits.
     rng = np.random.default_rng(args.seed)
+    shared = rng.integers(
+        0, vocab_size, args.shared_prefix).tolist() \
+        if args.shared_prefix else []
     reqs = []
     for i in range(args.synthetic):
         plen = int(rng.integers(2, max(3, args.synthetic_max_prompt)))
+        tail = rng.integers(0, vocab_size, plen).tolist()
+        prompt = (shared + tail)[:max_seq - 1]
         reqs.append(Request(
             rid=f"s{i}",
-            prompt=rng.integers(0, vocab_size, plen).tolist(),
+            prompt=prompt,
             max_new_tokens=args.max_new,
             arrival_step=int(i * args.arrival_every)))
     return reqs
@@ -88,6 +98,35 @@ def main(argv=None):
     parser.add_argument("--block-k", type=int, default=None,
                         help="flash-decode KV block size (must divide "
                              "max(seq_buckets))")
+    parser.add_argument("--kv-layout", default=None,
+                        choices=("ring", "paged"),
+                        help="KV cache layout: per-row ring buffers or "
+                             "the paged pool with radix prefix sharing")
+    parser.add_argument("--page-size", type=int, default=None,
+                        help="paged layout: tokens per KV page (0 = "
+                             "auto; must be a multiple of "
+                             "prefill_chunk and divide max seq bucket)")
+    parser.add_argument("--n-pages", type=int, default=None,
+                        help="paged layout: physical pool pages "
+                             "(0 = auto; page 0 is the trash page)")
+    parser.add_argument("--prefix-cache", dest="prefix_cache",
+                        action="store_true", default=None,
+                        help="paged layout: intern finished prompts in "
+                             "the radix prefix cache (default on)")
+    parser.add_argument("--no-prefix-cache", dest="prefix_cache",
+                        action="store_false",
+                        help="paged layout: disable prefix sharing")
+    parser.add_argument("--park-threshold", type=float, default=None,
+                        help="paged layout: evacuate parked sessions "
+                             "to host RAM when the free-page fraction "
+                             "drops below this (0 disables)")
+    parser.add_argument("--shared-prefix", type=int, default=0,
+                        help="synthetic stream: open every prompt with "
+                             "the same N tokens (a shared system "
+                             "prompt) to exercise the prefix cache")
+    parser.add_argument("--expect-prefix-hits", type=int, default=None,
+                        help="exit 1 unless the paged prefix cache "
+                             "recorded at least this many hits")
     parser.add_argument("--temperature", type=float, default=None,
                         help="sampling temperature (0 = greedy argmax, "
                              "the default)")
@@ -155,7 +194,12 @@ def main(argv=None):
                    "temperature": inf.temperature,
                    "top_k": inf.top_k,
                    "top_p": inf.top_p,
-                   "sampling_seed": inf.sampling_seed}
+                   "sampling_seed": inf.sampling_seed,
+                   "kv_layout": inf.kv_layout,
+                   "page_size": inf.page_size,
+                   "n_pages": inf.n_pages,
+                   "prefix_cache": inf.prefix_cache,
+                   "host_park_threshold": inf.host_park_threshold}
     if args.max_batch is not None:
         inf_cfg["max_batch"] = args.max_batch
     if args.seq_buckets is not None:
@@ -175,6 +219,19 @@ def main(argv=None):
         inf_cfg["top_k"] = args.top_k
     if args.top_p is not None:
         inf_cfg["top_p"] = args.top_p
+    if args.kv_layout is not None:
+        inf_cfg["kv_layout"] = args.kv_layout
+    if args.page_size is not None:
+        inf_cfg["page_size"] = args.page_size
+    if args.n_pages is not None:
+        inf_cfg["n_pages"] = args.n_pages
+    if args.prefix_cache is not None:
+        inf_cfg["prefix_cache"] = args.prefix_cache
+    if args.park_threshold is not None:
+        inf_cfg["host_park_threshold"] = args.park_threshold
+    if args.expect_prefix_hits is not None and \
+            inf_cfg.get("kv_layout", "ring") != "paged":
+        parser.error("--expect-prefix-hits requires --kv-layout paged")
     # --seed doubles as the sampling seed: one knob pins params, the
     # synthetic stream, AND the in-program sampler, so a serve is
     # reproducible end to end (a non-default --seed beats the config).
@@ -195,7 +252,7 @@ def main(argv=None):
                              session=session)
     sched = ContinuousBatchingScheduler(engine)
 
-    requests = _build_requests(args, cfg.vocab_size)
+    requests = _build_requests(args, cfg.vocab_size, engine.max_seq)
     completions = sched.run(requests)
 
     counts = engine.compile_counts()
@@ -205,7 +262,10 @@ def main(argv=None):
         "completions": [
             {"rid": c.rid, "prompt_len": c.prompt_len,
              "tokens": c.tokens, "finish_reason": c.finish_reason,
-             "bucket": c.bucket, "slot": c.slot, "steps": c.steps}
+             "bucket": c.bucket, "slot": c.slot, "steps": c.steps,
+             "prefix_hit": c.prefix_hit, "resumed": c.resumed,
+             "prefill_chunks": c.prefill_chunks,
+             "prefill_chunks_skipped": c.prefill_chunks_skipped}
             for c in completions],
         "decode_steps": sched.step_count,
         "compile_counts": counts,
@@ -216,28 +276,52 @@ def main(argv=None):
                      "top_k": engine.top_k, "top_p": engine.top_p,
                      "seed": engine.sampling_seed},
     }
+    if sched.paging is not None:
+        result["paging"] = sched.paging.facts()
     ok = len(completions) == len(requests)
     if args.expect_compiles is not None:
         result["expect_compiles"] = args.expect_compiles
         ok = ok and total_compiles == args.expect_compiles
+    prefix_hits_ok = True
+    if args.expect_prefix_hits is not None:
+        hits = result["paging"]["prefix_hits"]
+        result["expect_prefix_hits"] = args.expect_prefix_hits
+        prefix_hits_ok = hits >= args.expect_prefix_hits
+        ok = ok and prefix_hits_ok
     result["ok"] = ok
 
     if args.as_json:
         print(json.dumps(result, indent=2, sort_keys=True))
     else:
         for c in completions:
+            extra = ""
+            if c.prefix_hit or c.resumed:
+                kind = "resumed" if c.resumed else "prefix hit"
+                extra = (f", {kind}: skipped "
+                         f"{c.prefill_chunks_skipped} prefill chunk(s)")
             print(f"{c.rid}: prompt {c.prompt_len} tokens -> "
                   f"{len(c.tokens)} generated ({c.finish_reason}, "
-                  f"bucket {c.bucket}, slot {c.slot})")
+                  f"bucket {c.bucket}, slot {c.slot}{extra})")
         print(f"{len(completions)}/{len(requests)} requests completed "
               f"in {sched.step_count} decode step(s); compiles: "
               f"prefill={counts['prefill']} decode={counts['decode']}")
+        if sched.paging is not None:
+            pg = result["paging"]
+            print(f"paged KV: {pg['pages_resident']}/{pg['n_pages']} "
+                  f"pages resident, prefix hits {pg['prefix_hits']}/"
+                  f"misses {pg['prefix_misses']}, host-parked "
+                  f"{pg['sessions_parked_host']} session(s)")
         if not ok:
-            print("FAIL: "
-                  + ("unfinished requests"
-                     if len(completions) != len(requests) else
-                     f"compile count {total_compiles} != expected "
-                     f"{args.expect_compiles}"), file=sys.stderr)
+            if len(completions) != len(requests):
+                why = "unfinished requests"
+            elif not prefix_hits_ok:
+                why = (f"prefix hits "
+                       f"{result['paging']['prefix_hits']} < expected "
+                       f"{args.expect_prefix_hits}")
+            else:
+                why = (f"compile count {total_compiles} != expected "
+                       f"{args.expect_compiles}")
+            print(f"FAIL: {why}", file=sys.stderr)
     return 0 if ok else 1
 
 

@@ -123,7 +123,7 @@ class CausalSelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic=True, positions=None,
                  kv_cache=None, attn_impl="dense", attn_block_k=128,
-                 attn_mesh=None, attn_mask=None):
+                 attn_mesh=None, attn_mask=None, kv_page_table=None):
         cfg = self.config
         B, T, C = x.shape
         H = cfg.n_head
@@ -142,7 +142,8 @@ class CausalSelfAttention(nn.Module):
                                             impl=attn_impl,
                                             block_k=attn_block_k,
                                             mesh=attn_mesh,
-                                            mask=attn_mask)
+                                            mask=attn_mask,
+                                            page_table=kv_page_table)
         elif cfg.use_flash_attention:
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
             # Attention-prob dropout runs inside the kernels (counter-based
@@ -208,7 +209,7 @@ class Block(nn.Module):
     def __call__(self, x, deterministic=True, pld_theta=None,
                  layer_idx=None, positions=None, kv_cache=None,
                  attn_impl="dense", attn_block_k=128, attn_mesh=None,
-                 attn_mask=None):
+                 attn_mask=None, kv_page_table=None):
         cfg = self.config
         attn = CausalSelfAttention(cfg, name="attn")
         mlp = MLP(cfg, name="mlp")
@@ -223,7 +224,8 @@ class Block(nn.Module):
                                 positions=positions, kv_cache=kv_cache,
                                 attn_impl=attn_impl,
                                 attn_block_k=attn_block_k,
-                                attn_mesh=attn_mesh, attn_mask=attn_mask)
+                                attn_mesh=attn_mesh, attn_mask=attn_mask,
+                                kv_page_table=kv_page_table)
             x = x + a
             x = x + mlp(ln2(x), deterministic)
             return x, new_cache
@@ -268,7 +270,8 @@ class GPT2LMHead(nn.Module):
     @nn.compact
     def __call__(self, input_ids, deterministic=True, pld_theta=None,
                  return_hidden=False, positions=None, kv_cache=None,
-                 attn_impl="dense", attn_block_k=128, attn_mesh=None):
+                 attn_impl="dense", attn_block_k=128, attn_mesh=None,
+                 kv_page_table=None):
         cfg = self.config
         B, T = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
@@ -306,33 +309,40 @@ class GPT2LMHead(nn.Module):
             # once here and broadcast to every layer, instead of each
             # layer rebuilding the same [B, T, max_seq] iota-compare
             # inside the compiled decode program (the flash path masks
-            # in-kernel from the positions scalar and needs none).
+            # in-kernel from the positions scalar and needs none; the
+            # paged pool takes S off the page table — the pool buffer
+            # no longer carries the sequence length).
             from deepspeed_tpu.inference.cache import attention_mask
             layer0 = kv_cache["h" if cfg.scan_layers else "h_0"]
-            attn_mask = attention_mask(layer0, positions)
+            attn_mask = attention_mask(layer0, positions,
+                                       page_table=kv_page_table)
         if cfg.scan_layers and kv_cache is not None:
             # decode over the scanned stack: the per-layer cache slices
             # ride the same lax.scan as the stacked params (in_axes=0
             # over the (iota, cache) pair), and the updated slices come
-            # back as the scan's stacked ys.
-            def body(block, h, xs, det, pos, mask):
+            # back as the scan's stacked ys. The page table (one per
+            # ROW, not per layer) broadcasts like the positions.
+            def body(block, h, xs, det, pos, mask, page_table):
                 idx, layer_cache = xs
                 h, new_c = block(h, det, None, layer_idx=idx,
                                  positions=pos, kv_cache=layer_cache,
                                  attn_impl=attn_impl,
                                  attn_block_k=attn_block_k,
-                                 attn_mesh=attn_mesh, attn_mask=mask)
+                                 attn_mesh=attn_mesh, attn_mask=mask,
+                                 kv_page_table=page_table)
                 return h, new_c
 
             scan = nn.scan(
                 body,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True, "pld": True},
-                in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast),
+                in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
+                         nn.broadcast),
                 length=cfg.n_layer)
             x, new_h = scan(block_cls(cfg, n_layers=cfg.n_layer, name="h"),
                             x, (jnp.arange(cfg.n_layer), kv_cache["h"]),
-                            deterministic, positions, attn_mask)
+                            deterministic, positions, attn_mask,
+                            kv_page_table)
             new_kv = {"h": new_h}
         elif cfg.scan_layers:
             # One lax.scan over layer-stacked params instead of n_layer
@@ -365,7 +375,8 @@ class GPT2LMHead(nn.Module):
                                    attn_impl=attn_impl,
                                    attn_block_k=attn_block_k,
                                    attn_mesh=attn_mesh,
-                                   attn_mask=attn_mask)
+                                   attn_mask=attn_mask,
+                                   kv_page_table=kv_page_table)
         else:
             for i in range(cfg.n_layer):
                 x = block_cls(cfg, layer_idx=i, n_layers=cfg.n_layer,

@@ -30,6 +30,18 @@ FlashDecoding-style fix, specialized for the ring buffer:
   the same kernel over its local heads under ``shard_map`` — the
   block-spec arithmetic never sees the global head count.
 
+- **page-table gathers** (:func:`flash_decode_paged`): the paged pool
+  layout (`inference/cache.py` ``page_size > 0``) feeds the kernel a
+  second scalar-prefetch input — each row's ``[pages_per_row]`` page
+  table — and the KV index map composes the clamp with a table lookup:
+  logical block → clamp to the row's last active block → physical
+  ``(page, intra-page block)``. The clamp runs BEFORE the lookup, so
+  the map only ever dereferences table entries the row has actually
+  filled — dead and unallocated pages never cost a DMA, the paged
+  generalization of the ring kernel's block skipping. KV blocks are
+  cut directly from the 4-D pool (``[1, block_k, 1, D]``), so no
+  pool-sized transpose copy materializes either.
+
 Off-TPU the kernel runs in Pallas interpret mode (CPU test meshes);
 the dense cached-attention path stays available as the parity oracle
 behind ``inference.attention.impl``.
@@ -51,16 +63,25 @@ def _fold_heads(x):
     return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
 
-def _flash_decode_kernel(H, D, block_k, n_kb, quant):
+def _flash_decode_kernel(H, D, block_k, n_kb, quant, paged=False):
     """Kernel factory: one (row*head, kv-block) grid step.
 
     Scalar-prefetch arg 0 is the ``[B]`` positions vector (SMEM);
     scratch carries the online-softmax state (acc [1, D], running max
-    and sum [1, 1]) across the sequential kv-block dim.
+    and sum [1, 1]) across the sequential kv-block dim. The paged
+    variant carries the page tables as a second scalar-prefetch arg —
+    consumed ONLY by the index maps (the body's math is identical; a
+    KV block is a KV block wherever it was fetched from), except that
+    paged blocks arrive in pool layout ``(1, bk, 1, D)`` instead of
+    the folded ``(1, bk, D)``.
     """
 
-    def kernel(pos_ref, q_ref, k_ref, v_ref, *refs):
-        refs = list(refs)
+    def kernel(pos_ref, *all_refs):
+        refs = list(all_refs)
+        if paged:
+            refs.pop(0)                 # page tables: index-map food only
+        q_ref, k_ref, v_ref = refs[:3]
+        refs = refs[3:]
         ks_ref = refs.pop(0) if quant else None
         vs_ref = refs.pop(0) if quant else None
         o_ref, acc_ref, m_ref, l_ref = refs
@@ -83,7 +104,8 @@ def _flash_decode_kernel(H, D, block_k, n_kb, quant):
         @pl.when(run)
         def _compute():
             qb = q_ref[0].astype(jnp.float32)              # [1, D]
-            kb = k_ref[0].astype(jnp.float32)              # [bk, D]
+            kb = (k_ref[0, :, 0, :] if paged
+                  else k_ref[0]).astype(jnp.float32)       # [bk, D]
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)        # [1, bk]
@@ -91,7 +113,8 @@ def _flash_decode_kernel(H, D, block_k, n_kb, quant):
                 # fused dequant: scale the SCORES by the key scales
                 # (dot distributes over the per-position scalar) —
                 # the kb block itself stays in storage dtype.
-                s = s * ks_ref[0][:, 0][None, :]
+                ks = ks_ref[0, :, 0] if paged else ks_ref[0][:, 0]
+                s = s * ks[None, :]
             s = s * (D ** -0.5)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
@@ -104,8 +127,10 @@ def _flash_decode_kernel(H, D, block_k, n_kb, quant):
             m_ref[0, 0] = m_new
             if quant:
                 # value scales fold into the probs the same way
-                pr = pr * vs_ref[0][:, 0][None, :]
-            vb = v_ref[0].astype(jnp.float32)              # [bk, D]
+                vs = vs_ref[0, :, 0] if paged else vs_ref[0][:, 0]
+                pr = pr * vs[None, :]
+            vb = (v_ref[0, :, 0, :] if paged
+                  else v_ref[0]).astype(jnp.float32)       # [bk, D]
             acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
                 pr, vb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -195,4 +220,101 @@ def flash_decode(q, k, v, positions, k_scale=None, v_scale=None,
         out_shape=jax.ShapeDtypeStruct((B * H, 1, D), q.dtype),
         interpret=interpret,
     )(jnp.asarray(positions, jnp.int32), *args)
+    return out.reshape(B, H, 1, D).transpose(0, 2, 1, 3)
+
+
+def flash_decode_paged(q, k, v, positions, page_tables, k_scale=None,
+                       v_scale=None, block_k=DEFAULT_BLOCK_K,
+                       interpret=None):
+    """Split-K flash decode over a paged KV pool.
+
+    ``q``: ``[B, 1, H, D]`` as in :func:`flash_decode`. ``k``/``v``:
+    the POOL buffers ``[n_pages, page_size, H, D]`` in storage dtype
+    (scales ``[n_pages, page_size, H]`` when quantized —
+    `inference/cache.py` paged layout). ``page_tables``: ``[B,
+    pages_per_row]`` int32 physical page ids per row (entry 0 = the
+    trash page for unallocated slots). ``positions``: ``[B]`` int32
+    write positions, same mask contract as the ring kernel.
+
+    Both scalar-prefetch inputs live in SMEM before the grid runs; the
+    KV index map clamps the logical block to the row's last active
+    block FIRST and only then looks up the physical page, so blocks
+    past a row's occupancy re-request the previous physical block
+    (DMA elided) and unallocated table entries are never dereferenced.
+    ``block_k`` clamps to ``page_size`` and must tile it — a KV block
+    never straddles a page boundary, which is what keeps the gather a
+    single block index per grid step.
+    """
+    n_pages, page_size, H, D = k.shape
+    B = q.shape[0]
+    if q.shape != (B, 1, H, D):
+        raise ValueError(
+            f"flash_decode_paged takes one query token per row: q "
+            f"shape {q.shape} != {(B, 1, H, D)}")
+    if page_tables.shape[0] != B:
+        raise ValueError(
+            f"page_tables rows {page_tables.shape[0]} != batch {B}")
+    n_pt = page_tables.shape[1]
+    S = n_pt * page_size
+    block_k = min(int(block_k), page_size)
+    if page_size % block_k:
+        raise ValueError(
+            f"page_size {page_size} must be a multiple of attention "
+            f"block_k {block_k}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    quant = k_scale is not None
+    n_kb = S // block_k
+    bpp = page_size // block_k          # kv-blocks per page
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    qh = q.transpose(0, 2, 1, 3).reshape(B * H, 1, D)
+
+    def q_map(bh, ki, pos_ref, pt_ref):
+        return (bh, 0, 0)
+
+    def _physical(bh, ki, pos_ref, pt_ref):
+        # clamp BEFORE the table lookup: the map only dereferences
+        # entries covering positions the row has written.
+        kc = jnp.minimum(ki, pos_ref[bh // H] // block_k)
+        return pt_ref[bh // H, kc // bpp], kc % bpp
+
+    def kv_map(bh, ki, pos_ref, pt_ref):
+        page, intra = _physical(bh, ki, pos_ref, pt_ref)
+        return (page, intra, bh % H, 0)
+
+    def sc_map(bh, ki, pos_ref, pt_ref):
+        page, intra = _physical(bh, ki, pos_ref, pt_ref)
+        return (page, intra, bh % H)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, D), q_map),
+        pl.BlockSpec((1, block_k, 1, D), kv_map),
+        pl.BlockSpec((1, block_k, 1, D), kv_map),
+    ]
+    args = [qh, k, v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, block_k, 1), sc_map),
+                     pl.BlockSpec((1, block_k, 1), sc_map)]
+        args += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * H, n_kb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((1, D), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        _flash_decode_kernel(H, D, block_k, n_kb, quant, paged=True),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, 1, D), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(positions, jnp.int32),
+      jnp.asarray(page_tables, jnp.int32), *args)
     return out.reshape(B, H, 1, D).transpose(0, 2, 1, 3)

@@ -650,7 +650,9 @@ class InferenceConfig:
             INFERENCE_PREFILL_CHUNK, INFERENCE_KV_CACHE_DTYPE,
             INFERENCE_MAX_NEW_TOKENS, INFERENCE_ATTENTION_IMPL,
             INFERENCE_ATTENTION_BLOCK_K, INFERENCE_TEMPERATURE,
-            INFERENCE_TOP_K, INFERENCE_TOP_P, INFERENCE_SAMPLING_SEED)
+            INFERENCE_TOP_K, INFERENCE_TOP_P, INFERENCE_SAMPLING_SEED,
+            INFERENCE_KV_LAYOUT, INFERENCE_PAGE_SIZE, INFERENCE_N_PAGES,
+            INFERENCE_PREFIX_CACHE, INFERENCE_HOST_PARK_THRESHOLD)
 
     def __init__(self, param_dict):
         sub = param_dict.get(INFERENCE, {}) or {}
@@ -679,6 +681,17 @@ class InferenceConfig:
                                       INFERENCE_TOP_P_DEFAULT)
         self.sampling_seed = get_scalar_param(
             sub, INFERENCE_SAMPLING_SEED, INFERENCE_SAMPLING_SEED_DEFAULT)
+        self.kv_layout = get_scalar_param(
+            sub, INFERENCE_KV_LAYOUT, INFERENCE_KV_LAYOUT_DEFAULT)
+        self.page_size = get_scalar_param(
+            sub, INFERENCE_PAGE_SIZE, INFERENCE_PAGE_SIZE_DEFAULT)
+        self.n_pages = get_scalar_param(
+            sub, INFERENCE_N_PAGES, INFERENCE_N_PAGES_DEFAULT)
+        self.prefix_cache = get_scalar_param(
+            sub, INFERENCE_PREFIX_CACHE, INFERENCE_PREFIX_CACHE_DEFAULT)
+        self.host_park_threshold = get_scalar_param(
+            sub, INFERENCE_HOST_PARK_THRESHOLD,
+            INFERENCE_HOST_PARK_THRESHOLD_DEFAULT)
 
     def __repr__(self):
         return (f"InferenceConfig(max_batch={self.max_batch}, "
@@ -690,7 +703,11 @@ class InferenceConfig:
                 f"attention_block_k={self.attention_block_k}, "
                 f"temperature={self.temperature}, top_k={self.top_k}, "
                 f"top_p={self.top_p}, "
-                f"sampling_seed={self.sampling_seed})")
+                f"sampling_seed={self.sampling_seed}, "
+                f"kv_layout={self.kv_layout!r}, "
+                f"page_size={self.page_size}, n_pages={self.n_pages}, "
+                f"prefix_cache={self.prefix_cache}, "
+                f"host_park_threshold={self.host_park_threshold})")
 
 
 class DeepSpeedConfig:
@@ -1050,6 +1067,43 @@ class DeepSpeedConfig:
         if isinstance(seed, bool) or not isinstance(seed, int):
             raise ValueError(
                 f"inference: sampling_seed must be an int, got {seed!r}")
+        if inf.kv_layout not in ("ring", "paged"):
+            raise ValueError(
+                f"inference: kv_layout must be 'ring' or 'paged', "
+                f"got {inf.kv_layout!r}")
+        ps = inf.page_size
+        if isinstance(ps, bool) or not isinstance(ps, int) or ps < 0:
+            raise ValueError(
+                f"inference: page_size must be an int >= 0 (0 = auto), "
+                f"got {ps!r}")
+        if inf.kv_layout == "paged" and ps:
+            if ps % pc:
+                raise ValueError(
+                    f"inference: page_size must be a multiple of "
+                    f"prefill_chunk={pc}; got {ps}")
+            if max(buckets) % ps:
+                raise ValueError(
+                    f"inference: page_size must divide the largest seq "
+                    f"bucket {max(buckets)}; got {ps}")
+        npg = inf.n_pages
+        if isinstance(npg, bool) or not isinstance(npg, int) or npg < 0:
+            raise ValueError(
+                f"inference: n_pages must be an int >= 0 (0 = auto), "
+                f"got {npg!r}")
+        if npg == 1:
+            raise ValueError(
+                "inference: n_pages must be >= 2 when set (page 0 is "
+                "the reserved trash page); got 1")
+        if not isinstance(inf.prefix_cache, bool):
+            raise ValueError(
+                f"inference: prefix_cache must be a bool, "
+                f"got {inf.prefix_cache!r}")
+        hp = inf.host_park_threshold
+        if isinstance(hp, bool) or not isinstance(hp, (int, float)) \
+                or not 0 <= hp < 1:
+            raise ValueError(
+                f"inference: host_park_threshold must be in [0, 1), "
+                f"got {hp!r}")
 
     def _check_fp8(self):
         from deepspeed_tpu.runtime.comm.codecs import CODECS

@@ -227,6 +227,41 @@ def _summarize_serve(decode):
            if e.get("occupancy") is not None]
     qd = [float(e["queue_depth"]) for e in decode
           if e.get("queue_depth") is not None]
+    # Paged-KV extras: a paged scheduler stamps each decode_step with
+    # the allocator census and the cumulative radix counters, so the
+    # last event carries the final tallies and the per-step series
+    # gives resident cache bytes per live session (the paged win: only
+    # occupied pages count, not max_seq rows).
+    pg_events = [e for e in decode if e.get("pages_resident") is not None]
+    paging = None
+    if pg_events:
+        last = pg_events[-1]
+        hits = int(last.get("prefix_hits") or 0)
+        misses = int(last.get("prefix_misses") or 0)
+        # free + resident excludes the reserved trash page 0
+        n_pages = int(last["pages_free"]) + \
+            int(last["pages_resident"]) + 1
+        page_bytes = float(last.get("cache_bytes") or 0) / max(n_pages, 1)
+        per_sess = [int(e["pages_resident"]) * page_bytes
+                    / int(e.get("batch") or 1)
+                    for e in pg_events if int(e.get("batch") or 0)]
+        paging = {
+            "pages": {"free": int(last["pages_free"]),
+                      "resident": int(last["pages_resident"]),
+                      "total": n_pages},
+            "prefix": {"hits": hits, "misses": misses,
+                       "hit_rate": hits / (hits + misses)
+                       if (hits + misses) else None},
+            "sessions_admitted": int(last.get("sessions_admitted") or 0),
+            "sessions_parked_host": int(
+                last.get("sessions_parked_host") or 0),
+            "cache_bytes_total": int(last.get("cache_bytes") or 0),
+            "cache_bytes_per_session": {
+                "mean": (sum(per_sess) / len(per_sess))
+                if per_sess else None,
+                "max": max(per_sess) if per_sess else None,
+            },
+        }
     return {
         "schema": SCHEMA_VERSION,
         "mode": "serve",
@@ -258,6 +293,7 @@ def _summarize_serve(decode):
             "mean": (sum(qd) / len(qd)) if qd else None,
             "max": max(qd) if qd else None,
         },
+        "paging": paging,
         "mfu": None,
     }
 
@@ -291,6 +327,22 @@ def print_serve_summary(s, out=None):
     if qd["mean"] is not None:
         print(f"  queue depth mean {qd['mean']:.2f}, max {qd['max']:.0f}",
               file=out)
+    pg = s.get("paging")
+    if pg:
+        cps = pg["cache_bytes_per_session"]
+        mean_kb = (f"{cps['mean'] / 1024:.1f}KB"
+                   if cps["mean"] is not None else "-")
+        print(f"  paged KV: {pg['pages']['resident']}/"
+              f"{pg['pages']['total']} pages resident, cache "
+              f"{mean_kb}/session (pool "
+              f"{pg['cache_bytes_total'] / 1024:.0f}KB)", file=out)
+        pf = pg["prefix"]
+        rate = (f"{pf['hit_rate'] * 100:.0f}%"
+                if pf["hit_rate"] is not None else "-")
+        print(f"  prefix cache: {pf['hits']} hits / {pf['misses']} "
+              f"misses (hit rate {rate}), sessions admitted "
+              f"{pg['sessions_admitted']}, parked to host "
+              f"{pg['sessions_parked_host']}", file=out)
 
 
 def print_summary(s, out=None):

@@ -194,3 +194,33 @@ class TestAuditDecodeEndToEnd:
         assert payload_shaped_values(dense.hlo_text, "f32", _PAYLOAD) > 0
         assert payload_shaped_dots(flash.hlo_text, _PAYLOAD) == []
         assert payload_shaped_values(flash.hlo_text, "f32", _PAYLOAD) == 0
+
+
+class TestAuditDecodePaged:
+    """The paged-layout acceptance pin: audit_decode's paged stream
+    exercises the whole admission ladder (radix hits, a parked session
+    evacuated to host RAM, a resume that pages it back in) and the
+    full rule catalog must still come back empty on the post-churn
+    decode HLO — page tables are data, parking is host-side, the two
+    compiled programs never change."""
+
+    def test_zero_findings_paged_with_churn(self):
+        report = audit_decode(kv_layout="paged")
+        assert report.findings == []
+        assert report.stats["compile_counts"] == \
+            {"prefill": 1, "decode": 1}
+        assert report.stats["cache"]["kv_layout"] == "paged"
+        pg = report.stats["paging"]
+        assert pg["prefix_hits"] >= 1            # shared-prefix stream
+        assert pg["sessions_resumed"] >= 1       # parked -> followed up
+        assert pg["pages_evacuated"] >= 1        # host tier engaged
+        assert pg["pages_paged_in"] >= 1
+        assert pg["pages_free"] + pg["pages_resident"] == \
+            pg["n_pages"] - 1                    # trash page accounting
+
+    @pytest.mark.slow
+    def test_zero_findings_paged_quantized(self):
+        report = audit_decode(kv_cache_dtype="int8", kv_layout="paged")
+        assert report.findings == []
+        assert report.stats["cache"]["dtype_census"] == {"int8": 4}
+        assert report.stats["paging"]["prefix_hits"] >= 1

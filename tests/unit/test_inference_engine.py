@@ -46,6 +46,9 @@ class StubEngine:
         logits[7] = 1.0
         return logits
 
+    def sample_first(self, last_logits):
+        return int(np.argmax(last_logits))
+
     def decode(self, tokens, positions):
         self.decodes += 1
         nxt = (np.asarray(positions) + 1).astype(np.int32)

@@ -1,0 +1,437 @@
+"""Paged KV cache host machinery (`deepspeed_tpu/inference/paging.py`
++ the paged branches of `inference/cache.py` and `analysis/rules.py`).
+
+Everything here is admission-time metadata, so most of the file is
+pure-python over a duck-typed engine stub: the allocator's free-list /
+refcount discipline (page 0 is the reserved trash page and is never
+handed out), the radix tree's whole-page prefix matching with LRU leaf
+eviction, the host store's CRC-stamped park/take round trip, and the
+:class:`PagedCacheManager` admission ladder — prefix hits map shared
+pages copy-on-write and resume prefill mid-prompt, parked sessions
+evacuate to host RAM under pressure and page back in on resume, and a
+dry pool makes ``admit`` return None without leaking references.
+
+The jax end pins the paged pool's static geometry
+(`cache.spec_for_model`: trash-page minimum, divisibility, ring-
+capacity default) and the `rule_decode` paged contract (host-transfer
+ops and degenerate page geometry are errors). Numerics ride
+`test_paged_parity.py`.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.paging import (
+    TRASH_PAGE,
+    HostPageStore,
+    PageAllocator,
+    PagedCacheManager,
+    RadixPrefixCache,
+)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+class TestPageAllocator:
+    def test_trash_page_requires_two(self):
+        with pytest.raises(ValueError, match="n_pages must be >= 2"):
+            PageAllocator(1)
+
+    def test_alloc_never_hands_out_trash(self):
+        alloc = PageAllocator(5)
+        pages = [alloc.alloc() for _ in range(4)]
+        assert TRASH_PAGE not in pages
+        assert sorted(pages) == [1, 2, 3, 4]
+
+    def test_exhaustion_returns_none(self):
+        alloc = PageAllocator(3)
+        assert alloc.alloc() is not None
+        assert alloc.alloc() is not None
+        assert alloc.alloc() is None
+        assert alloc.free_pages == 0
+        assert alloc.resident_pages == 2
+
+    def test_free_list_is_lifo(self):
+        # recently freed pages are re-used first (hot working set)
+        alloc = PageAllocator(4)
+        a, b = alloc.alloc(), alloc.alloc()
+        alloc.decref(b)
+        assert alloc.alloc() == b
+        alloc.decref(a)
+        assert alloc.alloc() == a
+
+    def test_refcounts_share_and_release(self):
+        alloc = PageAllocator(3)
+        p = alloc.alloc()
+        alloc.incref(p)
+        assert alloc.refcount(p) == 2
+        alloc.decref(p)
+        assert alloc.free_pages == 1      # still held by one ref
+        alloc.decref(p)
+        assert alloc.free_pages == 2
+        assert alloc.resident_pages == 0
+
+    def test_ref_misuse_raises(self):
+        alloc = PageAllocator(3)
+        p = alloc.alloc()
+        with pytest.raises(ValueError, match="trash page"):
+            alloc.incref(TRASH_PAGE)
+        with pytest.raises(ValueError, match="incref on free page"):
+            alloc.incref(p + 1 if p + 1 < 3 else p - 1)
+        alloc.decref(p)
+        with pytest.raises(ValueError, match="decref on free page"):
+            alloc.decref(p)
+
+
+# ---------------------------------------------------------------------------
+# radix prefix cache
+# ---------------------------------------------------------------------------
+
+def _radix(n_pages=8, page_size=4):
+    alloc = PageAllocator(n_pages)
+    return alloc, RadixPrefixCache(alloc, page_size)
+
+
+class TestRadixPrefixCache:
+    def test_miss_then_hit(self):
+        alloc, radix = _radix()
+        prompt = list(range(10))               # 2 full pages + tail
+        assert radix.match(prompt) == []
+        assert (radix.hits, radix.misses) == (0, 1)
+
+        pages = [alloc.alloc(), alloc.alloc()]
+        radix.insert(prompt, pages)
+        assert len(radix) == 2
+        assert radix.match(prompt) == pages
+        assert (radix.hits, radix.misses) == (1, 1)
+        # interned nodes hold their own reference per page
+        assert all(alloc.refcount(p) == 2 for p in pages)
+
+    def test_match_is_longest_interned_prefix(self):
+        alloc, radix = _radix()
+        prompt = list(range(8))
+        pages = [alloc.alloc(), alloc.alloc()]
+        radix.insert(prompt, pages)
+        # same first page, divergent second page -> one-page match
+        other = prompt[:4] + [99, 98, 97, 96]
+        assert radix.match(other) == pages[:1]
+        # sub-page prompts never match (whole-page sharing only)
+        assert radix.match(prompt[:3]) == []
+
+    def test_reinsert_is_idempotent(self):
+        alloc, radix = _radix()
+        prompt = list(range(8))
+        pages = [alloc.alloc(), alloc.alloc()]
+        radix.insert(prompt, pages)
+        radix.insert(prompt, pages)            # same tokens, same KV
+        assert len(radix) == 2
+        assert all(alloc.refcount(p) == 2 for p in pages)
+
+    def test_evict_one_drops_lru_leaf_first(self):
+        alloc, radix = _radix()
+        a = list(range(8))
+        b = a[:4] + [50, 51, 52, 53]
+        pa = [alloc.alloc(), alloc.alloc()]
+        radix.insert(a, pa)
+        pb_tail = alloc.alloc()
+        radix.insert(b, [pa[0], pb_tail])
+        radix.match(a)                         # a's leaf is now MRU
+        for p in pa + [pb_tail]:
+            alloc.decref(p)                    # rows released; radix holds
+
+        assert radix.evict_one()               # b's tail: the LRU leaf
+        assert len(radix) == 2
+        assert alloc.refcount(pb_tail) == 0
+        # the shared interior node anchors its live descendant
+        assert radix.match(a) == pa
+        assert radix.evict_one() and radix.evict_one()
+        assert not radix.evict_one()           # tree empty
+        assert alloc.resident_pages == 0
+
+
+# ---------------------------------------------------------------------------
+# host page store
+# ---------------------------------------------------------------------------
+
+class TestHostPageStore:
+    def test_park_take_round_trip(self):
+        store = HostPageStore()
+        tree = {"k": np.arange(12, dtype=np.float32).reshape(3, 4),
+                "v": np.ones((3, 4), np.float32)}
+        store.park("s0", tree)
+        assert "s0" in store and len(store) == 1
+        assert store.nbytes == 2 * 3 * 4 * 4
+        out = store.take("s0")
+        np.testing.assert_array_equal(out["k"], tree["k"])
+        assert "s0" not in store and store.nbytes == 0
+
+    def test_corruption_is_detected(self):
+        store = HostPageStore()
+        tree = {"k": np.zeros((2, 2), np.float32)}
+        store.park("s0", tree)
+        tree["k"][0, 0] = 7.0                  # rot the parked snapshot
+        with pytest.raises(RuntimeError, match="CRC mismatch"):
+            store.take("s0")
+
+    def test_drop_is_idempotent(self):
+        store = HostPageStore()
+        store.park("s0", {"k": np.zeros(2, np.float32)})
+        store.drop("s0")
+        store.drop("s0")
+        assert len(store) == 0
+
+
+# ---------------------------------------------------------------------------
+# paged cache manager (admission / COW / park / resume ladder)
+# ---------------------------------------------------------------------------
+
+class _PoolEngine:
+    """Duck-typed engine stub: the manager only reads geometry facts,
+    moves pages through gather/scatter, and checks the park threshold —
+    none of which needs a compiled program."""
+
+    kv_layout = "paged"
+
+    def __init__(self, n_pages=6, page_size=4, pages_per_row=4,
+                 prefill_chunk=4, prefix_cache=True,
+                 host_park_threshold=0.0):
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.pages_per_row = pages_per_row
+        self.prefill_chunk = prefill_chunk
+        self.prefix_cache = prefix_cache
+        self.host_park_threshold = host_park_threshold
+        rng = np.random.default_rng(0)
+        self.cache = {"k": rng.standard_normal(
+            (n_pages, page_size, 2, 2)).astype(np.float32)}
+
+    def gather_pages(self, page_ids):
+        return {"k": self.cache["k"][np.asarray(page_ids)].copy()}
+
+    def scatter_pages(self, page_ids, host_pages):
+        self.cache["k"][np.asarray(page_ids)] = host_pages["k"]
+
+
+def _mgr(**kw):
+    eng = _PoolEngine(**kw)
+    return eng, PagedCacheManager(eng)
+
+
+class TestPagedCacheManager:
+    def test_cold_admit_allocates_ceil_pages(self):
+        _, mgr = _mgr()
+        row = mgr.admit(list(range(10)))       # ceil(10/4) = 3 pages
+        assert len(row.pages) == 3
+        assert row.start == 0 and not row.prefix_hit
+        assert row.prefill_chunks == 3 and row.prefill_chunks_skipped == 0
+        assert mgr.prefix_misses == 1
+        assert mgr.facts()["pages_resident"] == 3
+
+    def test_prefix_hit_shares_pages_and_skips_chunks(self):
+        _, mgr = _mgr()
+        prompt = list(range(8))
+        first = mgr.admit(prompt)
+        mgr.after_prefill(first, prompt)
+
+        again = mgr.admit(prompt)
+        # the LAST prompt token always prefills: m = (8-1)//4 = 1 even
+        # though both pages are interned
+        assert again.prefix_hit and again.start == 4
+        assert again.pages[0] == first.pages[0]
+        assert again.pages[1] != first.pages[1]     # private tail page
+        assert again.prefill_chunks == 1
+        assert again.prefill_chunks_skipped == 1
+        assert (mgr.prefix_hits, mgr.prefix_misses) == (1, 1)
+        # shared page: first row + radix + second row
+        assert mgr.allocator.refcount(first.pages[0]) == 3
+
+    def test_cow_divergence_allocates_private_pages(self):
+        _, mgr = _mgr(n_pages=8)
+        a = list(range(8))
+        ra = mgr.admit(a)
+        mgr.after_prefill(ra, a)
+        b = a[:4] + [60, 61, 62, 63]
+        rb = mgr.admit(b)
+        assert rb.prefix_hit and rb.start == 4
+        assert rb.pages[0] == ra.pages[0]
+        # divergence past the shared span writes a PRIVATE page — the
+        # shared page is never copied and never written
+        assert rb.pages[1] != ra.pages[1]
+        assert len({ra.pages[1], rb.pages[1]}) == 2
+
+    def test_dry_pool_defers_without_leaking(self):
+        _, mgr = _mgr(n_pages=4)               # 3 usable pages
+        live = mgr.admit(list(range(8)))       # takes 2, still mapped
+        assert live is not None
+        free_before = mgr.allocator.free_pages
+        assert mgr.admit(list(range(100, 108))) is None
+        assert mgr.allocator.free_pages == free_before
+
+    def test_pressure_evicts_radix_leaves(self):
+        _, mgr = _mgr(n_pages=4)
+        prompt = list(range(8))
+        row = mgr.admit(prompt)
+        mgr.after_prefill(row, prompt)
+        mgr.release(row)                       # only radix refs remain
+        assert mgr.facts()["radix_nodes"] == 2
+        # a non-matching prompt needs 3 pages; only 1 is free, so the
+        # ladder must evict interned leaves to satisfy it
+        row2 = mgr.admit(list(range(50, 60)))
+        assert row2 is not None and len(row2.pages) == 3
+        assert mgr.facts()["radix_nodes"] < 2
+
+    def test_ensure_position_grows_and_caps(self):
+        _, mgr = _mgr(n_pages=6, pages_per_row=2)
+        row = mgr.admit([1, 2, 3])             # 1 page
+        assert mgr.ensure_position(row, 3) is True       # same page
+        assert mgr.ensure_position(row, 4) is True       # grows
+        assert len(row.pages) == 2
+        assert mgr.ensure_position(row, 8) is False      # table full
+
+    def test_session_park_and_resume_skips_history(self):
+        _, mgr = _mgr(n_pages=8)
+        prompt = list(range(8))
+        row = mgr.admit(prompt, session_id="s")
+        kv_tokens = prompt + [9]               # one generated token's KV
+        mgr.release(row, kv_tokens=kv_tokens, session_id="s")
+        assert mgr.facts()["sessions_parked_device"] == 1
+
+        follow = prompt + [9, 10, 11]          # extends the history
+        r2 = mgr.admit(follow, session_id="s")
+        assert r2.resumed and not r2.prefix_hit
+        # frontier 8 covers pages 0-1; prefill restarts at its chunk
+        # floor and only runs the tail
+        assert r2.start == 8
+        assert r2.prefill_chunks_skipped == 2
+        assert mgr.sessions_resumed == 1
+
+    def test_resume_requires_prompt_extension(self):
+        _, mgr = _mgr(n_pages=8)
+        prompt = list(range(8))
+        row = mgr.admit(prompt, session_id="s")
+        mgr.release(row, kv_tokens=prompt + [9], session_id="s")
+        # a DIFFERENT prompt on the session must not reuse its KV
+        r2 = mgr.admit(list(range(40, 48)), session_id="s")
+        assert not r2.resumed and r2.start == 0
+
+    def test_host_tier_round_trip_preserves_pool_bytes(self):
+        eng, mgr = _mgr(n_pages=8, host_park_threshold=0.9)
+        prompt = list(range(8))
+        row = mgr.admit(prompt, session_id="s")
+        pages = list(row.pages)
+        want = eng.cache["k"][np.asarray(pages)].copy()
+        # threshold 0.9 > free fraction: release evacuates straight to
+        # the host tier and frees the device pages
+        mgr.release(row, kv_tokens=prompt, session_id="s")
+        facts = mgr.facts()
+        assert facts["sessions_parked_host"] == 1
+        assert facts["sessions_parked_device"] == 0
+        assert facts["pages_evacuated"] == 2
+        assert facts["host_tier_bytes"] > 0
+        eng.cache["k"][np.asarray(pages)] = 0.0    # pages recycled
+
+        r2 = mgr.admit(prompt + [9], session_id="s")
+        assert r2.resumed
+        assert mgr.facts()["pages_paged_in"] == 2
+        got = eng.cache["k"][np.asarray(r2.pages[:2])]
+        np.testing.assert_array_equal(got, want)
+
+    def test_facts_account_for_trash_page(self):
+        _, mgr = _mgr(n_pages=6)
+        f = mgr.facts()
+        assert f["pages_free"] + f["pages_resident"] == f["n_pages"] - 1
+        assert f["page_bytes"] * f["n_pages"] == \
+            _PoolEngine(n_pages=6).cache["k"].nbytes
+
+
+# ---------------------------------------------------------------------------
+# static pool geometry (spec_for_model)
+# ---------------------------------------------------------------------------
+
+class TestPagedSpec:
+    def _cfg(self):
+        import jax.numpy as jnp
+        from deepspeed_tpu.models.gpt2 import GPT2Config
+        return GPT2Config(vocab_size=64, n_positions=64, n_embd=32,
+                          n_layer=2, n_head=4, dtype=jnp.float32)
+
+    def test_ring_capacity_default(self):
+        from deepspeed_tpu.inference.cache import spec_for_model
+        spec = spec_for_model(self._cfg(), 2, 32, page_size=8)
+        assert spec.paged
+        assert spec.pages_per_row == 4
+        assert spec.n_pages == 2 * 4 + 1       # + the trash page
+
+    def test_page_size_must_divide_max_seq(self):
+        from deepspeed_tpu.inference.cache import spec_for_model
+        with pytest.raises(ValueError, match="must divide max_seq"):
+            spec_for_model(self._cfg(), 2, 32, page_size=12)
+
+    def test_n_pages_floor_guards_trash_page(self):
+        from deepspeed_tpu.inference.cache import spec_for_model
+        with pytest.raises(ValueError, match="n_pages must be >= 2"):
+            spec_for_model(self._cfg(), 2, 32, page_size=8, n_pages=1)
+
+    def test_pool_shape_and_quantized_scales(self):
+        from deepspeed_tpu.inference.cache import (init_kv_cache,
+                                                   spec_for_model)
+        spec = spec_for_model(self._cfg(), 2, 32, "int8", page_size=8)
+        cache = init_kv_cache(spec)
+        assert cache["h_0"]["k"].shape == (9, 8, 4, 8)
+        assert cache["h_0"]["k_scale"].shape == (9, 8, 4)
+
+
+# ---------------------------------------------------------------------------
+# rule_decode paged contract (seeded violations)
+# ---------------------------------------------------------------------------
+
+_PAGE_FACTS = {"page_size": 8, "n_pages": 9, "pages_per_row": 4,
+               "max_seq": 32}
+
+
+class TestRuleDecodePaged:
+    def test_clean_paged_context_passes(self):
+        from deepspeed_tpu.analysis.rules import StepContext, rule_decode
+        ctx = StepContext(hlo_text="", decode_kv_layout="paged",
+                          decode_page_facts=dict(_PAGE_FACTS))
+        assert rule_decode(ctx) == []
+
+    def test_host_transfer_in_paged_decode_is_error(self):
+        from deepspeed_tpu.analysis.rules import (SEV_ERROR, StepContext,
+                                                  rule_decode)
+        hlo = ("%of = token[] outfeed(f32[2,8]{1,0} %pages, "
+               "token[] %tok)")
+        ctx = StepContext(hlo_text=hlo, decode_kv_layout="paged",
+                          decode_page_facts=dict(_PAGE_FACTS))
+        findings = rule_decode(ctx)
+        assert [f.severity for f in findings] == [SEV_ERROR]
+        assert "host transfer" in findings[0].message
+
+    def test_degenerate_geometry_is_error(self):
+        from deepspeed_tpu.analysis.rules import (SEV_ERROR, StepContext,
+                                                  rule_decode)
+        ctx = StepContext(
+            hlo_text="", decode_kv_layout="paged",
+            decode_page_facts={"page_size": 0, "n_pages": 1,
+                               "pages_per_row": 0, "max_seq": 32})
+        findings = rule_decode(ctx)
+        assert [f.severity for f in findings] == [SEV_ERROR]
+        assert "degenerate" in findings[0].message
+
+    def test_table_must_cover_max_seq(self):
+        from deepspeed_tpu.analysis.rules import (SEV_ERROR, StepContext,
+                                                  rule_decode)
+        bad = dict(_PAGE_FACTS, pages_per_row=3)   # 3*8 != 32
+        ctx = StepContext(hlo_text="", decode_kv_layout="paged",
+                          decode_page_facts=bad)
+        findings = rule_decode(ctx)
+        assert [f.severity for f in findings] == [SEV_ERROR]
+        assert "trash page" in findings[0].message
+
+    def test_ring_layout_ignores_page_facts(self):
+        from deepspeed_tpu.analysis.rules import StepContext, rule_decode
+        ctx = StepContext(hlo_text="%of = token[] outfeed(f32[2] %x)",
+                          decode_kv_layout="ring")
+        assert rule_decode(ctx) == []

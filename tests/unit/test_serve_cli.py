@@ -154,3 +154,84 @@ def test_greedy_serve_is_sampling_invariant(tmp_path, capsys):
         assert result["sampling"]["seed"] == sampling_seed
         streams.append([c["tokens"] for c in result["completions"]])
     assert streams[0] == streams[1]
+
+
+# ---------------------------------------------------------------------------
+# paged KV layout (--kv-layout paged)
+# ---------------------------------------------------------------------------
+
+class TestPagedUsageErrors:
+    def test_expect_prefix_hits_requires_paged(self):
+        with pytest.raises(SystemExit) as e:
+            main(["--synthetic", "2", "--expect-prefix-hits", "1"])
+        assert e.value.code == 2
+
+
+def test_paged_prefix_sharing_end_to_end(tmp_path, capsys):
+    """The CI paged smoke, in-process: a shared system prompt makes the
+    radix cache hit, the hits gate and the 2-compile gate both hold,
+    and the telemetry log summarizes with the paging block."""
+    log = tmp_path / "paged.jsonl"
+    rc = main(["--synthetic", "6", "--max-new", "4",
+               "--arrival-every", "1",
+               "--kv-layout", "paged", "--shared-prefix", "12",
+               "--expect-compiles", "2", "--expect-prefix-hits", "1",
+               "--jsonl", str(log), "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["ok"] is True
+    assert result["compile_counts"] == {"prefill": 1, "decode": 1}
+    pg = result["paging"]
+    assert pg["prefix_hits"] >= 1
+    assert pg["pages_free"] + pg["pages_resident"] == pg["n_pages"] - 1
+    assert any(c["prefix_hit"] for c in result["completions"])
+    # prefix hits translate into skipped prefill chunks, never fewer
+    # generated tokens
+    assert sum(c["prefill_chunks_skipped"]
+               for c in result["completions"]) >= 1
+    assert all(c["tokens"] for c in result["completions"])
+
+    s = summarize(read_events(str(log)))
+    assert s["mode"] == "serve"
+    assert s["paging"]["prefix"]["hits"] >= 1
+    assert s["paging"]["pages"]["total"] == pg["n_pages"]
+    assert s["paging"]["cache_bytes_total"] > 0
+
+
+def test_expect_prefix_hits_violation_exits_nonzero(capsys):
+    # no shared prefix -> no hits -> the gate must trip
+    rc = main(["--synthetic", "2", "--max-new", "2",
+               "--kv-layout", "paged", "--no-prefix-cache",
+               "--expect-prefix-hits", "1"])
+    assert rc == 1
+    captured = capsys.readouterr()
+    assert "FAIL" in captured.err
+    assert "prefix hits" in captured.err
+
+
+def test_paged_config_file_with_sessions(tmp_path, capsys):
+    """kv_layout + page knobs flow through --config, and session_id
+    rides the request JSONL into parked sessions."""
+    cfg = tmp_path / "ds_config.json"
+    cfg.write_text(json.dumps({
+        "train_batch_size": 1,
+        "train_micro_batch_size_per_gpu": 1,
+        "inference": {"max_batch": 2, "seq_buckets": [16, 32],
+                      "prefill_chunk": 4, "max_new_tokens": 3,
+                      "kv_layout": "paged", "page_size": 8}}))
+    reqs = tmp_path / "stream.jsonl"
+    reqs.write_text("\n".join([
+        json.dumps({"rid": "a", "prompt": [1, 2, 3, 4, 5],
+                    "max_new_tokens": 3, "session_id": "chat-1"}),
+        json.dumps({"rid": "b", "prompt": [9, 8, 7],
+                    "max_new_tokens": 2}),
+    ]) + "\n")
+    rc = main(["--config", str(cfg), "--requests", str(reqs), "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["ok"] is True
+    assert result["paging"]["page_size"] == 8
+    # "a" carried a session_id: its pages parked instead of freeing
+    parked = result["paging"]["sessions_parked_device"] + \
+        result["paging"]["sessions_parked_host"]
+    assert parked == 1

@@ -20,11 +20,14 @@ import pytest
 from deepspeed_tpu.analysis.tune import (
     REJECT_BUILD_ERROR,
     REJECT_PEAK_MEMORY,
+    SERVING_DIMENSION_NAMES,
     Choice,
     deep_merge,
     default_dimensions,
     evaluate_candidate,
+    evaluate_serving_candidate,
     expected_events,
+    serving_dimensions,
     tune,
     write_expected_log,
 )
@@ -153,3 +156,44 @@ def test_peak_budget_rejection_is_typed():
     assert res.reject_reason == REJECT_PEAK_MEMORY
     assert "budget" in res.reject_detail
     assert math.isinf(res.score)
+
+
+# ---------------------------------------------------------------------------
+# --serving: paged-KV serving knobs
+# ---------------------------------------------------------------------------
+
+def test_serving_dimensions_respect_engine_geometry():
+    dims = dict(serving_dimensions(
+        {"inference": {"prefill_chunk": 4, "seq_buckets": [16, 32]}}))
+    assert set(dims) == set(SERVING_DIMENSION_NAMES)
+    # page sizes are prefill-chunk multiples capped at the largest
+    # bucket; park sweeps the host evacuation threshold
+    assert [c.label for c in dims["page"]] == ["page4", "page8", "page16"]
+    assert [c.label for c in dims["park"]] == ["park0", "park25", "park50"]
+    big_chunk = dict(serving_dimensions(
+        {"inference": {"prefill_chunk": 16, "seq_buckets": [16]}}))
+    assert [c.label for c in big_chunk["page"]] == ["page16"]
+
+
+def test_serving_contract_breaker_is_typed_rejection():
+    """page_size 12 can't divide max_seq 32: the engine refuses to
+    build and the tuner reports the typed rejection instead of scoring
+    (or silently skipping) the point."""
+    res = evaluate_serving_candidate(
+        {"inference": {"page_size": 12}}, label="page12",
+        dimension="page")
+    assert res.reject_reason == REJECT_BUILD_ERROR
+    assert "page_size" in res.reject_detail
+    assert math.isinf(res.score)
+
+
+@pytest.mark.slow
+def test_serving_candidate_scores_through_the_paged_audit():
+    res = evaluate_serving_candidate(
+        {"inference": {"page_size": 8}}, label="page8",
+        dimension="page")
+    assert res.reject_reason is None
+    assert res.findings == 0
+    assert res.tokens > 0                     # max_batch tokens / step
+    assert math.isfinite(res.score)
+    assert res.cost.step_seconds > 0
