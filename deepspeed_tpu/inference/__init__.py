@@ -17,7 +17,13 @@ Pieces:
   Megatron PartitionSpecs.
 - :mod:`.scheduler` — continuous batching: admit/evict/pad loop over an
   open-loop request queue, emitting ``decode_step`` telemetry events.
-- :mod:`.serve` — the ``ds_tpu_serve`` CLI.
+- :mod:`.router` / :mod:`.fleet` — multi-replica serving: an admission
+  router owning the global queue in front of N replicas (subprocess
+  workers under the ``ds_tpu_run`` env contract, or in-process threads
+  for tests), with heartbeat health checks, dead-replica drain and
+  redispatch, deadlines, and backpressure (docs/inference.md).
+- :mod:`.serve` — the ``ds_tpu_serve`` CLI (``--replicas N`` for fleet
+  mode).
 """
 
 from deepspeed_tpu.inference.cache import (
@@ -28,6 +34,17 @@ from deepspeed_tpu.inference.cache import (
     spec_for_model,
 )
 from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.fleet import (
+    ProcessReplica,
+    ThreadReplica,
+    build_process_fleet,
+)
+from deepspeed_tpu.inference.paging import HostPageCorruptError
+from deepspeed_tpu.inference.router import (
+    FleetResult,
+    FleetRouter,
+    RequestAbortedError,
+)
 from deepspeed_tpu.inference.scheduler import (
     Completion,
     ContinuousBatchingScheduler,

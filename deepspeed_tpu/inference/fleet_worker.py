@@ -1,0 +1,213 @@
+"""One serving-fleet replica worker: `python -m ...fleet_worker`.
+
+Spawned by `inference/fleet.py:ProcessReplica` under the ``ds_tpu_run``
+supervisor env contract. Protocol, all JSONL:
+
+- stdin (router → worker): ``{"cmd": "submit", "request": {...}}`` and
+  ``{"cmd": "stop"}``.
+- stdout (worker → router): ``{"type": "ready", "pid": ...}`` once the
+  engine is built, one ``{"type": "completion", "completion": {...}}``
+  per finished request as it finishes (streamed — the router must see
+  progress before the replica drains, or a mid-stream death would lose
+  completed work), and a final ``{"type": "stats", ...}`` /
+  ``{"type": "preempted", ...}``.
+- ``hb-p<idx>.json`` heartbeat file in the workdir every loop tick
+  (same schema as the hang watchdog's), suppressed while an armed
+  ``heartbeat_stall`` fault is in effect.
+
+Lifecycle contract (mirrors training workers):
+
+- ``DS_TPU_SERVE_SPEC`` (env) carries the engine recipe: the inference
+  config block, the params seed, ``scan_layers``, optional ``jsonl``
+  telemetry path.
+- Faults arm from ``DS_TPU_SERVE_INJECT`` only when
+  ``DS_TPU_RUN_RESTART_COUNT`` is 0 (first attempt).
+- Clean stop: drain, report stats, write ``done-p<idx:05d>``, exit 0.
+- SIGTERM (``PreemptionHandler``): finish the CURRENT decode step, emit
+  a durable ``preemption`` telemetry event, flush completed-so-far
+  completions, exit 0 WITHOUT the done marker — which is exactly what
+  ``classify_exit`` reads as a preemption.
+- SIGKILL / injected decode faults: the process dies mid-stream; the
+  router's health check classifies and redispatches.
+"""
+
+import collections
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+
+def _out(msg):
+    sys.stdout.write(json.dumps(msg) + "\n")
+    sys.stdout.flush()
+
+
+def _write_heartbeat(workdir, index, step, busy):
+    from deepspeed_tpu.telemetry.watchdog import heartbeat_path
+    hb = {
+        "t": time.time(),
+        "hostname": socket.gethostname(),
+        "process_index": index,
+        "pid": os.getpid(),
+        "step": step,
+        "phase": "serve",
+        "in_step": busy,
+        "step_elapsed_s": 0.0,
+    }
+    path = heartbeat_path(workdir, index)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(hb, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+class _CommandReader:
+    """Blocking stdin reader on a daemon thread. select() over a
+    buffered sys.stdin is a trap — readline() can pull several lines
+    into Python's buffer while select() sees an empty fd, stranding
+    commands — so a thread does blocking readline() and the serve loop
+    drains the deque non-blockingly."""
+
+    def __init__(self):
+        self._lines = collections.deque()
+        self._t = threading.Thread(target=self._loop, daemon=True,
+                                   name="fleet-worker-stdin")
+        self._t.start()
+
+    def _loop(self):
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                self._lines.append(json.loads(line))
+            except ValueError:
+                pass
+        self._lines.append({"cmd": "stop"})     # EOF: router is gone
+
+    def drain(self):
+        cmds = []
+        while self._lines:
+            try:
+                cmds.append(self._lines.popleft())
+            except IndexError:
+                break
+        return cmds
+
+
+def main():
+    index = int(os.environ.get("DS_TPU_RUN_PROCESS_INDEX", "0"))
+    workdir = os.environ.get("DS_TPU_RUN_WORKDIR", os.getcwd())
+    restart_count = int(os.environ.get("DS_TPU_RUN_RESTART_COUNT", "0"))
+    spec = json.loads(os.environ["DS_TPU_SERVE_SPEC"])
+
+    from deepspeed_tpu.runtime.resilience import fault_injection
+    if restart_count == 0:
+        fault_injection.arm_from_env()
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.fleet import completion_dict
+    from deepspeed_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler, Request)
+    from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
+    from deepspeed_tpu.runtime.resilience.preemption import (
+        PreemptionHandler)
+
+    session = None
+    if spec.get("jsonl"):
+        from deepspeed_tpu.telemetry.exporters import JsonlExporter
+        from deepspeed_tpu.telemetry.session import TelemetrySession
+        session = TelemetrySession(
+            exporters=[JsonlExporter(spec["jsonl"])])
+
+    seed = int(spec.get("seed", 0))
+    cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32,
+                    scan_layers=bool(spec.get("scan_layers", False)))
+    model = GPT2LMHead(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), toks)["params"]
+    engine = InferenceEngine(model, params,
+                             config=spec.get("inf_cfg") or {},
+                             session=session)
+    sched = ContinuousBatchingScheduler(engine)
+
+    handler = PreemptionHandler().install()
+    reader = _CommandReader()
+    _out({"type": "ready", "pid": os.getpid(), "replica": index})
+
+    reported = 0
+    stalled_until = 0.0
+    stopping = False
+    while True:
+        idle = not (sched.queue or any(
+            s is not None for s in sched.slots))
+        if idle and not stopping:
+            time.sleep(0.002)
+        for cmd in reader.drain():
+            if cmd.get("cmd") == "submit":
+                d = cmd["request"]
+                sched.submit(Request(
+                    rid=str(d["rid"]),
+                    prompt=[int(t) for t in d["prompt"]],
+                    max_new_tokens=int(d.get("max_new_tokens", 16)),
+                    eos_id=d.get("eos_id"),
+                    arrival_step=int(d.get("arrival_step", 0)),
+                    session_id=d.get("session_id"),
+                    deadline_s=d.get("deadline_s"),
+                    queue_timeout_s=d.get("queue_timeout_s"),
+                    redispatched=int(d.get("redispatched", 0)),
+                    restarts=int(d.get("restarts", 0))))
+            elif cmd.get("cmd") == "stop":
+                stopping = True
+
+        has_work = bool(sched.queue) or any(
+            s is not None for s in sched.slots)
+        if has_work:
+            sched.step()        # kill/decode fault probes fire inside
+
+        for c in sched.completions[reported:]:
+            _out({"type": "completion", "completion": completion_dict(c)})
+        reported = len(sched.completions)
+
+        now = time.time()
+        stall = fault_injection.heartbeat_stall_seconds(sched.step_count)
+        if stall:
+            stalled_until = now + stall
+        if now >= stalled_until:
+            _write_heartbeat(workdir, index, sched.step_count, has_work)
+
+        if handler.preempted:
+            # SIGTERM: the current decode step already finished above.
+            if session is not None:
+                session.emit("preemption", step=sched.step_count,
+                             completed=reported, replica=index)
+                session.close()
+            _out({"type": "preempted", "completed": reported,
+                  "steps": sched.step_count})
+            return 0            # exit 0, NO done marker -> preemption
+
+        if stopping and not has_work:
+            break
+
+    counts = engine.compile_counts()
+    _out({"type": "stats", "compile_counts": counts,
+          "steps": sched.step_count, "completed": reported,
+          "replica": index})
+    if session is not None:
+        session.close()
+    from deepspeed_tpu.runtime.supervisor.supervisor import done_path
+    with open(done_path(workdir, index), "w") as f:
+        f.write("done\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.runtime.resilience import fault_injection
 from deepspeed_tpu.runtime.resilience.checkpoint import _leaf_checksums
 
 TRASH_PAGE = 0
@@ -50,6 +51,23 @@ TRASH_PAGE = 0
 
 class OutOfPagesError(RuntimeError):
     """The pool has no free page and nothing left to evict."""
+
+
+class HostPageCorruptError(RuntimeError):
+    """A parked session's host-RAM snapshot failed CRC verification at
+    page-in. Scattering rotted bytes into the pool would poison the
+    session's whole continuation, so the snapshot is unusable — but the
+    PROMPT still exists, so the scheduler recovers by dropping the
+    parked pages and re-prefilling from scratch instead of crashing
+    the engine (`PagedCacheManager.admit` catches this)."""
+
+    def __init__(self, session_id, bad_leaves):
+        self.session_id = session_id
+        self.bad_leaves = list(bad_leaves)
+        super().__init__(
+            f"host page tier: CRC mismatch for session {session_id!r} "
+            f"on {len(self.bad_leaves)} leaves "
+            f"(first: {self.bad_leaves[:3]})")
 
 
 class PageAllocator:
@@ -218,19 +236,35 @@ class HostPageStore:
         import jax
         nbytes = sum(int(leaf.nbytes)
                      for leaf in jax.tree_util.tree_leaves(host_pages))
-        self._parked[session_id] = (
-            host_pages, _leaf_checksums(host_pages), nbytes)
+        checksums = _leaf_checksums(host_pages)
+        if fault_injection.corrupt_host_pages(session_id):
+            # Harness-injected rot: flip one byte in the first leaf
+            # AFTER the CRCs were stamped, so take() must detect it.
+            done = [False]
+
+            def _flip(leaf):
+                if done[0]:
+                    return leaf
+                done[0] = True
+                buf = np.array(leaf)
+                buf.reshape(-1).view(np.uint8)[0] ^= 0xFF
+                return buf
+
+            host_pages = jax.tree_util.tree_map(_flip, host_pages)
+        self._parked[session_id] = (host_pages, checksums, nbytes)
 
     def take(self, session_id):
-        """Remove and return a parked snapshot after CRC verification."""
+        """Remove and return a parked snapshot after CRC verification.
+
+        A failed verification removes the snapshot anyway (rotted bytes
+        are useless to every future caller) and raises
+        :class:`HostPageCorruptError`."""
         tree, checksums, _ = self._parked.pop(session_id)
         actual = _leaf_checksums(tree)
         if actual != checksums:
             bad = sorted(k for k in checksums
                          if actual.get(k) != checksums[k])
-            raise RuntimeError(
-                f"host page tier: CRC mismatch for session "
-                f"{session_id!r} on {len(bad)} leaves (first: {bad[:3]})")
+            raise HostPageCorruptError(session_id, bad)
         return tree
 
     def drop(self, session_id):
@@ -291,6 +325,7 @@ class PagedCacheManager:
         self.sessions_resumed = 0
         self.pages_evacuated = 0
         self.pages_paged_in = 0
+        self.host_pages_corrupt = 0
 
     # -- bookkeeping ---------------------------------------------------------
 
@@ -333,6 +368,7 @@ class PagedCacheManager:
             "sessions_resumed": self.sessions_resumed,
             "pages_evacuated": self.pages_evacuated,
             "pages_paged_in": self.pages_paged_in,
+            "host_pages_corrupt": self.host_pages_corrupt,
             "host_tier_bytes": self.host_store.nbytes,
         }
 
@@ -421,15 +457,27 @@ class PagedCacheManager:
                         self.sessions[session_id] = sess
                         return None
                     fresh.append(p)
-                self.engine.scatter_pages(
-                    fresh, self.host_store.take(session_id))
-                self.pages_paged_in += len(fresh)
-                sess.pages = fresh
-                sess.on_device = True
-            pages = list(sess.pages)    # row takes over the session's refs
-            start = (min(sess.next_pos, n - 1) // chunk) * chunk
-            resumed = True
-        elif self.radix is not None:
+                try:
+                    self.engine.scatter_pages(
+                        fresh, self.host_store.take(session_id))
+                except HostPageCorruptError:
+                    # The rotted snapshot is gone (take() dropped it);
+                    # free the landing pages and fall through to a cold
+                    # admission — the session survives as a plain
+                    # re-prefill from the prompt.
+                    for q in fresh:
+                        self.allocator.decref(q)
+                    self.host_pages_corrupt += 1
+                    sess = None
+                else:
+                    self.pages_paged_in += len(fresh)
+                    sess.pages = fresh
+                    sess.on_device = True
+            if sess is not None:
+                pages = list(sess.pages)  # row takes the session's refs
+                start = (min(sess.next_pos, n - 1) // chunk) * chunk
+                resumed = True
+        if not resumed and self.radix is not None:
             # cap at floor((n-1)/ps): the LAST prompt token always
             # prefills (its logits seed sampling), so a prompt that is
             # entirely interned still runs its final page's chunks.

@@ -37,6 +37,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.runtime.resilience import fault_injection
+
 
 @dataclasses.dataclass
 class Request:
@@ -45,13 +47,28 @@ class Request:
     step count reaches it (deterministic synthetic load for benches and
     tests). ``session_id`` (paged engines) parks the request's KV pages
     at completion so a follow-up request on the same session resumes
-    without re-prefilling its history."""
+    without re-prefilling its history.
+
+    Robustness knobs (ISSUE 17): ``deadline_s`` bounds the request's
+    TOTAL wall clock from first submit to completion, ``queue_timeout_s``
+    bounds its wait for a cache row — either expiry finishes it with the
+    typed ``timeout`` reason instead of letting it stall the stream.
+    ``redispatched``/``restarts`` are stamped by the fleet router when a
+    replica death forces a re-prefill elsewhere; ``submit_t`` is the
+    monotonic clock at FIRST submit and survives redispatch, so the
+    deadline spans retries (exactly-once completion semantics over
+    at-least-once execution)."""
     rid: str
     prompt: List[int]
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
     arrival_step: int = 0
     session_id: Optional[str] = None
+    deadline_s: Optional[float] = None
+    queue_timeout_s: Optional[float] = None
+    redispatched: int = 0       # replica-death redispatches (router)
+    restarts: int = 0           # total re-executions (router)
+    submit_t: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -59,14 +76,17 @@ class Completion:
     rid: str
     prompt_len: int
     tokens: List[int]           # generated ids (includes eos when hit)
-    finish_reason: str          # "max_new_tokens" | "eos" | "length"
+    finish_reason: str          # "max_new_tokens" | "eos" | "length" |
+                                # "timeout" | "incomplete"
     bucket: int
-    slot: int
+    slot: int                   # -1: never held a row (queued timeout)
     steps: int                  # decode steps this request was live for
     prefix_hit: bool = False    # admitted on shared radix pages
     resumed: bool = False       # admitted by resuming a parked session
     prefill_chunks: int = 0     # prefill chunks actually run
     prefill_chunks_skipped: int = 0
+    redispatched: int = 0       # times redispatched across replicas
+    restarts: int = 0           # times its execution restarted
 
 
 @dataclasses.dataclass
@@ -106,6 +126,8 @@ class ContinuousBatchingScheduler:
         if request.max_new_tokens < 1:
             raise ValueError(
                 f"request {request.rid}: max_new_tokens must be >= 1")
+        if request.submit_t is None:    # survives redispatch resubmits
+            request.submit_t = time.monotonic()
         self.queue.append(request)
 
     def _bucket_for(self, request):
@@ -120,7 +142,9 @@ class ContinuousBatchingScheduler:
         comp = Completion(
             rid=s.request.rid, prompt_len=len(s.request.prompt),
             tokens=list(s.generated), finish_reason=reason, bucket=s.bucket,
-            slot=i, steps=self.step_count - s.admitted_step)
+            slot=i, steps=self.step_count - s.admitted_step,
+            redispatched=s.request.redispatched,
+            restarts=s.request.restarts)
         if s.paging is not None:
             comp.prefix_hit = s.paging.prefix_hit
             comp.resumed = s.paging.resumed
@@ -135,6 +159,15 @@ class ContinuousBatchingScheduler:
         self.completions.append(comp)
         self.slots[i] = None            # row back on the ring
 
+    def _finish_unstarted(self, request, reason):
+        """Record a completion for a request that never held a row
+        (queued timeout / max_steps exhaustion)."""
+        self.completions.append(Completion(
+            rid=request.rid, prompt_len=len(request.prompt), tokens=[],
+            finish_reason=reason, bucket=self._bucket_for(request),
+            slot=-1, steps=0, redispatched=request.redispatched,
+            restarts=request.restarts))
+
     def _check_finished(self, i):
         s = self.slots[i]
         if s.request.eos_id is not None and \
@@ -145,6 +178,39 @@ class ContinuousBatchingScheduler:
         elif s.next_pos >= s.bucket:
             # bucket budget exhausted: evict (truncated generation)
             self._finish(i, "length")
+
+    def _expire(self):
+        """Typed ``timeout`` finishes: queued requests past their queue
+        timeout (or total deadline) drop WITHOUT ever taking a row, and
+        live rows past their deadline finish with whatever they
+        generated so far."""
+        now = time.monotonic()
+
+        def _queued_expired(r):
+            waited = now - r.submit_t if r.submit_t is not None else 0.0
+            return ((r.queue_timeout_s is not None and
+                     waited > r.queue_timeout_s) or
+                    (r.deadline_s is not None and waited > r.deadline_s))
+
+        expired = [r for r in self.queue if _queued_expired(r)]
+        if expired:
+            self.queue = collections.deque(
+                r for r in self.queue if not _queued_expired(r))
+        for r in expired:
+            self._finish_unstarted(r, "timeout")
+            if self.session is not None:
+                self.session.emit("request_timeout", rid=r.rid,
+                                  where="queue", step=self.step_count)
+        for i, s in enumerate(self.slots):
+            if s is None or s.request.deadline_s is None or \
+                    s.request.submit_t is None:
+                continue
+            if now - s.request.submit_t > s.request.deadline_s:
+                self._finish(i, "timeout")
+                if self.session is not None:
+                    self.session.emit("request_timeout",
+                                      rid=s.request.rid, where="decode",
+                                      step=self.step_count)
 
     def _admit(self):
         for i in range(len(self.slots)):
@@ -186,6 +252,7 @@ class ContinuousBatchingScheduler:
         """Admit what the queue allows, then run one compiled decode
         step over the live rows. Returns True while there is (or will
         be) work left."""
+        self._expire()
         self._admit()
         if self.paging is not None:
             # grow each live row's page mapping to cover this step's
@@ -214,6 +281,11 @@ class ContinuousBatchingScheduler:
             for i in active:
                 page_tables[i] = self.slots[i].paging.table(
                     self.paging.pages_per_row)
+        # fault-injection seams: a hard kill (SIGKILL — the process just
+        # dies with admitted sessions' KV un-drained) and the soft
+        # decode exception, both no-ops unless a harness armed them.
+        fault_injection.maybe_kill("decode_step", self.step_count)
+        fault_injection.maybe_fail_decode(self.step_count)
         t0 = time.perf_counter()
         if page_tables is None:
             next_tokens, _ = self.engine.decode(tokens, positions)
@@ -233,7 +305,14 @@ class ContinuousBatchingScheduler:
 
     def run(self, requests=None, max_steps=100000):
         """Drain ``requests`` (plus anything already queued) through the
-        decode loop; returns the completions in finish order."""
+        decode loop; returns the completions in finish order.
+
+        Exhausting ``max_steps`` with work still in flight no longer
+        returns silently: every live row finishes with the typed
+        ``incomplete`` reason (keeping its generated-so-far tokens),
+        every still-queued request records an empty ``incomplete``
+        completion, and one ``scheduler_incomplete`` warning event makes
+        the truncation visible in telemetry."""
         for r in requests or ():
             self.submit(r)
         steps = 0
@@ -241,6 +320,18 @@ class ContinuousBatchingScheduler:
             if not self.step():
                 break
             steps += 1
+        live = [i for i, s in enumerate(self.slots) if s is not None]
+        if live or self.queue:
+            for i in live:
+                self._finish(i, "incomplete")
+            queued = len(self.queue)
+            while self.queue:
+                self._finish_unstarted(self.queue.popleft(), "incomplete")
+            if self.session is not None:
+                self.session.emit(
+                    "scheduler_incomplete", level="warning",
+                    step=self.step_count, max_steps=max_steps,
+                    live_rows=len(live), queued=queued)
         return list(self.completions)
 
     # -- telemetry ----------------------------------------------------------

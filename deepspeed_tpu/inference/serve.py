@@ -7,21 +7,35 @@
     ds_tpu_serve --expect-compiles 2 --json
     ds_tpu_serve --synthetic 8 --kv-layout paged --shared-prefix 12 \
                  --expect-prefix-hits 1   # radix prefix-cache smoke
+    ds_tpu_serve --synthetic 8 --replicas 2 \
+                 --kill-replica 0 --kill-at-step 3 \
+                 --expect-redispatch 1    # fleet resilience smoke
 
 The model is the test-size GPT-2 with seeded random params — this CLI
 exists to exercise and measure the serving engine (CI smoke, bench
 rows, audits), not to ship checkpoints. A request line is
 ``{"rid": "r0", "prompt": [1, 2, 3], "max_new_tokens": 8,
-"eos_id": null, "arrival_step": 0}`` (only ``prompt`` required).
+"eos_id": null, "arrival_step": 0}`` (only ``prompt`` required; also
+``deadline_s``/``queue_timeout_s`` per ISSUE 17).
 
 ``--expect-compiles N`` makes the exit code enforce the recompile
 contract: after the stream drains, prefill + decode jit-cache entries
 must total exactly N (2 for any single-engine serve — one prefill, one
-decode — regardless of how many buckets the stream crossed).
-``--jsonl`` writes ``decode_step`` telemetry events for
-``ds_tpu_metrics summary`` serve mode.
+decode — regardless of how many buckets the stream crossed). With
+``--replicas`` the gate applies PER SURVIVING REPLICA.
+``--jsonl`` writes telemetry events for ``ds_tpu_metrics summary``
+serve mode (``decode_step`` single-engine; fleet events with
+``--replicas``).
 
-Exit codes: 0 ok, 1 compile-count violation or unfinished requests,
+``--replicas N`` (N >= 2) serves through the fleet router
+(`inference/fleet.py` + `router.py`): N replica workers behind one
+admission queue with drain/redispatch on replica death.
+``--kill-replica I --kill-at-step S`` arms a real SIGKILL inside
+replica I's decode loop (``DS_TPU_SERVE_INJECT``), and
+``--expect-redispatch N`` gates the exit code on the fleet actually
+recovering.
+
+Exit codes: 0 ok, 1 contract violation or unfinished requests,
 2 usage errors.
 """
 
@@ -49,7 +63,10 @@ def _build_requests(args, vocab_size, max_seq):
                         d.get("max_new_tokens", args.max_new)),
                     eos_id=d.get("eos_id"),
                     arrival_step=int(d.get("arrival_step", 0)),
-                    session_id=d.get("session_id")))
+                    session_id=d.get("session_id"),
+                    deadline_s=d.get("deadline_s", args.deadline_s),
+                    queue_timeout_s=d.get("queue_timeout_s",
+                                          args.queue_timeout_s)))
         return reqs
     # synthetic open-loop stream: varied prompt lengths spanning the
     # buckets, staggered arrivals, deterministic under --seed. With
@@ -68,8 +85,150 @@ def _build_requests(args, vocab_size, max_seq):
             rid=f"s{i}",
             prompt=prompt,
             max_new_tokens=args.max_new,
-            arrival_step=int(i * args.arrival_every)))
+            arrival_step=int(i * args.arrival_every),
+            deadline_s=args.deadline_s,
+            queue_timeout_s=args.queue_timeout_s))
     return reqs
+
+
+# gpt2_tiny's fixed test vocab — the synthetic stream only needs the
+# token range, so fleet mode doesn't build a model in the parent
+_TINY_VOCAB = 256
+
+
+def _run_fleet(args, inf_cfg, session):
+    """Serve through the N-replica fleet router (ISSUE 17)."""
+    import os
+    import tempfile
+
+    from deepspeed_tpu.inference import fleet as fleet_mod
+    from deepspeed_tpu.inference.router import FleetRouter
+
+    workdir = os.path.abspath(
+        args.workdir or tempfile.mkdtemp(prefix="ds-tpu-fleet-"))
+    max_seq = max(inf_cfg.get("seq_buckets", (16, 32)))
+    requests = _build_requests(args, _TINY_VOCAB, max_seq)
+
+    inject = None
+    if args.kill_replica is not None:
+        inject = {"kill": {"op": "decode_step",
+                           "at_step": args.kill_at_step}}
+    spec = {"inf_cfg": {k: (list(v) if isinstance(v, tuple) else v)
+                        for k, v in inf_cfg.items()},
+            "seed": args.seed, "scan_layers": args.scan_layers}
+
+    if args.replica_backend == "process":
+        replicas = []
+        for i in range(args.replicas):
+            rspec = dict(spec, jsonl=os.path.join(
+                workdir, f"replica{i}.jsonl"))
+            replicas.append(fleet_mod.ProcessReplica(
+                i, rspec, workdir, num_replicas=args.replicas,
+                inject=inject if i == args.kill_replica else None,
+                hang_timeout_s=args.hang_timeout_s,
+                heartbeat_stale_s=args.heartbeat_stale_s).start())
+        for r in replicas:
+            r.wait_ready()
+    else:
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
+
+        def factory():
+            cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32,
+                            scan_layers=args.scan_layers)
+            model = GPT2LMHead(cfg)
+            params = model.init(jax.random.PRNGKey(args.seed),
+                                jnp.zeros((1, 8), jnp.int32))["params"]
+            return InferenceEngine(model, params, config=inf_cfg)
+
+        replicas = [fleet_mod.ThreadReplica(i, factory).start()
+                    for i in range(args.replicas)]
+
+    router = FleetRouter(
+        replicas, session=session,
+        max_redispatch=(args.max_redispatch if args.max_redispatch
+                        is not None
+                        else int(inf_cfg.get("max_redispatch", 2))),
+        max_queue_depth=(args.max_queue_depth if args.max_queue_depth
+                         is not None
+                         else int(inf_cfg.get("max_queue_depth", 8))),
+        max_pending=args.max_pending)
+    fr = router.run(requests, timeout_s=args.fleet_timeout)
+
+    ok = fr.ok
+    compiles_bad = []
+    if args.expect_compiles is not None:
+        for st in fr.stats:
+            total = sum(n for n in st["compile_counts"].values()
+                        if n is not None)
+            if total != args.expect_compiles:
+                compiles_bad.append((st["replica"], total))
+        ok = ok and not compiles_bad
+    redisp_ok = True
+    if args.expect_redispatch is not None:
+        redisp_ok = fr.redispatched_total >= args.expect_redispatch
+        ok = ok and redisp_ok
+
+    result = {
+        "requests": len(requests),
+        "completions": fr.completions,
+        "fleet": {
+            "replicas": fr.replicas,
+            "backend": args.replica_backend,
+            "replicas_dead": fr.replicas_dead,
+            "dead_causes": dict(router.dead),
+            "redispatched_total": fr.redispatched_total,
+            "aborted": fr.aborted, "shed": fr.shed,
+            "defers": fr.defers, "timeouts": fr.timeouts,
+            "latency_s": fr.latency_s,
+            "stats": fr.stats,
+            "workdir": workdir,
+        },
+        "ok": ok,
+    }
+    if args.expect_compiles is not None:
+        result["expect_compiles"] = args.expect_compiles
+    if args.expect_redispatch is not None:
+        result["expect_redispatch"] = args.expect_redispatch
+
+    if args.as_json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+    else:
+        for c in fr.completions:
+            extra = ""
+            if c["redispatched"]:
+                extra = f", redispatched x{c['redispatched']}"
+            print(f"{c['rid']}: prompt {c['prompt_len']} tokens -> "
+                  f"{len(c['tokens'])} generated "
+                  f"({c['finish_reason']}, replica {c['replica']}"
+                  f"{extra})")
+        fl = result["fleet"]
+        print(f"{len(fr.completions)}/{len(requests)} requests "
+              f"completed on {fl['replicas']} replica(s) "
+              f"({fl['replicas_dead']} died: {fl['dead_causes']}); "
+              f"redispatched={fl['redispatched_total']} "
+              f"aborted={fl['aborted']} shed={fl['shed']} "
+              f"timeouts={fl['timeouts']}")
+        for st in fr.stats:
+            cc = st["compile_counts"]
+            print(f"replica {st['replica']}: {st['completed']} "
+                  f"completed in {st['steps']} step(s); compiles: "
+                  f"prefill={cc.get('prefill')} "
+                  f"decode={cc.get('decode')}")
+        if not ok:
+            if compiles_bad:
+                why = (f"replica compile counts {compiles_bad} != "
+                       f"expected {args.expect_compiles}")
+            elif not redisp_ok:
+                why = (f"redispatched {fr.redispatched_total} < "
+                       f"expected {args.expect_redispatch}")
+            else:
+                why = ("unfinished/aborted/shed/timed-out requests "
+                       "in the fleet result")
+            print(f"FAIL: {why}", file=sys.stderr)
+    return 0 if ok else 1
 
 
 def main(argv=None):
@@ -156,12 +315,65 @@ def main(argv=None):
                              "(ds_tpu_metrics summary serve mode)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="print the result dict as JSON")
+    # -- fleet mode (ISSUE 17) ------------------------------------------
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="serve through an N-replica fleet behind "
+                             "the admission router (N >= 2)")
+    parser.add_argument("--replica-backend", default="process",
+                        choices=("process", "thread"),
+                        help="fleet replicas: real subprocess workers "
+                             "(SIGKILL-able) or in-process threads")
+    parser.add_argument("--workdir", default=None,
+                        help="fleet workdir (heartbeats, done markers, "
+                             "replica logs); default: a temp dir")
+    parser.add_argument("--deadline-s", type=float, default=None,
+                        help="per-request total wall-clock deadline "
+                             "(typed 'timeout' finish reason)")
+    parser.add_argument("--queue-timeout-s", type=float, default=None,
+                        help="per-request bound on queue wait before "
+                             "admission (typed 'timeout')")
+    parser.add_argument("--max-redispatch", type=int, default=None,
+                        help="redispatches before a request aborts "
+                             "(typed RequestAbortedError path)")
+    parser.add_argument("--max-queue-depth", type=int, default=None,
+                        help="per-replica in-flight bound (router "
+                             "defers past it, emitting fleet_defer)")
+    parser.add_argument("--max-pending", type=int, default=None,
+                        help="global admission bound (router sheds "
+                             "past it, emitting fleet_shed)")
+    parser.add_argument("--fleet-timeout", type=float, default=300.0,
+                        help="whole-fleet drive-loop wall bound")
+    parser.add_argument("--hang-timeout-s", type=float, default=None,
+                        help="replica heartbeat stuck-in-step bound")
+    parser.add_argument("--heartbeat-stale-s", type=float, default=None,
+                        help="replica heartbeat staleness bound")
+    parser.add_argument("--kill-replica", type=int, default=None,
+                        help="arm a SIGKILL fault in this replica index")
+    parser.add_argument("--kill-at-step", type=int, default=3,
+                        help="decode step the armed kill fires at")
+    parser.add_argument("--expect-redispatch", type=int, default=None,
+                        help="exit 1 unless the fleet redispatched at "
+                             "least this many requests")
     args = parser.parse_args(argv)
 
     if not args.requests and not args.synthetic:
         parser.error("one of --requests or --synthetic N is required")
     if args.requests and args.synthetic:
         parser.error("--requests and --synthetic are mutually exclusive")
+    if args.replicas < 1:
+        parser.error("--replicas must be >= 1")
+    if args.replicas == 1 and (args.kill_replica is not None or
+                               args.expect_redispatch is not None):
+        parser.error("--kill-replica/--expect-redispatch require "
+                     "--replicas >= 2")
+    if args.kill_replica is not None and \
+            not 0 <= args.kill_replica < args.replicas:
+        parser.error(f"--kill-replica {args.kill_replica} outside "
+                     f"0..{args.replicas - 1}")
+    if args.kill_replica is not None and \
+            args.replica_backend != "process":
+        parser.error("--kill-replica needs --replica-backend process "
+                     "(a thread cannot be SIGKILLed in isolation)")
 
     import jax
     import jax.numpy as jnp
@@ -199,7 +411,12 @@ def main(argv=None):
                    "page_size": inf.page_size,
                    "n_pages": inf.n_pages,
                    "prefix_cache": inf.prefix_cache,
-                   "host_park_threshold": inf.host_park_threshold}
+                   "host_park_threshold": inf.host_park_threshold,
+                   "replicas": inf.replicas,
+                   "max_redispatch": inf.max_redispatch,
+                   "max_queue_depth": inf.max_queue_depth,
+                   "deadline_s": inf.deadline_s,
+                   "queue_timeout_s": inf.queue_timeout_s}
     if args.max_batch is not None:
         inf_cfg["max_batch"] = args.max_batch
     if args.seq_buckets is not None:
@@ -242,6 +459,17 @@ def main(argv=None):
     if args.jsonl:
         from deepspeed_tpu.telemetry.exporters import JsonlExporter
         session = TelemetrySession(exporters=[JsonlExporter(args.jsonl)])
+
+    # config-file fleet/deadline knobs apply when the flags stay at
+    # their defaults (0 in the config block means disabled)
+    args.replicas = max(args.replicas, int(inf_cfg.get("replicas", 1)
+                                           or 1))
+    if args.deadline_s is None:
+        args.deadline_s = inf_cfg.get("deadline_s") or None
+    if args.queue_timeout_s is None:
+        args.queue_timeout_s = inf_cfg.get("queue_timeout_s") or None
+    if args.replicas > 1:
+        return _run_fleet(args, inf_cfg, session)
 
     cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32,
                     scan_layers=args.scan_layers)

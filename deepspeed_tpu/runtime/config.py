@@ -652,7 +652,10 @@ class InferenceConfig:
             INFERENCE_ATTENTION_BLOCK_K, INFERENCE_TEMPERATURE,
             INFERENCE_TOP_K, INFERENCE_TOP_P, INFERENCE_SAMPLING_SEED,
             INFERENCE_KV_LAYOUT, INFERENCE_PAGE_SIZE, INFERENCE_N_PAGES,
-            INFERENCE_PREFIX_CACHE, INFERENCE_HOST_PARK_THRESHOLD)
+            INFERENCE_PREFIX_CACHE, INFERENCE_HOST_PARK_THRESHOLD,
+            INFERENCE_REPLICAS, INFERENCE_MAX_REDISPATCH,
+            INFERENCE_MAX_QUEUE_DEPTH, INFERENCE_DEADLINE_S,
+            INFERENCE_QUEUE_TIMEOUT_S)
 
     def __init__(self, param_dict):
         sub = param_dict.get(INFERENCE, {}) or {}
@@ -692,6 +695,17 @@ class InferenceConfig:
         self.host_park_threshold = get_scalar_param(
             sub, INFERENCE_HOST_PARK_THRESHOLD,
             INFERENCE_HOST_PARK_THRESHOLD_DEFAULT)
+        self.replicas = get_scalar_param(
+            sub, INFERENCE_REPLICAS, INFERENCE_REPLICAS_DEFAULT)
+        self.max_redispatch = get_scalar_param(
+            sub, INFERENCE_MAX_REDISPATCH, INFERENCE_MAX_REDISPATCH_DEFAULT)
+        self.max_queue_depth = get_scalar_param(
+            sub, INFERENCE_MAX_QUEUE_DEPTH,
+            INFERENCE_MAX_QUEUE_DEPTH_DEFAULT)
+        self.deadline_s = get_scalar_param(
+            sub, INFERENCE_DEADLINE_S, INFERENCE_DEADLINE_S_DEFAULT)
+        self.queue_timeout_s = get_scalar_param(
+            sub, INFERENCE_QUEUE_TIMEOUT_S, INFERENCE_QUEUE_TIMEOUT_S_DEFAULT)
 
     def __repr__(self):
         return (f"InferenceConfig(max_batch={self.max_batch}, "
@@ -707,7 +721,12 @@ class InferenceConfig:
                 f"kv_layout={self.kv_layout!r}, "
                 f"page_size={self.page_size}, n_pages={self.n_pages}, "
                 f"prefix_cache={self.prefix_cache}, "
-                f"host_park_threshold={self.host_park_threshold})")
+                f"host_park_threshold={self.host_park_threshold}, "
+                f"replicas={self.replicas}, "
+                f"max_redispatch={self.max_redispatch}, "
+                f"max_queue_depth={self.max_queue_depth}, "
+                f"deadline_s={self.deadline_s}, "
+                f"queue_timeout_s={self.queue_timeout_s})")
 
 
 class DeepSpeedConfig:
@@ -1104,6 +1123,29 @@ class DeepSpeedConfig:
             raise ValueError(
                 f"inference: host_park_threshold must be in [0, 1), "
                 f"got {hp!r}")
+        nr = inf.replicas
+        if isinstance(nr, bool) or not isinstance(nr, int) or nr < 1:
+            raise ValueError(
+                f"inference: replicas must be an int >= 1, got {nr!r}")
+        mrd = inf.max_redispatch
+        if isinstance(mrd, bool) or not isinstance(mrd, int) or mrd < 0:
+            raise ValueError(
+                f"inference: max_redispatch must be an int >= 0, "
+                f"got {mrd!r}")
+        mqd = inf.max_queue_depth
+        if isinstance(mqd, bool) or not isinstance(mqd, int) or mqd < 1:
+            raise ValueError(
+                f"inference: max_queue_depth must be an int >= 1, "
+                f"got {mqd!r}")
+        for name, val in (("deadline_s", inf.deadline_s),
+                          ("queue_timeout_s", inf.queue_timeout_s)):
+            if val is None:
+                continue        # also "disabled", like 0
+            if isinstance(val, bool) or \
+                    not isinstance(val, (int, float)) or val < 0:
+                raise ValueError(
+                    f"inference: {name} must be a number >= 0 "
+                    f"(0 = disabled), got {val!r}")
 
     def _check_fp8(self):
         from deepspeed_tpu.runtime.comm.codecs import CODECS

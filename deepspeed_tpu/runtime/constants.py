@@ -531,3 +531,27 @@ INFERENCE_PREFIX_CACHE_DEFAULT = True
 # evacuation (pressure-driven eviction still runs on exhaustion).
 INFERENCE_HOST_PARK_THRESHOLD = "host_park_threshold"
 INFERENCE_HOST_PARK_THRESHOLD_DEFAULT = 0.25
+
+# Serving fleet (ISSUE 17): N replica workers behind one admission
+# router with drain/redispatch on replica death. replicas=1 keeps the
+# single-engine path.
+INFERENCE_REPLICAS = "replicas"
+INFERENCE_REPLICAS_DEFAULT = 1
+
+# Redispatches a request survives before the router aborts it with the
+# typed RequestAbortedError / "aborted" finish reason.
+INFERENCE_MAX_REDISPATCH = "max_redispatch"
+INFERENCE_MAX_REDISPATCH_DEFAULT = 2
+
+# Per-replica in-flight bound: the router defers dispatch (fleet_defer)
+# while every healthy replica is at it.
+INFERENCE_MAX_QUEUE_DEPTH = "max_queue_depth"
+INFERENCE_MAX_QUEUE_DEPTH_DEFAULT = 8
+
+# Per-request wall-clock bounds (seconds; 0 disables): total budget
+# from submit to completion, and queue wait before admission. Either
+# expiry finishes the request with the typed "timeout" reason.
+INFERENCE_DEADLINE_S = "deadline_s"
+INFERENCE_DEADLINE_S_DEFAULT = 0.0
+INFERENCE_QUEUE_TIMEOUT_S = "queue_timeout_s"
+INFERENCE_QUEUE_TIMEOUT_S_DEFAULT = 0.0

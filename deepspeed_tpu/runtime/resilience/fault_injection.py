@@ -25,14 +25,37 @@ Seams (all deterministic — armed for explicit steps or a fixed count):
 - ``kill`` — :func:`maybe_kill` delivers a hard signal (default SIGKILL)
   to the process itself, either mid-step (inside the dispatch span,
   after the batch is consumed and before the optimizer state is
-  consistent) or mid-checkpoint-save (state bytes staged, manifest not
-  yet sealed) — the ungraceful exits the ``ds_tpu_run`` supervisor
-  (`runtime/supervisor/`) must detect and recover from. Unlike every
+  consistent), mid-checkpoint-save (state bytes staged, manifest not
+  yet sealed), or mid-decode-step (``op="decode_step"`` — inside a
+  serving replica's decode loop, with in-flight sessions whose KV lives
+  only in that process) — the ungraceful exits the ``ds_tpu_run``
+  supervisor (`runtime/supervisor/`) and the serving fleet router
+  (`inference/fleet.py`) must detect and recover from. Unlike every
   other seam this one never raises: the process just dies, exactly like
   an OOM-killer or preempted-VM death.
 
+Serving seams (the fleet resilience ladder, ISSUE 17):
+
+- ``decode_exception`` — :func:`maybe_fail_decode` raises
+  ``InjectedDecodeError`` from inside the continuous-batching
+  scheduler's decode step: the softer replica death (the process gets
+  to crash with a traceback and a nonzero exit, unlike ``kill``).
+- ``page_corruption`` — :func:`corrupt_host_pages` tells the host page
+  tier (`inference/paging.py:HostPageStore`) to flip a byte in a parked
+  session's snapshot AFTER its CRCs are stamped, so the next page-in
+  detects the rot and raises ``HostPageCorruptError`` — exercising the
+  drop-pages-and-re-prefill recovery path.
+- ``heartbeat_stall`` — :func:`heartbeat_stall_seconds` tells a serving
+  replica worker to STOP writing its ``hb-p<idx>.json`` heartbeat for N
+  seconds while continuing to decode: the replica looks dead to the
+  router's liveness deadline without actually being dead, the
+  classification the hang/stale path must get right.
+
 Use :func:`clear_faults` (or the ``fault_registry`` pytest fixture in
-``tests/``) to disarm everything between tests.
+``tests/``) to disarm everything between tests. Subprocess serving
+replicas arm faults from the ``DS_TPU_SERVE_INJECT`` env var via
+:func:`arm_from_env` — only on their first attempt, matching the
+``DS_TPU_RUN_RESTART_COUNT`` contract.
 """
 
 import os
@@ -47,6 +70,13 @@ _faults = {}
 
 class InjectedIOError(OSError):
     """Checkpoint I/O failure injected by the fault harness."""
+
+
+class InjectedDecodeError(RuntimeError):
+    """Decode-step failure injected into a serving replica's scheduler
+    loop by the fault harness. Deliberately NOT caught inside the
+    replica: a decode-step exception is a replica crash, and the fleet
+    router must observe the nonzero exit and redispatch."""
 
 
 class InjectedHostAdamError(RuntimeError):
@@ -177,7 +207,7 @@ def hang_seconds(step):
 # Hard process death (SIGKILL mid-step / mid-checkpoint-save)
 # --------------------------------------------------------------------------
 
-KILL_OPS = ("step", "checkpoint_save")
+KILL_OPS = ("step", "checkpoint_save", "decode_step")
 
 
 def inject_kill(op="step", at_step=None, signum=signal.SIGKILL):
@@ -187,9 +217,12 @@ def inject_kill(op="step", at_step=None, signum=signal.SIGKILL):
     global step >= ``at_step``; ``op="checkpoint_save"`` fires inside
     the checkpoint writer after the state bytes are staged and before
     the manifest seal + atomic rename (``at_step`` is ignored there —
-    the next save dies). The default SIGKILL cannot be caught, so no
-    preemption handler, atexit hook, or flight recorder runs: this is
-    the ungraceful-exit seam the supervisor soak tests need.
+    the next save dies); ``op="decode_step"`` fires inside a serving
+    replica's decode loop at the first scheduler step >= ``at_step``,
+    with admitted sessions' KV still device-resident and un-drained.
+    The default SIGKILL cannot be caught, so no preemption handler,
+    atexit hook, or flight recorder runs: this is the ungraceful-exit
+    seam the supervisor and fleet soak tests need.
     """
     if op not in KILL_OPS:
         raise ValueError(f"kill op must be one of {KILL_OPS}, got {op!r}")
@@ -213,6 +246,127 @@ def maybe_kill(op, step=None):
         _faults.pop(f"kill:{op}", None)
         signum = entry["signum"]
     os.kill(os.getpid(), signum)
+
+
+# --------------------------------------------------------------------------
+# Serving: decode-step exceptions (soft replica crash)
+# --------------------------------------------------------------------------
+
+def inject_decode_exception(at_step, times=1):
+    """Arm ``times`` decode-step exceptions starting at the first
+    scheduler step >= ``at_step`` (serving replica soft-crash seam)."""
+    with _lock:
+        _faults["decode_exception"] = {"at_step": int(at_step),
+                                       "times": int(times)}
+
+
+def maybe_fail_decode(step):
+    """Probe called from inside the scheduler's decode step; raises
+    :class:`InjectedDecodeError` while armed."""
+    with _lock:
+        entry = _faults.get("decode_exception")
+        if entry is None or int(step) < entry["at_step"]:
+            return
+        entry["times"] -= 1
+        _pop_if_exhausted("decode_exception", entry)
+    raise InjectedDecodeError(
+        f"injected decode-step failure at step {step}")
+
+
+# --------------------------------------------------------------------------
+# Serving: host page-tier corruption (silent rot between park and resume)
+# --------------------------------------------------------------------------
+
+def inject_page_corruption(session_id=None, times=1):
+    """Arm host-page corruption: the next ``times`` sessions parked to
+    the host tier (or only ``session_id``'s parks, when given) get one
+    byte flipped AFTER their CRCs are stamped, so resume detects it."""
+    with _lock:
+        _faults["page_corruption"] = {
+            "session_id": session_id, "times": int(times)}
+
+
+def corrupt_host_pages(session_id):
+    """Probe called by the host page store at park time; True when the
+    harness wants this session's snapshot corrupted."""
+    with _lock:
+        entry = _faults.get("page_corruption")
+        if entry is None:
+            return False
+        if entry["session_id"] is not None and \
+                entry["session_id"] != session_id:
+            return False
+        entry["times"] -= 1
+        _pop_if_exhausted("page_corruption", entry)
+    return True
+
+
+# --------------------------------------------------------------------------
+# Serving: heartbeat stall (replica looks dead without dying)
+# --------------------------------------------------------------------------
+
+def inject_heartbeat_stall(at_step, seconds):
+    """Arm a one-shot heartbeat blackout: from the first scheduler step
+    >= ``at_step`` the replica worker suppresses heartbeat writes for
+    ``seconds`` while continuing to serve."""
+    with _lock:
+        _faults["heartbeat_stall"] = {"at_step": int(at_step),
+                                      "seconds": float(seconds)}
+
+
+def heartbeat_stall_seconds(step):
+    """Seconds the replica should suppress heartbeat writes starting at
+    ``step`` (0.0 = not armed). Fires exactly once."""
+    with _lock:
+        entry = _faults.get("heartbeat_stall")
+        if entry is not None and int(step) >= entry["at_step"]:
+            _faults.pop("heartbeat_stall", None)
+            return entry["seconds"]
+    return 0.0
+
+
+# --------------------------------------------------------------------------
+# Env-var arming (subprocess serving replicas)
+# --------------------------------------------------------------------------
+
+INJECT_ENV = "DS_TPU_SERVE_INJECT"
+
+
+def arm_from_env(env=None):
+    """Arm faults described by the ``DS_TPU_SERVE_INJECT`` env var — a
+    JSON object like ``{"kill": {"op": "decode_step", "at_step": 4},
+    "decode_exception": {"at_step": 2}, "heartbeat_stall": {"at_step":
+    3, "seconds": 30}, "page_corruption": {}}``. Subprocess replica
+    workers call this on startup (first attempt only); returns the list
+    of armed fault names."""
+    import json
+    raw = (env if env is not None else os.environ).get(INJECT_ENV)
+    if not raw:
+        return []
+    spec = json.loads(raw)
+    armed = []
+    if "kill" in spec:
+        k = spec["kill"] or {}
+        inject_kill(op=k.get("op", "decode_step"),
+                    at_step=k.get("at_step"),
+                    signum=int(k.get("signum", signal.SIGKILL)))
+        armed.append("kill")
+    if "decode_exception" in spec:
+        d = spec["decode_exception"] or {}
+        inject_decode_exception(at_step=d.get("at_step", 0),
+                                times=d.get("times", 1))
+        armed.append("decode_exception")
+    if "heartbeat_stall" in spec:
+        h = spec["heartbeat_stall"] or {}
+        inject_heartbeat_stall(at_step=h.get("at_step", 0),
+                               seconds=h.get("seconds", 60.0))
+        armed.append("heartbeat_stall")
+    if "page_corruption" in spec:
+        p = spec["page_corruption"] or {}
+        inject_page_corruption(session_id=p.get("session_id"),
+                               times=p.get("times", 1))
+        armed.append("page_corruption")
+    return armed
 
 
 # --------------------------------------------------------------------------

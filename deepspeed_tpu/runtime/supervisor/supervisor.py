@@ -66,6 +66,42 @@ def done_path(workdir, index):
     return os.path.join(workdir, f"done-p{int(index):05d}")
 
 
+def classify_exit(returncode, done_marker_exists):
+    """Failure cause for an exited worker, or None.
+
+    ``None`` while still running (``returncode is None``) or on a clean
+    completion (exit 0 WITH the done marker); exit 0 without the marker
+    reads as :data:`CAUSE_PREEMPTION`; any nonzero exit is
+    :data:`CAUSE_CRASH`. Shared by the training supervisor and the
+    serving fleet router (`inference/fleet.py`) so both sides of the
+    house classify process death identically."""
+    if returncode is None:
+        return None
+    if returncode == 0 and done_marker_exists:
+        return None
+    return CAUSE_PREEMPTION if returncode == 0 else CAUSE_CRASH
+
+
+def heartbeat_verdict(hb, now, hang_timeout_s=None,
+                      heartbeat_stale_s=None):
+    """:data:`CAUSE_HANG` when a live process's heartbeat says it is
+    stuck (``in_step`` past ``hang_timeout_s``) or has gone stale
+    (last write older than ``heartbeat_stale_s``); None otherwise.
+    ``hb`` is a parsed ``hb-p<idx>.json`` dict (or None = no verdict —
+    a worker that has not started reporting is covered by its exit
+    code, not its silence)."""
+    if hb is None:
+        return None
+    stuck = (hang_timeout_s is not None
+             and hb.get("in_step")
+             and float(hb.get("step_elapsed_s") or 0.0)
+             > float(hang_timeout_s))
+    stale = (heartbeat_stale_s is not None
+             and now - float(hb.get("t") or now)
+             > float(heartbeat_stale_s))
+    return CAUSE_HANG if (stuck or stale) else None
+
+
 class Supervisor:
     def __init__(self, argv, num_workers, workdir,
                  heartbeat_dir=None,
@@ -216,13 +252,15 @@ class Supervisor:
                 continue
             rc = slot.proc.poll() if slot.proc is not None else None
             if rc is not None:
-                if os.path.exists(done_path(self.workdir, slot.index)):
+                cause = classify_exit(
+                    rc, os.path.exists(done_path(self.workdir,
+                                                 slot.index)))
+                if cause is None:
                     slot.done = True
                     logger.info("ds_tpu_run: worker %d completed",
                                 slot.index)
                     continue
-                return ((CAUSE_PREEMPTION if rc == 0 else CAUSE_CRASH),
-                        slot)
+                return cause, slot
             hb = heartbeats.get(slot.pid)
             if hb is None:
                 continue   # not started reporting yet; exit code covers
@@ -231,15 +269,11 @@ class Supervisor:
                 if slot.last_step is not None and step > slot.last_step:
                     slot.consecutive_failures = 0
                 slot.last_step = step
-            stuck = (self.hang_timeout_s is not None
-                     and hb.get("in_step")
-                     and float(hb.get("step_elapsed_s") or 0.0)
-                     > float(self.hang_timeout_s))
-            stale = (self.heartbeat_stale_s is not None
-                     and now - float(hb.get("t") or now)
-                     > float(self.heartbeat_stale_s))
-            if stuck or stale:
-                return CAUSE_HANG, slot
+            cause = heartbeat_verdict(
+                hb, now, hang_timeout_s=self.hang_timeout_s,
+                heartbeat_stale_s=self.heartbeat_stale_s)
+            if cause is not None:
+                return cause, slot
         return None, None
 
     # ------------------------------------------------------------------

@@ -14,14 +14,21 @@ Five subcommands over the schema-versioned event log a run writes when
   scheduler, `inference/scheduler.py`) get a serve-mode summary
   instead: tokens/sec, per-token latency p50/p95/p99 (each token's
   latency is its decode step's host wall), mean batch occupancy, and
-  queue depth.
+  queue depth. Fleet logs (router events from `inference/router.py`)
+  add a fleet block: requests/completions by reason, replica deaths by
+  cause, redispatches, aborts, shed/defer backpressure, and
+  per-request latency percentiles.
 - ``ds_tpu_metrics tail LOG -n 20`` — the last N events, one line each.
 - ``ds_tpu_metrics diff A B`` — per-metric regression table between two
   runs; ``--fail-over PCT`` exits 1 when mean step time regressed more.
 - ``ds_tpu_metrics aggregate LOG...`` — merge per-host logs of ONE run
   (events carry ``process_index``/``hostname``), print the per-step
   cross-host skew table and the straggler ranking (mean wall excess
-  over the fastest host at each shared step).
+  over the fastest host at each shared step). Serving-fleet logs (one
+  per replica, plus the router's) aggregate into per-replica decode
+  throughput rows and the merged fleet block instead. A torn heartbeat
+  file (a replica killed mid-``os.replace``) gets one bounded re-read
+  retry before being reported as no-heartbeat.
 - ``ds_tpu_metrics postmortem DUMP`` — render a flight-recorder crash
   dump (`telemetry/flight.py`): what fired, the watchdog's verdict,
   every thread's in-flight phase path and stack, the last collective
@@ -106,14 +113,72 @@ def _wire_bytes_per_step(events):
     return None
 
 
+def _summarize_fleet(events):
+    """Fleet block: router-level serving events (`inference/router.py`
+    — replica deaths, drains/redispatches, aborts, shed/defer
+    backpressure, per-request latency). None when the log carries no
+    fleet events at all."""
+    kinds = {}
+    for e in events:
+        kinds.setdefault(e.get("event"), []).append(e)
+    done = (kinds.get("fleet_done") or [None])[-1] or {}
+    completes = kinds.get("request_complete", [])
+    deaths = kinds.get("replica_dead", [])
+    if not done and not (completes or deaths or
+                         kinds.get("fleet_redispatch")):
+        return None
+    lat = sorted(float(e["latency_s"]) for e in completes
+                 if e.get("latency_s") is not None)
+    reasons = {}
+    for e in completes:
+        r = e.get("finish_reason", "?")
+        reasons[r] = reasons.get(r, 0) + 1
+    causes = {}
+    for e in deaths:
+        c = e.get("cause", "?")
+        causes[c] = causes.get(c, 0) + 1
+    recover = [float(e["time_to_recover_s"])
+               for e in kinds.get("replica_recovered", [])
+               if e.get("time_to_recover_s")]
+    return {
+        "requests": done.get("requests", len(completes)),
+        "completions": len(completes) or done.get("completions", 0),
+        "finish_reasons": reasons,
+        "replicas": done.get("replicas"),
+        "replicas_dead": {
+            "count": len(deaths) or done.get("replicas_dead", 0),
+            "by_cause": causes,
+        },
+        "redispatched": len(kinds.get("fleet_redispatch", ()))
+        or done.get("redispatched_total", 0),
+        "aborted": len(kinds.get("request_aborted", ()))
+        or done.get("aborted", 0),
+        "shed": len(kinds.get("fleet_shed", ())) or done.get("shed", 0),
+        "defers": len(kinds.get("fleet_defer", ()))
+        or done.get("defers", 0),
+        "timeouts": len(kinds.get("request_timeout", ()))
+        or done.get("timeouts", 0),
+        "request_latency_s": {
+            "p50": _percentile(lat, 0.50),
+            "p95": _percentile(lat, 0.95),
+            "p99": _percentile(lat, 0.99),
+            "max": lat[-1] if lat else None,
+        },
+        "mean_time_to_recover_s": (sum(recover) / len(recover))
+        if recover else None,
+        "ok": done.get("ok"),
+    }
+
+
 def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
     """Aggregate a run's events into the summary dict. None when the
     log holds neither step events nor resilience events (a supervisor's
     log is all restarts and recoveries — still worth a summary)."""
     steps = [e for e in events if e.get("event") == "step"]
     decode = [e for e in events if e.get("event") == "decode_step"]
-    if not steps and decode:
-        return _summarize_serve(decode)
+    fleet = _summarize_fleet(events)
+    if not steps and (decode or fleet):
+        return _summarize_serve(decode, fleet=fleet)
     if not steps and not any(
             e.get("event") in ("restart", "recovery_ladder",
                                "checkpoint_fallback", "supervisor_done")
@@ -211,7 +276,7 @@ def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
     }
 
 
-def _summarize_serve(decode):
+def _summarize_serve(decode, fleet=None):
     """Serve-mode summary over ``decode_step`` events. Per-token latency
     samples: every token a decode step produced experienced that step's
     host wall, so the sample list is each step's wall repeated
@@ -294,6 +359,7 @@ def _summarize_serve(decode):
             "max": max(qd) if qd else None,
         },
         "paging": paging,
+        "fleet": fleet,
         "mfu": None,
     }
 
@@ -343,6 +409,30 @@ def print_serve_summary(s, out=None):
               f"misses (hit rate {rate}), sessions admitted "
               f"{pg['sessions_admitted']}, parked to host "
               f"{pg['sessions_parked_host']}", file=out)
+    if s.get("fleet"):
+        print_fleet_block(s["fleet"], out=out)
+
+
+def print_fleet_block(fl, out=None):
+    rd = fl["replicas_dead"]
+    causes = ", ".join(f"{k}={v}" for k, v in
+                       sorted(rd["by_cause"].items())) or "none"
+    reasons = ", ".join(f"{k}={v}" for k, v in
+                        sorted(fl["finish_reasons"].items())) or "-"
+    print(f"  fleet: {fl['requests']} request(s) -> "
+          f"{fl['completions']} completion(s) [{reasons}], "
+          f"{fl['redispatched']} redispatch(es), {fl['aborted']} "
+          f"aborted, {fl['shed']} shed, {fl['timeouts']} timeout(s), "
+          f"{fl['defers']} defer episode(s)", file=out)
+    ttr = fl["mean_time_to_recover_s"]
+    print(f"  fleet replicas: {fl['replicas'] or '?'} total, "
+          f"{rd['count']} dead [{causes}]"
+          + (f", mean recover {_fmt_s(ttr)}" if ttr else ""), file=out)
+    rl = fl["request_latency_s"]
+    if rl["p50"] is not None:
+        print(f"  fleet request latency p50 {_fmt_s(rl['p50'])} "
+              f"p95 {_fmt_s(rl['p95'])} p99 {_fmt_s(rl['p99'])} "
+              f"max {_fmt_s(rl['max'])}", file=out)
 
 
 def print_summary(s, out=None):
@@ -502,21 +592,40 @@ def aggregate(logs, no_heartbeat=()):
     """
     hosts = [dict(row) for row in no_heartbeat]
     per_step = {}
+    serve_hosts = []
+    all_events = []
     for label, events in logs:
+        all_events.extend(events)
+        decode = [e for e in events if e.get("event") == "decode_step"
+                  and e.get("wall_s") is not None]
+        if decode:
+            d_walls = [float(e["wall_s"]) for e in decode]
+            toks = sum(int(e.get("tokens") or 0) for e in decode)
+            serve_hosts.append({
+                "host": label,
+                "decode_steps": len(decode),
+                "tokens": toks,
+                "tokens_per_s": (toks / sum(d_walls))
+                if sum(d_walls) and toks else None,
+                "last_step": decode[-1].get("step"),
+            })
         steps = [e for e in events if e.get("event") == "step"
                  and e.get("wall_s") is not None]
-        walls = [float(e["wall_s"]) for e in steps]
-        hosts.append({
-            "host": label,
-            "steps": len(steps),
-            "mean_wall_s": sum(walls) / len(walls) if walls else None,
-            "last_step": steps[-1].get("step") if steps else None,
-        })
+        if steps or not decode:
+            walls = [float(e["wall_s"]) for e in steps]
+            hosts.append({
+                "host": label,
+                "steps": len(steps),
+                "mean_wall_s": sum(walls) / len(walls) if walls else None,
+                "last_step": steps[-1].get("step") if steps else None,
+            })
         for e in steps:
             per_step.setdefault(int(e.get("step", -1)),
                                 {})[label] = float(e["wall_s"])
+    fleet = _summarize_fleet(all_events)
     shared = {s: w for s, w in per_step.items() if len(w) >= 2}
-    if not shared and not no_heartbeat:
+    if not shared and not no_heartbeat and not serve_hosts \
+            and fleet is None:
         return None
     step_rows = []
     excess = {h["host"]: [] for h in hosts}
@@ -538,11 +647,13 @@ def aggregate(logs, no_heartbeat=()):
                for label, ex in excess.items() if ex]
     ranking.sort(key=lambda r: -r["mean_excess_s"])
     return {"schema": SCHEMA_VERSION, "hosts": hosts,
-            "steps": step_rows, "straggler_ranking": ranking}
+            "steps": step_rows, "straggler_ranking": ranking,
+            "serve_hosts": serve_hosts, "fleet": fleet}
 
 
 def print_aggregate(agg, n_steps=10, out=None):
-    print(f"cross-host aggregation ({len(agg['hosts'])} host logs, "
+    n_logs = len(agg["hosts"]) + len(agg.get("serve_hosts") or ())
+    print(f"cross-host aggregation ({n_logs} host logs, "
           f"schema {agg['schema']})", file=out)
     for h in agg["hosts"]:
         if h.get("status") == "no-heartbeat":
@@ -562,15 +673,26 @@ def print_aggregate(agg, n_steps=10, out=None):
                              for label, w in sorted(r["walls"].items()))
             print(f"    step {r['step']:>6d}  skew {_fmt_s(r['skew_s']):>9s}"
                   f"  slowest {r['slowest']}  [{walls}]", file=out)
-    print("  straggler ranking (mean wall excess over the fastest host "
-          "per shared step):", file=out)
-    for i, r in enumerate(agg["straggler_ranking"], start=1):
-        print(f"    {i}. {r['host']:<24s} +{_fmt_s(r['mean_excess_s'])} "
-              f"mean excess, slowest on {r['slowest_steps']}/"
-              f"{r['shared_steps']} steps", file=out)
-    top = agg["straggler_ranking"][0] if agg["straggler_ranking"] else None
-    if top and top["mean_excess_s"] > 0:
-        print(f"  => straggler: {top['host']}", file=out)
+    if agg["steps"] or agg["straggler_ranking"]:
+        print("  straggler ranking (mean wall excess over the fastest "
+              "host per shared step):", file=out)
+        for i, r in enumerate(agg["straggler_ranking"], start=1):
+            print(f"    {i}. {r['host']:<24s} "
+                  f"+{_fmt_s(r['mean_excess_s'])} "
+                  f"mean excess, slowest on {r['slowest_steps']}/"
+                  f"{r['shared_steps']} steps", file=out)
+        top = agg["straggler_ranking"][0] \
+            if agg["straggler_ranking"] else None
+        if top and top["mean_excess_s"] > 0:
+            print(f"  => straggler: {top['host']}", file=out)
+    for h in agg.get("serve_hosts") or ():
+        tps = (f"{h['tokens_per_s']:,.1f} tokens/s"
+               if h["tokens_per_s"] else "-")
+        print(f"  replica {h['host']:<22s} {h['decode_steps']} decode "
+              f"step(s), {h['tokens']} tokens, {tps}, last step "
+              f"{h['last_step']}", file=out)
+    if agg.get("fleet"):
+        print_fleet_block(agg["fleet"], out=out)
 
 
 # ---------------------------------------------------------------------------

@@ -29,6 +29,9 @@ import sys
 DURABLE_EVENTS = frozenset({
     "run_start", "health_guard", "recompile", "preemption", "watchdog",
     "anomaly", "restart", "recovery_ladder", "checkpoint_fallback",
+    # serving fleet (ISSUE 17): replica deaths and aborted requests are
+    # exactly the events a post-incident aggregate must not lose
+    "replica_dead", "request_aborted", "scheduler_incomplete",
 })
 
 

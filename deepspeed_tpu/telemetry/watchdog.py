@@ -60,15 +60,41 @@ def _hb_index(name):
         return None
 
 
+# One bounded re-read before a heartbeat file is classified as
+# unparseable. The writer is tmp+os.replace atomic, but a reader racing
+# a slow replace (or a file torn by a mid-write kill that a healthy
+# watchdog is about to overwrite) can observe truncated JSON once; a
+# single retry separates "torn right now" from "torn forever" without
+# letting a truly corrupt file stall the scan. ``_retry_sleep`` is a
+# module hook so tests can repair/observe the file between the reads.
+_TORN_RETRY_SLEEP_S = 0.05
+_retry_sleep = time.sleep
+
+
+def _read_heartbeat_file(path):
+    """Parse one heartbeat file with one bounded re-read retry; None
+    when both attempts fail."""
+    for attempt in (0, 1):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            if attempt == 0:
+                _retry_sleep(_TORN_RETRY_SLEEP_S)
+    return None
+
+
 def scan_heartbeats(directory, expected_count=None):
     """``(heartbeats, no_heartbeat)`` for ``directory``.
 
     ``heartbeats`` is every parseable per-process heartbeat file.
     ``no_heartbeat`` lists the processes that SHOULD have reported but
     did not — a half-written file (killed mid-``json.dump``, though the
-    writer's tmp+``os.replace`` makes that rare), or, with
-    ``expected_count``, an index in ``range(expected_count)`` with no
-    file at all (the process died before its watchdog ever wrote).
+    writer's tmp+``os.replace`` makes that rare; each file gets one
+    bounded re-read via :func:`_read_heartbeat_file` before the
+    ``unparseable`` verdict sticks), or, with ``expected_count``, an
+    index in ``range(expected_count)`` with no file at all (the process
+    died before its watchdog ever wrote).
     Each entry is ``{"process_index", "status": "no-heartbeat",
     "reason": "missing"|"unparseable"}`` — JSON-safe, so consumers
     (``classify``, ``ds_tpu_metrics``, the supervisor) can report the
@@ -85,10 +111,8 @@ def scan_heartbeats(directory, expected_count=None):
         if not (name.startswith(_HB_PREFIX) and name.endswith(".json")):
             continue
         idx = _hb_index(name)
-        try:
-            with open(os.path.join(directory, name)) as f:
-                hb = json.load(f)
-        except (OSError, ValueError):
+        hb = _read_heartbeat_file(os.path.join(directory, name))
+        if hb is None:
             if idx is not None:
                 unparseable.add(idx)
             continue

@@ -1,0 +1,190 @@
+"""End-to-end serving fleet soak (resilience PR acceptance): replica
+deaths mid-stream → drain → redispatch → token-identical completions.
+
+Each scenario runs a REAL two-replica process fleet — subprocess
+workers (`inference/fleet_worker.py`) under the ``ds_tpu_run`` env
+contract, driven by `inference/router.py:FleetRouter` — and checks:
+
+- an injected SIGKILL in one replica's decode loop (the
+  ``inject_kill("decode_step")`` serving seam) is classified as a
+  crash; its in-flight requests drain back to the router and
+  redispatch; EVERY request still completes, with tokens BIT-EXACT
+  against an uninterrupted single-engine oracle run (greedy decode is
+  request-local deterministic, so at-least-once execution surfaces as
+  exactly-once completion);
+- the surviving replica honours the 2-compile contract
+  (``{"prefill": 1, "decode": 1}``) — redispatched re-prefills reuse
+  the same compiled entry points;
+- SIGTERM mid-decode (cloud preemption) lets the worker finish the
+  current step, emit a durable ``preemption`` telemetry event, report
+  completed-so-far, and exit 0 WITHOUT its done marker — which the
+  router's ``classify_exit`` reads as a preemption, not a crash.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.runtime.supervisor import (
+    CAUSE_CRASH,
+    CAUSE_PREEMPTION,
+)
+from deepspeed_tpu.runtime.supervisor.supervisor import done_path
+from deepspeed_tpu.telemetry.watchdog import heartbeat_path
+
+# slow: each scenario boots two jax subprocess workers (engine build +
+# compile warmup per replica) plus an in-process oracle engine — the
+# CI fleet-smoke / slow lane, not the per-commit fast lane.
+pytestmark = [pytest.mark.model, pytest.mark.faultinject,
+              pytest.mark.slow]
+
+# One engine recipe everywhere — fleet workers and the oracle must
+# build byte-identical engines for the token-identity check to mean
+# anything. seq_buckets as a list: the spec travels through JSON.
+INF_CFG = {"max_batch": 2, "seq_buckets": [16, 32], "prefill_chunk": 4,
+           "temperature": 0.0}
+SPEC = {"seed": 0, "scan_layers": False, "inf_cfg": INF_CFG}
+
+
+def _requests(n=4, max_new=8):
+    from deepspeed_tpu.inference.scheduler import Request
+    reqs = []
+    for i in range(n):
+        prompt = [(7 * i + 3 * j + 1) % 256 for j in range(3 + i)]
+        reqs.append(Request(rid=f"s{i}", prompt=prompt,
+                            max_new_tokens=max_new))
+    return reqs
+
+
+def _oracle_tokens(requests):
+    """Uninterrupted single-engine run: rid -> greedy token list."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler)
+    from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
+
+    cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32, scan_layers=False)
+    model = GPT2LMHead(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(SPEC["seed"]), toks)["params"]
+    engine = InferenceEngine(model, params, config=dict(INF_CFG))
+    comps = ContinuousBatchingScheduler(engine).run(requests)
+    return {c.rid: list(c.tokens) for c in comps}
+
+
+def _start_fleet(workdir, inject=None, inject_replica=0):
+    """Two ProcessReplicas with per-replica telemetry jsonl files."""
+    from deepspeed_tpu.inference.fleet import ProcessReplica
+    replicas = []
+    for i in range(2):
+        rspec = dict(SPEC, jsonl=os.path.join(workdir,
+                                              f"replica{i}.jsonl"))
+        replicas.append(ProcessReplica(
+            i, rspec, workdir, num_replicas=2,
+            inject=inject if i == inject_replica else None).start())
+    for r in replicas:
+        r.wait_ready(timeout=180.0)
+    return replicas
+
+
+def _events(jsonl_path):
+    return [json.loads(line) for line in open(jsonl_path)
+            if line.strip()]
+
+
+def test_sigkill_midstream_drains_redispatches_token_identical(tmp_path):
+    """Kill one of two replicas mid-decode (armed SIGKILL seam): every
+    request completes, redispatched ones token-identical to the oracle,
+    survivor stays within the 2-compile contract."""
+    from deepspeed_tpu.inference.router import FleetRouter
+    workdir = str(tmp_path)
+    replicas = _start_fleet(
+        workdir, inject={"kill": {"op": "decode_step", "at_step": 3}})
+    router = FleetRouter(replicas, backoff_base_s=0.01)
+    result = router.run(_requests(), timeout_s=240.0)
+
+    assert result.ok, [c["finish_reason"] for c in result.completions]
+    assert result.replicas_dead == 1
+    assert router.dead == {0: CAUSE_CRASH}
+    assert result.redispatched_total >= 1
+
+    # the drained requests record their retry history
+    redone = [c for c in result.completions if c["redispatched"]]
+    assert redone
+    assert all(c["restarts"] >= 1 and c["replica"] == 1
+               for c in redone)
+
+    # 2-compile contract on the surviving replica: redispatched
+    # re-prefills reuse the same compiled prefill/decode entry points
+    assert len(result.stats) == 1
+    survivor = result.stats[0]
+    assert survivor["replica"] == 1
+    assert survivor["compile_counts"] == {"prefill": 1, "decode": 1}
+
+    # token identity: at-least-once execution, exactly-once completion,
+    # bit-exact with an uninterrupted single-engine run
+    oracle = _oracle_tokens(_requests())
+    got = {c["rid"]: c["tokens"] for c in result.completions}
+    assert got == oracle
+
+
+def test_sigterm_preemption_finishes_step_and_exits_clean(tmp_path):
+    """SIGTERM one replica mid-decode: durable ``preemption`` event,
+    completed-so-far reported, exit 0 without the done marker (the
+    preemption signature), and the fleet still completes everything."""
+    from deepspeed_tpu.inference.router import FleetRouter
+    workdir = str(tmp_path)
+    replicas = _start_fleet(workdir)
+
+    # SIGTERM replica 0 once its heartbeat shows real decode progress —
+    # "mid-decode" by construction, not by sleeping and hoping.
+    hb_file = heartbeat_path(workdir, 0)
+
+    def _terminate_when_decoding():
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            try:
+                with open(hb_file) as f:
+                    if json.load(f).get("step", 0) >= 1:
+                        break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.01)
+        replicas[0].terminate()
+
+    watcher = threading.Thread(target=_terminate_when_decoding,
+                               daemon=True)
+    watcher.start()
+    router = FleetRouter(replicas, backoff_base_s=0.01)
+    result = router.run(_requests(n=4, max_new=24), timeout_s=240.0)
+    watcher.join(timeout=10.0)
+
+    assert result.ok, [c["finish_reason"] for c in result.completions]
+    assert router.dead == {0: CAUSE_PREEMPTION}
+    assert result.redispatched_total >= 1
+
+    # the preemption signature: exit 0, NO done marker
+    assert replicas[0].proc.returncode == 0
+    assert not os.path.exists(done_path(workdir, 0))
+
+    # the worker flushed a durable preemption event before exiting
+    pre = [e for e in _events(os.path.join(workdir, "replica0.jsonl"))
+           if e.get("event") == "preemption"]
+    assert pre
+    assert pre[-1]["replica"] == 0
+    assert pre[-1]["completed"] >= 0
+
+    # ...and reported completed-so-far over the pipe on its way out
+    assert replicas[0]._stats is not None
+    assert replicas[0]._stats["type"] == "preempted"
+    assert replicas[0]._stats["completed"] >= 0
+
+    # preempted work still lands token-identical on the survivor
+    oracle = _oracle_tokens(_requests(n=4, max_new=24))
+    got = {c["rid"]: c["tokens"] for c in result.completions}
+    assert got == oracle

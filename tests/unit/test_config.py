@@ -313,3 +313,36 @@ def test_inference_config_validation():
     bad({"top_p": 1.5}, "top_p")
     bad({"sampling_seed": "abc"}, "sampling_seed")
     bad({"max_batc": 4}, "unknown key")
+
+
+def test_inference_fleet_config_defaults_and_block():
+    cfg = make_config({"train_batch_size": 16})
+    inf = cfg.inference
+    assert inf.replicas == 1
+    assert inf.max_redispatch == 2
+    assert inf.max_queue_depth == 8
+    assert inf.deadline_s == 0.0        # 0 = disabled
+    assert inf.queue_timeout_s == 0.0
+
+    cfg = make_config({
+        "train_batch_size": 16,
+        "inference": {"replicas": 3, "max_redispatch": 1,
+                      "max_queue_depth": 4, "deadline_s": 2.5,
+                      "queue_timeout_s": 0.5}})
+    inf = cfg.inference
+    assert (inf.replicas, inf.max_redispatch, inf.max_queue_depth,
+            inf.deadline_s, inf.queue_timeout_s) == (3, 1, 4, 2.5, 0.5)
+
+
+def test_inference_fleet_config_validation():
+    def bad(block, match):
+        with pytest.raises(ValueError, match=match):
+            make_config({"train_batch_size": 16, "inference": block})
+
+    bad({"replicas": 0}, "replicas")
+    bad({"replicas": True}, "replicas")           # bools are not counts
+    bad({"max_redispatch": -1}, "max_redispatch")
+    bad({"max_queue_depth": 0}, "max_queue_depth")
+    bad({"deadline_s": -1.0}, "deadline_s")
+    bad({"deadline_s": True}, "deadline_s")
+    bad({"queue_timeout_s": -0.5}, "queue_timeout_s")

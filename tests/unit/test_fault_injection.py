@@ -308,3 +308,71 @@ class TestHardKillFaults:
             assert hits == [signal.SIGUSR1]
         finally:
             signal.signal(signal.SIGUSR1, prev)
+
+
+class TestServingSeams:
+    """The ISSUE 17 serving seams: decode-step exceptions, host page
+    corruption, heartbeat stalls, and env-var arming for subprocess
+    replicas (``DS_TPU_SERVE_INJECT``)."""
+
+    def test_decode_exception_fires_at_step_then_disarms(
+            self, fault_registry):
+        from deepspeed_tpu.runtime.resilience.fault_injection import (
+            InjectedDecodeError)
+        fault_registry.inject_decode_exception(at_step=3)
+        fault_registry.maybe_fail_decode(2)             # not yet
+        with pytest.raises(InjectedDecodeError):
+            fault_registry.maybe_fail_decode(3)
+        fault_registry.maybe_fail_decode(4)             # one-shot
+
+    def test_decode_exception_raises_through_scheduler(
+            self, fault_registry):
+        from deepspeed_tpu.inference.scheduler import (
+            ContinuousBatchingScheduler, Request)
+        from deepspeed_tpu.runtime.resilience.fault_injection import (
+            InjectedDecodeError)
+        from tests.unit.test_inference_engine import StubEngine
+        fault_registry.inject_decode_exception(at_step=1)
+        sched = ContinuousBatchingScheduler(StubEngine())
+        with pytest.raises(InjectedDecodeError):
+            sched.run([Request("a", [1, 2], max_new_tokens=8)])
+
+    def test_page_corruption_filters_by_session(self, fault_registry):
+        fault_registry.inject_page_corruption(session_id="s1")
+        assert not fault_registry.corrupt_host_pages("other")
+        assert fault_registry.corrupt_host_pages("s1")
+        assert not fault_registry.corrupt_host_pages("s1")  # one-shot
+
+    def test_page_corruption_any_session(self, fault_registry):
+        fault_registry.inject_page_corruption(times=2)
+        assert fault_registry.corrupt_host_pages("a")
+        assert fault_registry.corrupt_host_pages("b")
+        assert not fault_registry.corrupt_host_pages("c")
+
+    def test_heartbeat_stall_is_one_shot(self, fault_registry):
+        fault_registry.inject_heartbeat_stall(at_step=5, seconds=9.0)
+        assert fault_registry.heartbeat_stall_seconds(4) == 0.0
+        assert fault_registry.heartbeat_stall_seconds(5) == 9.0
+        assert fault_registry.heartbeat_stall_seconds(6) == 0.0
+
+    def test_arm_from_env_parses_every_seam(self, fault_registry):
+        import json as _json
+        from deepspeed_tpu.runtime.resilience.fault_injection import (
+            INJECT_ENV)
+        env = {INJECT_ENV: _json.dumps({
+            "decode_exception": {"at_step": 2},
+            "heartbeat_stall": {"at_step": 1, "seconds": 3.0},
+            "page_corruption": {"session_id": "s"},
+        })}
+        armed = fault_registry.arm_from_env(env=env)
+        assert set(armed) == {"decode_exception", "heartbeat_stall",
+                              "page_corruption"}
+        assert fault_registry.heartbeat_stall_seconds(1) == 3.0
+        assert fault_registry.corrupt_host_pages("s")
+
+    def test_arm_from_env_absent_is_inert(self, fault_registry):
+        assert fault_registry.arm_from_env(env={}) == []
+
+    def test_kill_accepts_decode_step_op(self, fault_registry):
+        fault_registry.inject_kill("decode_step", at_step=3)
+        fault_registry.maybe_kill("step", step=3)       # wrong op: inert

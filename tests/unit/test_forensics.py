@@ -540,3 +540,43 @@ def test_classify_blames_silent_peer_first(tmp_path):
     assert stragglers[0]["step"] is None
     assert stragglers[1]["process_index"] == 1
     assert stragglers[1]["behind_steps"] == 1
+
+
+def test_torn_heartbeat_gets_one_bounded_reread(tmp_path, monkeypatch):
+    """A reader racing the writer's ``os.replace`` sees truncated JSON
+    once; the single retry must recover it without stalling on a file
+    that is torn forever."""
+    from deepspeed_tpu.telemetry import watchdog as wd
+    path = heartbeat_path(tmp_path, 0)
+    with open(path, "w") as f:
+        f.write('{"t": 123.4, "process_ind')        # torn mid-write
+
+    sleeps = []
+
+    def repair(seconds):
+        # the writer finishes its atomic replace during the backoff
+        sleeps.append(seconds)
+        with open(path, "w") as f:
+            json.dump({"t": 123.4, "process_index": 0, "step": 7}, f)
+
+    monkeypatch.setattr(wd, "_retry_sleep", repair)
+    heartbeats, no_heartbeat = scan_heartbeats(str(tmp_path),
+                                               expected_count=1)
+    assert sleeps == [wd._TORN_RETRY_SLEEP_S]       # exactly one retry
+    assert [hb["step"] for hb in heartbeats] == [7]
+    assert no_heartbeat == []
+
+
+def test_torn_forever_heartbeat_retries_once_then_reports(
+        tmp_path, monkeypatch):
+    from deepspeed_tpu.telemetry import watchdog as wd
+    with open(heartbeat_path(tmp_path, 0), "w") as f:
+        f.write('{"t": 123.4, "process_ind')
+    sleeps = []
+    monkeypatch.setattr(wd, "_retry_sleep", sleeps.append)
+    heartbeats, no_heartbeat = scan_heartbeats(str(tmp_path),
+                                               expected_count=1)
+    assert len(sleeps) == 1                         # bounded: no loop
+    assert heartbeats == []
+    assert [(g["process_index"], g["reason"]) for g in no_heartbeat] \
+        == [(0, "unparseable")]

@@ -295,3 +295,85 @@ def test_serve_summary_json_math(tmp_path):
     slower = write_serve_log(tmp_path / "b.jsonl", wall_s=0.03)
     proc = run_cli("diff", str(log), str(slower), check=False)
     assert "step_s.mean" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# fleet block: router events in summary and aggregate (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def write_fleet_log(path):
+    """A fleet router log shaped exactly like
+    `inference/router.py:FleetRouter._emit` writes it."""
+    session = TelemetrySession(exporters=[JsonlExporter(str(path))])
+    session.emit("fleet_dispatch", rid="a", replica=0, redispatched=0,
+                 queue_depth=1)
+    session.emit("replica_dead", replica=0, cause="crash", in_flight=1)
+    session.emit("fleet_redispatch", rid="a", from_replica=0,
+                 redispatched=1, backoff_s=0.05)
+    session.emit("replica_recovered", replica=0,
+                 time_to_recover_s=0.25, redispatched=1)
+    session.emit("request_complete", rid="a", replica=1,
+                 finish_reason="max_new_tokens", tokens=8,
+                 latency_s=1.5, redispatched=1, restarts=1)
+    session.emit("request_complete", rid="b", replica=1,
+                 finish_reason="max_new_tokens", tokens=8,
+                 latency_s=0.5, redispatched=0, restarts=0)
+    session.emit("fleet_done", ok=True, requests=2, completions=2,
+                 replicas=2, replicas_dead=1, dead_causes={"0": "crash"},
+                 redispatched_total=1, aborted=0, shed=0, defers=0,
+                 timeouts=0, latency_p99_s=1.5)
+    session.close()
+    return path
+
+
+def test_fleet_summary_text_and_json(tmp_path):
+    log = write_fleet_log(tmp_path / "router.jsonl")
+    proc = run_cli("summary", str(log))
+    out = proc.stdout
+    assert "fleet: 2 request(s) -> 2 completion(s)" in out
+    assert "1 redispatch(es)" in out
+    assert "1 dead [crash=1]" in out
+    assert "mean recover" in out
+
+    s = json.loads(run_cli("summary", str(log), "--json").stdout)
+    fl = s["fleet"]
+    assert fl["requests"] == 2 and fl["completions"] == 2
+    assert fl["redispatched"] == 1 and fl["aborted"] == 0
+    assert fl["replicas_dead"] == {"count": 1, "by_cause": {"crash": 1}}
+    assert fl["request_latency_s"]["max"] == pytest.approx(1.5)
+    assert fl["mean_time_to_recover_s"] == pytest.approx(0.25)
+    assert fl["ok"] is True
+
+
+def test_fleet_aggregate_merges_replica_and_router_logs(tmp_path):
+    router = write_fleet_log(tmp_path / "router.jsonl")
+    r0 = write_serve_log(tmp_path / "replica0.jsonl", steps=3)
+    r1 = write_serve_log(tmp_path / "replica1.jsonl", steps=9)
+    proc = run_cli("aggregate", str(router), str(r0), str(r1))
+    out = proc.stdout
+    assert "replica" in out and "decode step(s)" in out
+    assert "fleet: 2 request(s)" in out
+
+    agg = json.loads(run_cli("aggregate", str(router), str(r0), str(r1),
+                             "--json").stdout)
+    assert len(agg["serve_hosts"]) == 2
+    assert agg["fleet"]["redispatched"] == 1
+
+
+def test_fleet_aggregate_torn_heartbeat_fixture(tmp_path):
+    """Regression: a replica SIGKILLed mid-heartbeat-write leaves
+    truncated JSON; aggregate must retry the read once, then report the
+    replica as no-heartbeat — never crash, never block the report."""
+    from deepspeed_tpu.telemetry.watchdog import heartbeat_path
+    r1 = write_serve_log(tmp_path / "replica1.jsonl", steps=9)
+    hb_dir = tmp_path / "hb"
+    hb_dir.mkdir()
+    with open(heartbeat_path(hb_dir, 1), "w") as f:
+        json.dump({"t": 1.0, "process_index": 1, "step": 9,
+                   "phase": "serve", "in_step": False}, f)
+    with open(heartbeat_path(hb_dir, 0), "w") as f:
+        f.write('{"t": 123.4, "process_ind')        # torn forever
+    proc = run_cli("aggregate", str(r1), "--heartbeats", str(hb_dir),
+                   "--expect-hosts", "2")
+    assert "NO HEARTBEAT" in proc.stdout
+    assert "unparseable" in proc.stdout

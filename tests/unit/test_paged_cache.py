@@ -435,3 +435,49 @@ class TestRuleDecodePaged:
         ctx = StepContext(hlo_text="%of = token[] outfeed(f32[2] %x)",
                           decode_kv_layout="ring")
         assert rule_decode(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# host page corruption: typed error + drop-and-re-prefill recovery
+# ---------------------------------------------------------------------------
+
+class TestHostPageCorruption:
+    def test_take_raises_typed_error_and_drops_snapshot(
+            self, fault_registry):
+        from deepspeed_tpu.inference.paging import HostPageCorruptError
+        store = HostPageStore()
+        fault_registry.inject_page_corruption(session_id="s0")
+        store.park("s0", {"k": np.zeros((2, 2), np.float32)})
+        with pytest.raises(HostPageCorruptError) as exc:
+            store.take("s0")
+        assert exc.value.session_id == "s0"
+        assert exc.value.bad_leaves
+        # rotted bytes are useless to every future caller: popped
+        assert "s0" not in store
+
+    def test_manager_recovers_with_cold_reprefill(self, fault_registry):
+        eng, mgr = _mgr(n_pages=8, host_park_threshold=0.9)
+        prompt = list(range(8))
+        row = mgr.admit(prompt, session_id="s")
+        fault_registry.inject_page_corruption(session_id="s")
+        # threshold 0.9: release evacuates to the host tier, where the
+        # armed fault rots one byte AFTER the CRCs were stamped
+        mgr.release(row, kv_tokens=prompt, session_id="s")
+        assert mgr.facts()["sessions_parked_host"] == 1
+
+        r2 = mgr.admit(prompt + [9], session_id="s")
+        # the engine did NOT crash: the session fell back to a cold
+        # admission (full re-prefill from the prompt), counter bumped
+        assert r2 is not None
+        assert not r2.resumed and r2.start == 0
+        assert mgr.host_pages_corrupt == 1
+        assert mgr.facts()["host_pages_corrupt"] == 1
+        assert mgr.facts()["sessions_parked_host"] == 0
+
+    def test_unfaulted_round_trip_still_clean(self, fault_registry):
+        eng, mgr = _mgr(n_pages=8, host_park_threshold=0.9)
+        prompt = list(range(8))
+        row = mgr.admit(prompt, session_id="s")
+        mgr.release(row, kv_tokens=prompt, session_id="s")
+        r2 = mgr.admit(prompt + [9], session_id="s")
+        assert r2.resumed and mgr.host_pages_corrupt == 0

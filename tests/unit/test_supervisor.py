@@ -172,3 +172,41 @@ done()
         assert ev["failed_index"] == 0
         assert ev["time_to_recover_s"] >= 0
         assert by_type["supervisor_done"][0]["success"] is True
+
+
+class TestClassifierFunctions:
+    """The module-level ``classify_exit``/``heartbeat_verdict`` the
+    serving fleet router reuses (ISSUE 17): one failure vocabulary for
+    training workers and serving replicas."""
+
+    def test_classify_exit_vocabulary(self):
+        from deepspeed_tpu.runtime.supervisor.supervisor import (
+            classify_exit)
+        from deepspeed_tpu.runtime.supervisor.state import (
+            CAUSE_CRASH, CAUSE_PREEMPTION)
+        assert classify_exit(None, False) is None       # still running
+        assert classify_exit(0, True) is None           # clean exit
+        assert classify_exit(0, False) == CAUSE_PREEMPTION
+        assert classify_exit(1, False) == CAUSE_CRASH
+        assert classify_exit(-9, False) == CAUSE_CRASH  # SIGKILL
+        assert classify_exit(-9, True) == CAUSE_CRASH   # marker moot
+
+    def test_heartbeat_verdict_hang_and_staleness(self):
+        import time as _time
+        from deepspeed_tpu.runtime.supervisor.supervisor import (
+            heartbeat_verdict)
+        from deepspeed_tpu.runtime.supervisor.state import CAUSE_HANG
+        now = _time.time()
+        fresh_busy = {"t": now, "in_step": True,
+                      "step_elapsed_s": 100.0}
+        assert heartbeat_verdict(fresh_busy, now,
+                                 hang_timeout_s=10.0) == CAUSE_HANG
+        assert heartbeat_verdict(
+            dict(fresh_busy, step_elapsed_s=1.0), now,
+            hang_timeout_s=10.0) is None
+        stale = {"t": now - 60.0, "in_step": False}
+        assert heartbeat_verdict(stale, now,
+                                 heartbeat_stale_s=5.0) == CAUSE_HANG
+        assert heartbeat_verdict(stale, now) is None    # not armed
+        assert heartbeat_verdict(None, now, hang_timeout_s=1.0,
+                                 heartbeat_stale_s=1.0) is None
