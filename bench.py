@@ -765,6 +765,65 @@ def paged_ab():
             all(ring_by_rid[c.rid] == c.tokens for c in comps)}
 
 
+def speculative_ab():
+    # speculative-vs-plain serving A/B over the SAME greedy stream:
+    # the pinned 3-program compile contract (prefill + draft + verify,
+    # plain decode at zero entries), the draft-program flop ratio vs
+    # the full-depth decode step (~draft_layers/n_layer — truncation
+    # is real, not renamed), accepted tokens per verify round, and
+    # bit-exact greedy parity with the non-speculative oracle.
+    cfg = gpt2_tiny(n_embd=32, n_layer=4, dtype=jnp.float32)
+    model = GPT2LMHead(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def stream():
+        r = np.random.default_rng(1)
+        return [Request(f"r{i}",
+                        r.integers(0, cfg.vocab_size,
+                                   int(r.integers(2, 20))).tolist(),
+                        max_new_tokens=6)
+                for i in range(6)]
+
+    base = {"max_batch": 2, "seq_buckets": (16, 32),
+            "prefill_chunk": 4}
+    plain_sched = ContinuousBatchingScheduler(
+        InferenceEngine(model, params, config=base))
+    plain_comps = plain_sched.run(stream())
+    eng = InferenceEngine(model, params, config=dict(
+        base, speculative={"enabled": True, "k": 3,
+                           "draft_layers": 1}))
+    sched = ContinuousBatchingScheduler(eng)
+    comps = sched.run(stream())
+    spec = eng.speculative
+
+    def flops(fn, args):
+        try:
+            ca = fn.lower(*args).compile().cost_analysis()
+        except Exception:
+            return 0.0
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float((ca or {}).get("flops", 0.0) or 0.0)
+
+    draft_fl = flops(spec._draft, spec.draft_lowering_args())
+    full_fl = flops(eng._decode, eng.decode_lowering_args())
+    plain_by_rid = {c.rid: c.tokens for c in plain_comps}
+    sf = spec.facts()
+    cc = eng.compile_counts()
+    return {
+        "compile_counts": cc,
+        "total_compiles": sum(v for v in cc.values() if v),
+        "draft_flops_ratio": draft_fl / max(full_fl, 1.0),
+        "expected_flops_ratio": sf["draft_layers"] / sf["n_layer"],
+        "mean_accepted": sf["mean_accepted"],
+        "draft_efficiency": sf["draft_efficiency"],
+        "decode_steps_plain": plain_sched.step_count,
+        "verify_rounds_speculative": sf["rounds"],
+        "greedy_outputs_match":
+            all(plain_by_rid[c.rid] == c.tokens for c in comps)}
+
+
 plain = facts(None)
 quant = facts("int8")
 tp = facts(None, mesh=build_mesh({"model": 4},
@@ -779,6 +838,7 @@ out = {"n_devices": len(jax.devices()),
        "paged_flash_int8": paged_flash_int8,
        "flash_ab": [flash_ab(512), flash_ab(4096)],
        "paged_ab": paged_ab(),
+       "speculative_ab": speculative_ab(),
        "kv_bytes_ratio_int8":
            quant["cache_bytes"] / max(plain["cache_bytes"], 1)}
 print(json.dumps(out))
@@ -1800,6 +1860,7 @@ def main():
               for row in facts.get("flash_ab") or []}
         ratio_4096 = (ab.get("4096") or {}).get("flash_bytes_ratio")
         pab = facts.get("paged_ab") or {}
+        sab = facts.get("speculative_ab") or {}
         if not on_tpu:
             cc = (facts.get("plain") or {}).get("compile_counts") or {}
             total = sum(v for v in cc.values() if v)
@@ -1820,6 +1881,18 @@ def main():
                        round(pab["prefill_skip_fraction"], 4)
                        if pab.get("prefill_skip_fraction") is not None
                        else None,
+                   "speculative_total_compiles":
+                       sab.get("total_compiles"),
+                   "speculative_draft_flops_ratio":
+                       round(sab["draft_flops_ratio"], 4)
+                       if sab.get("draft_flops_ratio") is not None
+                       else None,
+                   "speculative_mean_accepted":
+                       round(sab["mean_accepted"], 4)
+                       if sab.get("mean_accepted") is not None
+                       else None,
+                   "speculative_greedy_outputs_match":
+                       sab.get("greedy_outputs_match"),
                    "static_facts": facts, "live": False,
                    "note": "tokens/sec + latency percentiles require a "
                            f"TPU; backend is {platform!r} — "
@@ -1858,6 +1931,14 @@ def main():
                    "flash_vs_dense_seq_bytes_ratio_4096":
                        round(ratio_4096, 4)
                        if ratio_4096 is not None else None,
+                   "speculative_draft_flops_ratio":
+                       round(sab["draft_flops_ratio"], 4)
+                       if sab.get("draft_flops_ratio") is not None
+                       else None,
+                   "speculative_mean_accepted":
+                       round(sab["mean_accepted"], 4)
+                       if sab.get("mean_accepted") is not None
+                       else None,
                    "static_facts": facts, "live": True}
             save_tpu_result(out)
             emit(out)

@@ -63,7 +63,13 @@ STEP_FLAVORS = ("dense", "zero1", "zero2", "zero3", "offload", "quantized",
 # continuous-batching stream across two seq buckets and audits the
 # compiled decode program: zero in-loop recompiles, cache-dtype
 # hygiene, and donation of the ring-buffer KV cache.
-EXTRA_FLAVORS = ("pipeline_tp", "fp8", "decode")
+# `speculative` drives the self-speculative serving engine
+# (`inference/speculative.py`) through the same churn streams on BOTH
+# kv layouts and audits the pinned three-program contract (prefill /
+# draft / verify, plain decode at zero entries), the draft-truncation
+# flop ratio, accept-loop invariants, and host-transfer hygiene of the
+# draft and verify programs.
+EXTRA_FLAVORS = ("pipeline_tp", "fp8", "decode", "speculative")
 
 
 class AuditError(RuntimeError):
@@ -753,6 +759,184 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
     return report
 
 
+def _xla_flops(fn, args):
+    """Compiled-program flop count from XLA cost analysis (0.0 when the
+    backend doesn't report one)."""
+    try:
+        ca = fn.lower(*args).compile().cost_analysis()
+    except Exception:
+        return 0.0
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return float((ca or {}).get("flops", 0.0) or 0.0)
+
+
+def audit_speculative(rules=None, config_overrides=None,
+                      kv_cache_dtype=None, attention_impl="flash",
+                      kv_layout=None, k=3, draft_layers=1, n_layer=4):
+    """Audit the self-speculative serving engine end to end.
+
+    Runs :func:`audit_decode`'s scripted churn streams (slot recycling
+    and bucket crossing on the ring layout; radix hits, pool pressure,
+    host park + mid-prompt resume on the paged layout) with speculation
+    enabled, then audits:
+
+    - the pinned THREE-program contract — prefill, draft, verify each
+      exactly one jit-cache entry and the plain decode program at ZERO
+      (an entry means the scheduler silently fell back mid-stream);
+    - draft truncation — XLA cost-analysis flops of the draft step vs
+      the full-depth decode step at the same avals must sit near
+      ``draft_layers / n_layer``, not near 1.0;
+    - accept-loop invariants (``mean_accepted >= 1.0`` by construction,
+      ``draft_efficiency`` within [0, 1]);
+    - draft/verify program hygiene — donation of the cache operand,
+      zero host transfers on the paged layout, and the flash payload
+      pins on the T=1 draft step.
+
+    ``kv_layout=None`` (the default, and what the CLI flavor runs)
+    sweeps BOTH layouts and merges the findings into one report —
+    speculation must survive serve churn on each.
+    """
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.cache import cache_dtype_census
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler, Request)
+    from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
+
+    t0 = time.perf_counter()
+    layouts = (kv_layout,) if kv_layout else ("ring", "paged")
+    findings, stats = [], {"layouts": {}}
+    hlo_text = ""
+    for layout in layouts:
+        cfg = gpt2_tiny(n_embd=32, n_layer=n_layer, dtype=jnp.float32)
+        model = GPT2LMHead(cfg)
+        toks = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), toks)["params"]
+        inf_cfg = {"max_batch": 2, "seq_buckets": (16, 32),
+                   "prefill_chunk": 4, "kv_cache_dtype": kv_cache_dtype,
+                   "attention_impl": attention_impl,
+                   "attention_block_k": 8, "kv_layout": layout,
+                   "speculative": {"enabled": True, "k": k,
+                                   "draft_layers": draft_layers}}
+        inf_cfg.update(config_overrides or {})
+        engine = InferenceEngine(model, params, config=inf_cfg)
+        spec = engine.speculative
+        sched = ContinuousBatchingScheduler(engine)
+        rng = np.random.default_rng(0)
+        if layout == "paged":
+            base = rng.integers(0, cfg.vocab_size, 12).tolist()
+            stream = [
+                Request("r0", base + rng.integers(
+                    0, cfg.vocab_size, 3).tolist(), max_new_tokens=4),
+                Request("r1", base + rng.integers(
+                    0, cfg.vocab_size, 5).tolist(), max_new_tokens=5),
+                Request("r2", rng.integers(
+                    0, cfg.vocab_size, 6).tolist(),
+                    max_new_tokens=4, session_id="s0"),
+                Request("r3", rng.integers(
+                    0, cfg.vocab_size, 30).tolist(), max_new_tokens=10),
+                Request("r4", base + rng.integers(
+                    0, cfg.vocab_size, 2).tolist(), max_new_tokens=3,
+                    arrival_step=3)]
+            completions = sched.run(stream)
+            s0 = {c.rid: c for c in completions}["r2"]
+            follow = stream[2].prompt + s0.tokens + rng.integers(
+                0, cfg.vocab_size, 2).tolist()
+            completions = sched.run(
+                [Request("r5", follow, max_new_tokens=3,
+                         session_id="s0")])
+        else:
+            stream = [
+                Request("r0", rng.integers(
+                    0, cfg.vocab_size, 3).tolist(), max_new_tokens=4),
+                Request("r1", rng.integers(
+                    0, cfg.vocab_size, 20).tolist(), max_new_tokens=6),
+                Request("r2", rng.integers(
+                    0, cfg.vocab_size, 2).tolist(),
+                    max_new_tokens=3, arrival_step=3),
+                Request("r3", rng.integers(
+                    0, cfg.vocab_size, 30).tolist(), max_new_tokens=10),
+                Request("r4", rng.integers(
+                    0, cfg.vocab_size, 6).tolist(), max_new_tokens=5)]
+            completions = sched.run(stream)
+        compile_counts = engine.compile_counts()
+        draft_args = spec.draft_lowering_args()
+        draft_hlo, expected, pinfo = _lower_step(spec._draft, draft_args)
+        verify_hlo, v_expected, v_pinfo = _lower_step(
+            spec._verify, spec.verify_lowering_args())
+        draft_flops = _xla_flops(spec._draft, draft_args)
+        full_flops = _xla_flops(engine._decode,
+                                engine.decode_lowering_args())
+        if layout == "paged":
+            payload_shape = (engine.spec.n_pages, engine.spec.page_size,
+                             engine.spec.n_head, engine.spec.head_dim)
+            page_facts = {"page_size": engine.page_size,
+                          "n_pages": engine.n_pages,
+                          "pages_per_row": engine.pages_per_row,
+                          "max_seq": engine.max_seq}
+        else:
+            payload_shape = (engine.spec.max_batch, engine.spec.max_seq,
+                             engine.spec.n_head, engine.spec.head_dim)
+            page_facts = None
+        ctx = StepContext(
+            hlo_text=draft_hlo, flavor="speculative",
+            compute_dtype="f32",
+            expected_donated_params=expected, donated_param_info=pinfo,
+            declared_donate_argnums=getattr(
+                spec._draft, "_ds_donate_argnums", None),
+            decode_compile_counts=compile_counts,
+            decode_kv_cache_dtype=engine.kv_cache_dtype,
+            decode_cache_census=cache_dtype_census(engine.cache),
+            decode_attention_impl=engine.attention_impl,
+            decode_cache_payload_shape=payload_shape,
+            decode_platform=jax.devices()[0].platform,
+            decode_kv_layout=engine.kv_layout,
+            decode_page_facts=page_facts,
+            spec_facts=spec.facts(),
+            spec_compile_counts=compile_counts,
+            spec_draft_hlo=draft_hlo, spec_verify_hlo=verify_hlo,
+            spec_draft_flops=draft_flops, spec_full_flops=full_flops,
+            skip_rules={"recompile"})
+        layout_findings = run_rules(ctx, rules)
+        # verify program: full-depth dense by design (the flash kernel
+        # is a T=1 specialization), so only the donation pin applies
+        v_ctx = StepContext(
+            hlo_text=verify_hlo, flavor="speculative",
+            compute_dtype="f32",
+            expected_donated_params=v_expected,
+            donated_param_info=v_pinfo,
+            declared_donate_argnums=getattr(
+                spec._verify, "_ds_donate_argnums", None),
+            skip_rules={"recompile"})
+        layout_findings.extend(run_rules(v_ctx, {"donation"}))
+        layout_findings.extend(engine.recompile_findings())
+        for f in layout_findings:
+            f.details.setdefault("kv_layout", layout)
+        findings.extend(layout_findings)
+        ratio = draft_flops / full_flops if full_flops else None
+        stats["layouts"][layout] = {
+            "compile_counts": compile_counts,
+            "completions": len(completions),
+            "finish_reasons": sorted(
+                c.finish_reason for c in completions),
+            "speculative": spec.facts(),
+            "draft_flops": draft_flops, "full_flops": full_flops,
+            "draft_flops_ratio": ratio,
+            "cache": engine.cache_facts(),
+        }
+        if layout == "paged":
+            stats["layouts"][layout]["paging"] = sched.paging.facts()
+        hlo_text = draft_hlo
+    report = AuditReport(flavor="speculative", findings=findings)
+    report.stats = _hlo_stats(hlo_text, StepContext(
+        hlo_text=hlo_text, flavor="speculative"))
+    report.stats.update(stats)
+    report.hlo_text = hlo_text
+    report.stats["audit_wall_s"] = round(time.perf_counter() - t0, 3)
+    return report
+
+
 def audit_flavors(flavors=None, rules=None, steps=0,
                   config_overrides=None):
     """Build + audit toy engines for the stock flavors.
@@ -764,6 +948,9 @@ def audit_flavors(flavors=None, rules=None, steps=0,
             # the serving flavor audits an InferenceEngine, not a
             # train-step engine — it has its own orchestrator.
             out[flavor] = audit_decode(rules=rules)
+            continue
+        if flavor == "speculative":
+            out[flavor] = audit_speculative(rules=rules)
             continue
         engine, batch = build_flavor_engine(
             flavor, config_overrides=config_overrides)

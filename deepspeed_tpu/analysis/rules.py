@@ -148,6 +148,25 @@ class StepContext:
     # internal-consistency pins.
     decode_kv_layout: str = None
     decode_page_facts: dict = None
+    # Speculative decoding (`inference/speculative.py`): spec_facts is
+    # the decoder's `facts()` (k / draft_layers / n_layer and the
+    # accept counters), spec_compile_counts the engine's full jit-cache
+    # census {prefill, decode, draft, verify} after a scripted churn
+    # stream — the pinned THREE-program contract, including decode == 0
+    # (the plain decode program must never be entered while speculation
+    # is on; one entry means the scheduler fell back mid-stream).
+    # spec_draft_hlo / spec_verify_hlo are the compiled draft / verify
+    # programs for host-transfer and payload pins; spec_draft_flops /
+    # spec_full_flops are XLA cost-analysis flop counts for the
+    # truncated draft step vs a same-shape full-depth step — their
+    # ratio proves the truncation is real (~draft_layers/n_layer, not
+    # ~1.0).
+    spec_facts: dict = None
+    spec_compile_counts: dict = None
+    spec_draft_hlo: str = None
+    spec_verify_hlo: str = None
+    spec_draft_flops: float = 0.0
+    spec_full_flops: float = 0.0
     skip_rules: set = field(default_factory=set)
 
 
@@ -864,6 +883,142 @@ def rule_flash_decode(ctx):
     return findings
 
 
+def rule_speculative(ctx):
+    """Self-speculative decoding's pinned contracts.
+
+    Program-count contract: a speculative serve compiles exactly THREE
+    programs — prefill, draft, verify — and the plain decode program
+    stays at ZERO jit-cache entries. One decode entry means the
+    scheduler silently fell back to token-at-a-time mid-stream (the
+    speedup is gone and nobody noticed); draft/verify above 1 means a
+    shape (draft window, batch, bucket) leaked into a jit boundary.
+
+    Truncation contract: the draft program must actually run only
+    ``draft_layers`` of ``n_layer`` blocks. XLA cost-analysis flops for
+    the draft step vs a same-shape full-depth step prove it — the
+    ratio must sit near draft_layers/n_layer, not near 1.0 (a ratio
+    near 1.0 means the truncation knob never reached the lowering and
+    the "draft" pays full-model cost for approximate tokens).
+
+    Accept-loop invariants: every verify round emits the correction /
+    bonus token even when all drafts miss, so ``mean_accepted`` (tokens
+    emitted per row-round) is >= 1.0 BY CONSTRUCTION — below 1.0 the
+    accept machinery is dropping tokens. ``draft_efficiency`` is a
+    fraction of drafted tokens and must stay within [0, 1].
+
+    Paged layout: draft and verify are steady-state programs — both
+    must lower zero host-transfer ops, same as plain decode. Flash
+    draft (T=1) on TPU must carry the Pallas custom-call and must not
+    contract over the full cache payload shape (verify always runs
+    dense full-depth; its payload dots are expected).
+    """
+    if ctx.spec_facts is None:
+        return []
+    findings = []
+    facts = ctx.spec_facts
+    expected = {"prefill": 1, "decode": 0, "draft": 1, "verify": 1}
+    for prog, want in sorted(expected.items()):
+        n = (ctx.spec_compile_counts or {}).get(prog)
+        if n is None or n == want:
+            continue
+        if prog == "decode":
+            msg = (f"speculative serve entered the plain decode "
+                   f"program {n} time(s) — speculation silently fell "
+                   f"back to token-at-a-time decoding mid-stream")
+        else:
+            msg = (f"speculative {prog} program accumulated {n} jit "
+                   f"cache entries (expected {want}) — a shape or "
+                   f"dtype leaked into the compiled boundary")
+        findings.append(Finding(
+            "speculative", SEV_ERROR, msg,
+            {"program": prog, "cache_size": n, "expected": want,
+             "compile_counts": dict(ctx.spec_compile_counts or {})}))
+    dl = facts.get("draft_layers", 0)
+    nl = facts.get("n_layer", 0)
+    if not 0 < dl < nl:
+        findings.append(Finding(
+            "speculative", SEV_ERROR,
+            f"degenerate draft depth draft_layers={dl} of n_layer={nl} "
+            f"reached the engine — the builder must disable "
+            f"speculation (2-program fallback) instead of drafting at "
+            f"full depth",
+            {"facts": dict(facts)}))
+    if ctx.spec_full_flops and ctx.spec_draft_flops:
+        ratio = ctx.spec_draft_flops / ctx.spec_full_flops
+        # non-layer work (embeddings, ln_f, lm_head) is shared, so the
+        # honest ratio lands between draft_layers/n_layer and 1;
+        # flagging past the midpoint catches the failure mode this pin
+        # exists for (truncation never lowered -> ratio ~= 1.0)
+        bound = (dl / nl + 1.0) / 2.0 if nl else 1.0
+        if ratio > bound:
+            findings.append(Finding(
+                "speculative", SEV_ERROR,
+                f"draft step costs {ratio:.2f}x the full-depth step "
+                f"(expected ~{dl}/{nl} = {dl / nl if nl else 0:.2f}, "
+                f"bound {bound:.2f}) — the layer truncation never "
+                f"reached the lowering and the draft pays full-model "
+                f"flops",
+                {"draft_flops": ctx.spec_draft_flops,
+                 "full_flops": ctx.spec_full_flops,
+                 "ratio": ratio, "bound": bound}))
+    rounds = facts.get("row_rounds", 0)
+    mean_acc = facts.get("mean_accepted", 0.0)
+    if rounds and mean_acc < 1.0:
+        findings.append(Finding(
+            "speculative", SEV_ERROR,
+            f"mean accepted tokens/round is {mean_acc:.3f} over "
+            f"{rounds} row-round(s) — every verify emits at least the "
+            f"correction token, so < 1.0 means the accept loop is "
+            f"dropping tokens",
+            {"facts": dict(facts)}))
+    eff = facts.get("draft_efficiency", 0.0)
+    if not 0.0 <= eff <= 1.0:
+        findings.append(Finding(
+            "speculative", SEV_ERROR,
+            f"draft_efficiency {eff:.3f} outside [0, 1] — accepted "
+            f"draft count exceeds drafted count; the accept gather is "
+            f"reading past the draft window",
+            {"facts": dict(facts)}))
+    if ctx.decode_kv_layout == "paged":
+        for name, hlo in (("draft", ctx.spec_draft_hlo),
+                          ("verify", ctx.spec_verify_hlo)):
+            hits = host_transfer_ops(hlo) if hlo else []
+            if hits:
+                kinds = sorted({h["kind"] for h in hits})
+                findings.append(Finding(
+                    "speculative", SEV_ERROR,
+                    f"paged speculative {name} program lowers "
+                    f"{len(hits)} host transfer op(s) "
+                    f"({', '.join(kinds)}) — page-table gathers must "
+                    f"stay on device in every steady-state program",
+                    {"program": name, "count": len(hits),
+                     "kinds": kinds,
+                     "ops": [h["line"][:200] for h in hits[:8]]}))
+    if ctx.decode_attention_impl == "flash" and ctx.spec_draft_hlo:
+        if ctx.decode_platform == "tpu" and \
+                "custom-call" not in ctx.spec_draft_hlo:
+            findings.append(Finding(
+                "speculative", SEV_ERROR,
+                "attention_impl='flash' on TPU but the draft program "
+                "contains no custom-call — the T=1 draft step lost the "
+                "Pallas flash-decode kernel",
+                {"platform": ctx.decode_platform}))
+        payload = ctx.decode_cache_payload_shape
+        if payload:
+            from deepspeed_tpu.analysis.hlo import payload_shaped_dots
+            dots = payload_shaped_dots(ctx.spec_draft_hlo, payload)
+            if dots:
+                findings.append(Finding(
+                    "speculative", SEV_ERROR,
+                    f"attention_impl='flash' but the draft program "
+                    f"still contracts over the full cache payload "
+                    f"shape {tuple(payload)} in {len(dots)} dot(s) — "
+                    f"dense attention survived in the draft step",
+                    {"payload_shape": tuple(payload),
+                     "dots": dots[:8]}))
+    return findings
+
+
 # Rule catalog: id -> rule. `recompile` is listed for config validation
 # but runs in the orchestrator (it needs live step objects, not HLO).
 RULES = {
@@ -879,6 +1034,7 @@ RULES = {
     "fp8": rule_fp8,
     "decode": rule_decode,
     "flash_decode": rule_flash_decode,
+    "speculative": rule_speculative,
 }
 RULE_IDS = tuple(RULES) + ("recompile",)
 

@@ -306,6 +306,12 @@ def paged_write_kv(layer_cache, k_new, v_new, positions, page_table):
     - prefill (``B == 1``): one ``dynamic_update_slice`` of the whole
       chunk into a single page — the engine pins ``page_size %
       prefill_chunk == 0`` so a chunk never straddles pages.
+    - speculative verify (``B > 1, T > 1``): a general advanced-index
+      scatter — each (row, step) token resolves its own (page, slot)
+      through the table, so a chunk MAY straddle a page boundary.
+      Positions past a row's allocated pages hit table entry 0 and
+      land on the trash page (rejected-tail rollback: those writes are
+      garbage by construction and never become visible).
 
     Quantization on the way in mirrors :func:`write_kv`: the pool's
     per-(page, slot, head) scales are exactly the ring's per-(row,
@@ -333,9 +339,12 @@ def paged_write_kv(layer_cache, k_new, v_new, positions, page_table):
             return jax.lax.dynamic_update_slice(
                 buf, vals.astype(buf.dtype), idx)
     else:
-        raise ValueError(
-            f"paged_write_kv handles decode (T==1) or single-row "
-            f"prefill (B==1); got B={B}, T={T}")
+        pages = jnp.take_along_axis(
+            page_table, positions // page_size, axis=1)     # [B, T]
+        offs = positions % page_size
+
+        def scatter(buf, vals):
+            return buf.at[pages, offs].set(vals.astype(buf.dtype))
 
     if codec is None:
         return {"k": scatter(layer_cache["k"], k_new),

@@ -1,6 +1,9 @@
 """InferenceEngine: the two compiled programs of the serving path.
 
-Exactly two jits, compiled once each, reused for the whole serve:
+Exactly two jits, compiled once each, reused for the whole serve
+(three with speculative decoding — see `inference/speculative.py`,
+which swaps the decode program for a draft/verify pair under the same
+never-recompile discipline):
 
 - **prefill** — one chunk of one prompt: ``[1, prefill_chunk]`` tokens
   at explicit positions, written into cache row ``slot`` (a traced
@@ -232,6 +235,15 @@ class InferenceEngine:
             self._decode = donated_jit(self._decode_fn,
                                        donate_argnums=(1,))
 
+        # speculative decoding (inference.speculative block): a draft
+        # + verify program pair hung off the engine, or None when the
+        # block is absent/disabled/degenerate — in which case the
+        # 2-program contract above is unchanged. When present, the
+        # contract is 3 programs (prefill, draft, verify) and the
+        # plain decode program must stay at 0 jit-cache entries.
+        from deepspeed_tpu.inference.speculative import build_speculative
+        self.speculative = build_speculative(self, config)
+
     # -- compiled programs --------------------------------------------------
 
     def _pin_cache(self, cache):
@@ -447,9 +459,12 @@ class InferenceEngine:
         serving analog of `analysis/audit.py:compiled_cache_size`. 1/1
         after warmup and FOREVER after is the contract; growth means a
         shape or dtype leaked into a compiled boundary."""
+        progs = [("prefill", self._prefill), ("decode", self._decode)]
+        if self.speculative is not None:
+            progs += [("draft", self.speculative._draft),
+                      ("verify", self.speculative._verify)]
         out = {}
-        for name, fn in (("prefill", self._prefill),
-                         ("decode", self._decode)):
+        for name, fn in progs:
             cs = getattr(fn, "_cache_size", None)
             try:
                 out[name] = int(cs()) if callable(cs) else None
@@ -508,4 +523,6 @@ class InferenceEngine:
             facts.update(page_size=self.page_size,
                          n_pages=self.n_pages,
                          pages_per_row=self.pages_per_row)
+        if self.speculative is not None:
+            facts["speculative"] = self.speculative.facts()
         return facts

@@ -538,6 +538,20 @@ class PagedCacheManager:
         row.pages.append(page)
         return True
 
+    def ensure_span(self, row, start, end):
+        """Grow the row's mapping to cover writes at every position in
+        ``[start, end]`` — the speculative round's potentially-ACCEPTED
+        frontier (``next_pos .. next_pos + draft_len``; an accepted
+        draft's KV must land on a real page, while pad/rejected writes
+        past the mapping harmlessly hit the trash page). Walks page by
+        page so a multi-page draft window can't skip an allocation;
+        False length-finishes the row exactly like
+        :meth:`ensure_position`."""
+        for pos in range(start, end + 1):
+            if not self.ensure_position(row, pos):
+                return False
+        return True
+
     # -- release / park ------------------------------------------------------
 
     def release(self, row, kv_tokens=None, session_id=None):

@@ -51,6 +51,24 @@ def _apply_top_p(logits, top_p):
     return jnp.where(logits >= cutoff, logits, _FILTERED)
 
 
+def filtered_logits(logits, temperature, top_k=0, top_p=1.0):
+    """The shared temperature → top-k → top-p pipeline as fp32 logits.
+
+    This is the distribution :func:`sample_logits` actually samples
+    from, exposed so speculative verify-accept can compute draft (q)
+    and verify (p) probabilities under the IDENTICAL filters — the
+    rejection-sampling accept rule is only distributionally correct
+    when both sides use the same filtered support. ``temperature``
+    must be > 0 (greedy has no distribution to filter).
+    """
+    if temperature <= 0.0:
+        raise ValueError(
+            f"filtered_logits needs temperature > 0, got {temperature}")
+    scaled = logits.astype(jnp.float32) / float(temperature)
+    scaled = _apply_top_k(scaled, int(top_k))
+    return _apply_top_p(scaled, float(top_p))
+
+
 def sample_logits(logits, key, temperature=0.0, top_k=0, top_p=1.0):
     """Sample next tokens from ``[..., vocab]`` logits.
 
@@ -71,8 +89,6 @@ def sample_logits(logits, key, temperature=0.0, top_k=0, top_p=1.0):
         # static greedy path: no randomness consumed, key untouched
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
     key, sub = jax.random.split(key)
-    scaled = logits.astype(jnp.float32) / float(temperature)
-    scaled = _apply_top_k(scaled, int(top_k))
-    scaled = _apply_top_p(scaled, float(top_p))
+    scaled = filtered_logits(logits, temperature, top_k, top_p)
     tokens = jax.random.categorical(sub, scaled, axis=-1)
     return tokens.astype(jnp.int32), key

@@ -251,9 +251,13 @@ class ContinuousBatchingScheduler:
     def step(self):
         """Admit what the queue allows, then run one compiled decode
         step over the live rows. Returns True while there is (or will
-        be) work left."""
+        be) work left. With a speculative engine the "step" is a whole
+        draft/verify round and rows advance by a VARIABLE number of
+        tokens (their accepted length) — see :meth:`_spec_step`."""
         self._expire()
         self._admit()
+        if getattr(self.engine, "speculative", None) is not None:
+            return self._spec_step(self.engine.speculative)
         if self.paging is not None:
             # grow each live row's page mapping to cover this step's
             # write BEFORE building the tables; a row the pool can't
@@ -303,6 +307,105 @@ class ContinuousBatchingScheduler:
         self._emit(len(active), wall)
         return bool(self.queue) or any(s is not None for s in self.slots)
 
+    def _spec_step(self, spec):
+        """One speculative round: j chained draft calls + one
+        verify-accept call, then a per-row consume walk over the
+        variable-length accepted blocks.
+
+        Row discipline: a row must have ``k + 1`` slots of physical
+        headroom before the round (the verify chunk writes positions
+        ``next_pos..next_pos+k``; past ``max_seq`` the ring write's
+        dynamic_update_slice would CLAMP the start and shift the whole
+        chunk onto valid history, and a paged table lookup would clamp
+        to the last page) — rows inside that margin length-finish now,
+        the same truncation contract as a bucket edge, at most k tokens
+        early. Paged rows also grow their mapping to cover every
+        potentially-ACCEPTED write (``next_pos + j``); pad writes past
+        the mapping land on the trash page by the PR 16 discipline."""
+        k = spec.k
+        j = spec.draft_len()
+        for i, s in enumerate(self.slots):
+            if s is not None and \
+                    s.next_pos + k + 1 > self.engine.max_seq:
+                self._finish(i, "length")
+        if self.paging is not None:
+            for i, s in enumerate(self.slots):
+                if s is not None and not self.paging.ensure_span(
+                        s.paging, s.next_pos, s.next_pos + j):
+                    self._finish(i, "length")
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            self.step_count += 1        # idle tick (open-loop gap)
+            return bool(self.queue)
+        mb = self.engine.max_batch
+        tokens = np.zeros(mb, np.int32)
+        positions = np.zeros(mb, np.int32)
+        for i in active:
+            tokens[i] = self.slots[i].pending
+            positions[i] = self.slots[i].next_pos
+        page_tables = None
+        if self.paging is not None:
+            page_tables = np.zeros((mb, self.paging.pages_per_row),
+                                   np.int32)
+            for i in active:
+                page_tables[i] = self.slots[i].paging.table(
+                    self.paging.pages_per_row)
+        fault_injection.maybe_kill("decode_step", self.step_count)
+        fault_injection.maybe_fail_decode(self.step_count)
+        # draft: j chained truncated-forward calls of ONE compiled
+        # program (tokens/positions are data; j itself never reaches a
+        # jit boundary)
+        chunk = np.zeros((mb, k + 1), np.int32)
+        chunk[:, 0] = tokens
+        q_dists = None
+        cur, cur_pos = tokens, positions.copy()
+        t0 = time.perf_counter()
+        for t in range(j):
+            cur, q = spec.draft(cur, cur_pos, page_tables=page_tables)
+            chunk[:, t + 1] = cur
+            if q is not None:
+                if q_dists is None:
+                    q_dists = np.zeros((mb, k, q.shape[-1]), np.float32)
+                q_dists[:, t] = q
+            cur_pos = cur_pos + 1
+        draft_wall = time.perf_counter() - t0
+        # verify: one full-depth teacher-forced call over [B, k+1]
+        pos_chunk = positions[:, None] + \
+            np.arange(k + 1, dtype=np.int32)[None, :]
+        draft_len = np.zeros(mb, np.int32)
+        draft_len[active] = j
+        t1 = time.perf_counter()
+        acc, out = spec.verify(chunk, pos_chunk, draft_len,
+                               q_dists=q_dists,
+                               page_tables=page_tables)
+        verify_wall = time.perf_counter() - t1
+        self.step_count += 1
+        # consume: walk each row's accepted block token by token so
+        # eos / token budget / bucket edges bind MID-CHUNK exactly
+        # where the non-speculative loop would have stopped
+        emitted = accepted = 0
+        for i in active:
+            s = self.slots[i]
+            accepted += int(acc[i])
+            for t in range(int(acc[i]) + 1):
+                s.next_pos += 1
+                s.pending = int(out[i, t])
+                s.generated.append(s.pending)
+                emitted += 1
+                self._check_finished(i)
+                if self.slots[i] is None:
+                    break
+        spec.observe(len(active), len(active) * j, accepted, emitted)
+        self._emit(len(active), draft_wall + verify_wall,
+                   tokens=emitted,
+                   spec_stats={"accepted_tokens": emitted,
+                               "accepted_drafts": accepted,
+                               "draft_tokens": len(active) * j,
+                               "draft_len": j,
+                               "draft_wall_s": draft_wall,
+                               "verify_wall_s": verify_wall})
+        return bool(self.queue) or any(s is not None for s in self.slots)
+
     def run(self, requests=None, max_steps=100000):
         """Drain ``requests`` (plus anything already queued) through the
         decode loop; returns the completions in finish order.
@@ -340,11 +443,14 @@ class ContinuousBatchingScheduler:
         live = sum(1 for s in self.slots if s is not None)
         return live / float(self.engine.max_batch)
 
-    def _emit(self, batch, wall_s):
+    def _emit(self, batch, wall_s, tokens=None, spec_stats=None):
         if self.session is None:
             return
         occ = batch / float(self.engine.max_batch)
+        tokens = batch if tokens is None else tokens
         extra = {}
+        if spec_stats is not None:
+            extra.update(spec_stats)
         if self.paging is not None:
             pg = self.paging
             extra = {"pages_free": pg.allocator.free_pages,
@@ -355,7 +461,7 @@ class ContinuousBatchingScheduler:
                      "sessions_parked_host": len(pg.host_store),
                      "cache_bytes": pg.page_bytes() * pg.engine.n_pages}
         self.session.emit(
-            "decode_step", step=self.step_count, tokens=batch,
+            "decode_step", step=self.step_count, tokens=tokens,
             batch=batch, occupancy=occ, queue_depth=len(self.queue),
             wall_s=wall_s, **extra)
         reg = self.session.registry
@@ -363,7 +469,19 @@ class ContinuousBatchingScheduler:
                       help="host wall per compiled decode step").observe(
                           wall_s)
         reg.counter("decode_tokens_total",
-                    help="tokens generated by decode steps").inc(batch)
+                    help="tokens generated by decode steps").inc(tokens)
+        if spec_stats is not None:
+            reg.histogram(
+                "accepted_tokens",
+                help="tokens emitted per row per speculative round "
+                     "(accepted drafts + correction)").observe(
+                         spec_stats["accepted_tokens"] / float(batch))
+            drafted = spec_stats["draft_tokens"]
+            reg.gauge(
+                "draft_efficiency",
+                help="fraction of drafted tokens verify accepted").set(
+                    spec_stats["accepted_drafts"] / float(drafted)
+                    if drafted else 0.0)
         reg.gauge("decode_batch_occupancy",
                   help="live rows / max_batch").set(occ)
         reg.gauge("decode_queue_depth",

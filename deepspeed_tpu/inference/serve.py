@@ -10,6 +10,10 @@
     ds_tpu_serve --synthetic 8 --replicas 2 \
                  --kill-replica 0 --kill-at-step 3 \
                  --expect-redispatch 1    # fleet resilience smoke
+    ds_tpu_serve --synthetic 8 --speculative --spec-k 4 \
+                 --draft-layers 1 --block-scale 0.1 \
+                 --expect-compiles 3 --expect-min-accepted 1.0
+    ds_tpu_serve --synthetic 4 --checkpoint /ckpts/run1 --n-head 4
 
 The model is the test-size GPT-2 with seeded random params — this CLI
 exists to exercise and measure the serving engine (CI smoke, bench
@@ -19,10 +23,12 @@ rows, audits), not to ship checkpoints. A request line is
 ``deadline_s``/``queue_timeout_s`` per ISSUE 17).
 
 ``--expect-compiles N`` makes the exit code enforce the recompile
-contract: after the stream drains, prefill + decode jit-cache entries
-must total exactly N (2 for any single-engine serve — one prefill, one
-decode — regardless of how many buckets the stream crossed). With
-``--replicas`` the gate applies PER SURVIVING REPLICA.
+contract: after the stream drains, prefill + decode (+ draft + verify
+with ``--speculative``) jit-cache entries must total exactly N (2 for
+any single-engine serve — one prefill, one decode — and exactly 3
+speculative: prefill, draft, verify, with the plain decode program
+never entered). With ``--replicas`` the gate applies PER SURVIVING
+REPLICA.
 ``--jsonl`` writes telemetry events for ``ds_tpu_metrics summary``
 serve mode (``decode_step`` single-engine; fleet events with
 ``--replicas``).
@@ -94,6 +100,97 @@ def _build_requests(args, vocab_size, max_seq):
 # gpt2_tiny's fixed test vocab — the synthetic stream only needs the
 # token range, so fleet mode doesn't build a model in the parent
 _TINY_VOCAB = 256
+
+
+def _scale_blocks(params, scale):
+    """Damp every block's residual-branch output projections
+    (attn/mlp ``c_proj`` kernels) by ``scale``.
+
+    Seeded-random weights give each block a ~unit-RMS output riding on
+    a 0.02-RMS embedding stream, so a truncated-depth draft diverges
+    from the full model immediately and speculative acceptance sits at
+    chance (~1/vocab). Trained transformers converge through depth;
+    ``--block-scale 0.1`` emulates that residual-stream convergence so
+    the CI mean-accepted gate measures the accept machinery, not the
+    entropy of random init."""
+    def walk(tree, path):
+        if hasattr(tree, "items"):
+            return {k: walk(v, path + (str(k),))
+                    for k, v in tree.items()}
+        if "c_proj" in path and path[-1] == "kernel":
+            return tree * scale
+        return tree
+
+    return walk(params, ())
+
+
+def _load_checkpoint_model(args, jax, jnp):
+    """Serve a real trained checkpoint: resolve + load a
+    `runtime/resilience/checkpoint.py` manifest, take its fp32 master
+    params, infer the GPT-2 geometry from leaf shapes, and convert the
+    layer layout (the elastic ``param_layout`` metadata: ``stacked``
+    scan_layers vs ``per_layer`` unrolled) to the requested serving
+    variant — training→serving handoff in one command. Checkpoints
+    saved under a different tensor-parallel topology need a
+    ``ds_tpu_reshard`` relayout first (single-host serving reads
+    replicated host leaves)."""
+    import re
+
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2Config,
+        GPT2LMHead,
+        stack_gpt2_layer_params,
+        unstack_gpt2_layer_params,
+    )
+    from deepspeed_tpu.runtime.resilience.checkpoint import (
+        CheckpointManager)
+
+    mgr = CheckpointManager()
+    tag = mgr.resolve_tag(args.checkpoint, args.ckpt_tag)
+    if tag is None:
+        raise SystemExit(
+            f"ds_tpu_serve: no valid checkpoint under {args.checkpoint}")
+    state, meta, path = mgr.load(args.checkpoint, tag)
+    if "params" not in state:
+        raise SystemExit(
+            f"ds_tpu_serve: checkpoint {path} carries no 'params' tree")
+    params = state["params"]
+    topo = (meta or {}).get("topology") or {}
+    saved_tp = int((topo.get("mesh_shape") or {}).get("model", 1) or 1)
+    if saved_tp > 1:
+        print(f"note: checkpoint {tag} was saved on a model-parallel "
+              f"mesh (model axis {saved_tp}); if its leaves were "
+              f"persisted sharded, relayout with ds_tpu_reshard before "
+              f"serving", file=sys.stderr)
+    # layer-layout conversion: the round trip is bit-exact, so a
+    # per-layer training checkpoint serves as scan_layers and back
+    if args.scan_layers and "h" not in params:
+        params = stack_gpt2_layer_params(params)
+    elif not args.scan_layers and "h" in params:
+        params = unstack_gpt2_layer_params(params)
+    wte, wpe = params["wte"], params["wpe"]
+    if "h" in params:
+        n_layer = int(jax.tree_util.tree_leaves(params["h"])[0].shape[0])
+    else:
+        n_layer = len([k for k in params
+                       if re.match(r"^h_\d+$", str(k))])
+    n_embd = int(wte.shape[1])
+    if n_embd % args.n_head:
+        raise SystemExit(
+            f"ds_tpu_serve: --n-head {args.n_head} does not divide the "
+            f"checkpoint's n_embd {n_embd}")
+    cfg = GPT2Config(
+        vocab_size=int(wte.shape[0]), n_positions=int(wpe.shape[0]),
+        n_embd=n_embd, n_layer=n_layer, n_head=args.n_head,
+        dropout=0.0, dtype=jnp.float32, param_dtype=jnp.float32,
+        scan_layers=args.scan_layers)
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    return GPT2LMHead(cfg), params, {"tag": tag, "path": path,
+                                     "n_layer": n_layer,
+                                     "n_embd": n_embd,
+                                     "vocab_size": cfg.vocab_size,
+                                     "param_layout": topo.get(
+                                         "param_layout")}
 
 
 def _run_fleet(args, inf_cfg, session):
@@ -294,6 +391,44 @@ def main(argv=None):
                              "(0 = disabled)")
     parser.add_argument("--top-p", type=float, default=None,
                         help="nucleus sampling mass (1.0 = disabled)")
+    # -- speculative decoding (ISSUE 18) --------------------------------
+    parser.add_argument("--speculative", action="store_true",
+                        help="self-speculative decoding: draft k "
+                             "tokens through the first draft_layers "
+                             "blocks, verify all of them in one "
+                             "full-depth forward")
+    parser.add_argument("--spec-k", type=int, default=4,
+                        help="draft window: tokens drafted per verify "
+                             "round (>= 1)")
+    parser.add_argument("--draft-layers", type=int, default=0,
+                        help="transformer blocks the draft pass runs "
+                             "(0 = auto n_layer // 2)")
+    parser.add_argument("--min-accept-to-grow", type=float, default=0.0,
+                        help="adaptive draft length: grow the window "
+                             "when mean accepted drafts/round clears "
+                             "this, shrink when it doesn't (0 = fixed "
+                             "window)")
+    parser.add_argument("--block-scale", type=float, default=None,
+                        help="damp every block's c_proj kernels by "
+                             "this factor; emulates trained residual "
+                             "convergence so seeded-random weights "
+                             "give measurable draft acceptance")
+    parser.add_argument("--expect-min-accepted", type=float,
+                        default=None,
+                        help="exit 1 unless mean accepted tokens per "
+                             "speculative round clears this")
+    # -- checkpoint serving (ISSUE 18) ----------------------------------
+    parser.add_argument("--checkpoint", default=None,
+                        help="serve params from this training "
+                             "checkpoint dir (runtime/resilience "
+                             "manifest layout) instead of seeded "
+                             "random weights")
+    parser.add_argument("--ckpt-tag", default=None,
+                        help="checkpoint tag to load (default: the "
+                             "newest valid one)")
+    parser.add_argument("--n-head", type=int, default=4,
+                        help="attention heads for --checkpoint serving "
+                             "(not recoverable from param shapes)")
     parser.add_argument("--requests", default=None,
                         help="JSONL request stream (one request/line)")
     parser.add_argument("--synthetic", type=int, default=0,
@@ -374,6 +509,20 @@ def main(argv=None):
             args.replica_backend != "process":
         parser.error("--kill-replica needs --replica-backend process "
                      "(a thread cannot be SIGKILLed in isolation)")
+    if args.speculative and args.replicas > 1:
+        parser.error("--speculative is single-replica only (the fleet "
+                     "router has no variable-tokens-per-step protocol "
+                     "yet)")
+    if args.expect_min_accepted is not None and not args.speculative:
+        parser.error("--expect-min-accepted requires --speculative")
+    if args.checkpoint and args.replicas > 1:
+        parser.error("--checkpoint serving is single-replica only")
+    if args.spec_k < 1:
+        parser.error("--spec-k must be >= 1")
+    if args.draft_layers < 0:
+        parser.error("--draft-layers must be >= 0 (0 = auto)")
+    if args.n_head < 1:
+        parser.error("--n-head must be >= 1")
 
     import jax
     import jax.numpy as jnp
@@ -416,7 +565,8 @@ def main(argv=None):
                    "max_redispatch": inf.max_redispatch,
                    "max_queue_depth": inf.max_queue_depth,
                    "deadline_s": inf.deadline_s,
-                   "queue_timeout_s": inf.queue_timeout_s}
+                   "queue_timeout_s": inf.queue_timeout_s,
+                   "speculative": inf.speculative}
     if args.max_batch is not None:
         inf_cfg["max_batch"] = args.max_batch
     if args.seq_buckets is not None:
@@ -446,6 +596,11 @@ def main(argv=None):
         inf_cfg["prefix_cache"] = args.prefix_cache
     if args.park_threshold is not None:
         inf_cfg["host_park_threshold"] = args.park_threshold
+    if args.speculative:
+        inf_cfg["speculative"] = {
+            "enabled": True, "k": args.spec_k,
+            "draft_layers": args.draft_layers,
+            "min_accept_to_grow": args.min_accept_to_grow}
     if args.expect_prefix_hits is not None and \
             inf_cfg.get("kv_layout", "ring") != "paged":
         parser.error("--expect-prefix-hits requires --kv-layout paged")
@@ -469,13 +624,24 @@ def main(argv=None):
     if args.queue_timeout_s is None:
         args.queue_timeout_s = inf_cfg.get("queue_timeout_s") or None
     if args.replicas > 1:
+        if inf_cfg.get("speculative"):
+            parser.error("config enables speculative decoding but the "
+                         "serve is fleet-mode; run single-replica")
         return _run_fleet(args, inf_cfg, session)
 
-    cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32,
-                    scan_layers=args.scan_layers)
-    model = GPT2LMHead(cfg)
-    toks = jnp.zeros((1, 8), jnp.int32)
-    params = model.init(jax.random.PRNGKey(args.seed), toks)["params"]
+    ckpt_info = None
+    if args.checkpoint:
+        model, params, ckpt_info = _load_checkpoint_model(args, jax, jnp)
+        cfg = model.config
+    else:
+        cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32,
+                        scan_layers=args.scan_layers)
+        model = GPT2LMHead(cfg)
+        toks = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(args.seed),
+                            toks)["params"]
+    if args.block_scale is not None:
+        params = _scale_blocks(params, args.block_scale)
     engine = InferenceEngine(model, params, config=inf_cfg,
                              session=session)
     sched = ContinuousBatchingScheduler(engine)
@@ -506,6 +672,10 @@ def main(argv=None):
     }
     if sched.paging is not None:
         result["paging"] = sched.paging.facts()
+    if engine.speculative is not None:
+        result["speculative"] = engine.speculative.facts()
+    if ckpt_info is not None:
+        result["checkpoint"] = ckpt_info
     ok = len(completions) == len(requests)
     if args.expect_compiles is not None:
         result["expect_compiles"] = args.expect_compiles
@@ -516,6 +686,12 @@ def main(argv=None):
         result["expect_prefix_hits"] = args.expect_prefix_hits
         prefix_hits_ok = hits >= args.expect_prefix_hits
         ok = ok and prefix_hits_ok
+    accepted_ok = True
+    if args.expect_min_accepted is not None:
+        mean_acc = result["speculative"]["mean_accepted"]
+        result["expect_min_accepted"] = args.expect_min_accepted
+        accepted_ok = mean_acc >= args.expect_min_accepted
+        ok = ok and accepted_ok
     result["ok"] = ok
 
     if args.as_json:
@@ -530,9 +706,26 @@ def main(argv=None):
             print(f"{c.rid}: prompt {c.prompt_len} tokens -> "
                   f"{len(c.tokens)} generated ({c.finish_reason}, "
                   f"bucket {c.bucket}, slot {c.slot}{extra})")
+        compiles = (f"prefill={counts['prefill']} "
+                    f"decode={counts['decode']}")
+        if engine.speculative is not None:
+            compiles += (f" draft={counts['draft']} "
+                         f"verify={counts['verify']}")
         print(f"{len(completions)}/{len(requests)} requests completed "
               f"in {sched.step_count} decode step(s); compiles: "
-              f"prefill={counts['prefill']} decode={counts['decode']}")
+              f"{compiles}")
+        if ckpt_info is not None:
+            print(f"checkpoint: tag {ckpt_info['tag']} "
+                  f"({ckpt_info['n_layer']}L/{ckpt_info['n_embd']}d, "
+                  f"vocab {ckpt_info['vocab_size']}, saved layout "
+                  f"{ckpt_info['param_layout']})")
+        if engine.speculative is not None:
+            sp = result["speculative"]
+            print(f"speculative: k={sp['k']} "
+                  f"draft_layers={sp['draft_layers']}/{sp['n_layer']}, "
+                  f"mean accepted {sp['mean_accepted']:.3f} "
+                  f"tokens/round over {sp['row_rounds']} row-round(s), "
+                  f"draft efficiency {sp['draft_efficiency']:.3f}")
         if sched.paging is not None:
             pg = result["paging"]
             print(f"paged KV: {pg['pages_resident']}/{pg['n_pages']} "
@@ -546,6 +739,10 @@ def main(argv=None):
                 why = (f"prefix hits "
                        f"{result['paging']['prefix_hits']} < expected "
                        f"{args.expect_prefix_hits}")
+            elif not accepted_ok:
+                why = (f"mean accepted "
+                       f"{result['speculative']['mean_accepted']:.3f} "
+                       f"< expected {args.expect_min_accepted}")
             else:
                 why = (f"compile count {total_compiles} != expected "
                        f"{args.expect_compiles}")

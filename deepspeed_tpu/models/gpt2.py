@@ -271,9 +271,24 @@ class GPT2LMHead(nn.Module):
     def __call__(self, input_ids, deterministic=True, pld_theta=None,
                  return_hidden=False, positions=None, kv_cache=None,
                  attn_impl="dense", attn_block_k=128, attn_mesh=None,
-                 kv_page_table=None):
+                 kv_page_table=None, truncate_layers=None):
         cfg = self.config
         B, T = input_ids.shape
+        # Early-exit truncation (speculative draft): run only the first
+        # ``truncate_layers`` blocks, then the usual ln_f + tied head.
+        # Decode-only — the caller must slice the stacked params/cache
+        # leaves to [:truncate_layers] under scan_layers (nn.scan splits
+        # params along axis 0, so the leading axis must equal the scan
+        # length); unrolled trees pass whole and only h_0..h_{L-1} run.
+        n_run = cfg.n_layer if truncate_layers is None \
+            else int(truncate_layers)
+        if not 0 < n_run <= cfg.n_layer:
+            raise ValueError(
+                f"truncate_layers {truncate_layers} outside "
+                f"1..{cfg.n_layer}")
+        if truncate_layers is not None and kv_cache is None:
+            raise ValueError("truncate_layers is a decode-path knob "
+                             "(requires kv_cache)")
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
@@ -338,9 +353,9 @@ class GPT2LMHead(nn.Module):
                 split_rngs={"params": True, "dropout": True, "pld": True},
                 in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast,
                          nn.broadcast),
-                length=cfg.n_layer)
+                length=n_run)
             x, new_h = scan(block_cls(cfg, n_layers=cfg.n_layer, name="h"),
-                            x, (jnp.arange(cfg.n_layer), kv_cache["h"]),
+                            x, (jnp.arange(n_run), kv_cache["h"]),
                             deterministic, positions, attn_mask,
                             kv_page_table)
             new_kv = {"h": new_h}
@@ -366,7 +381,7 @@ class GPT2LMHead(nn.Module):
                         pld_theta)
         elif kv_cache is not None:
             new_kv = {}
-            for i in range(cfg.n_layer):
+            for i in range(n_run):
                 x, new_kv[f"h_{i}"] = block_cls(
                     cfg, layer_idx=i, n_layers=cfg.n_layer,
                     name=f"h_{i}")(x, deterministic, None,

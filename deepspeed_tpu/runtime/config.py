@@ -655,7 +655,12 @@ class InferenceConfig:
             INFERENCE_PREFIX_CACHE, INFERENCE_HOST_PARK_THRESHOLD,
             INFERENCE_REPLICAS, INFERENCE_MAX_REDISPATCH,
             INFERENCE_MAX_QUEUE_DEPTH, INFERENCE_DEADLINE_S,
-            INFERENCE_QUEUE_TIMEOUT_S)
+            INFERENCE_QUEUE_TIMEOUT_S, INFERENCE_SPECULATIVE)
+
+    SPECULATIVE_KEYS = (INFERENCE_SPECULATIVE_ENABLED,
+                        INFERENCE_SPECULATIVE_K,
+                        INFERENCE_SPECULATIVE_DRAFT_LAYERS,
+                        INFERENCE_SPECULATIVE_MIN_ACCEPT_TO_GROW)
 
     def __init__(self, param_dict):
         sub = param_dict.get(INFERENCE, {}) or {}
@@ -706,6 +711,41 @@ class InferenceConfig:
             sub, INFERENCE_DEADLINE_S, INFERENCE_DEADLINE_S_DEFAULT)
         self.queue_timeout_s = get_scalar_param(
             sub, INFERENCE_QUEUE_TIMEOUT_S, INFERENCE_QUEUE_TIMEOUT_S_DEFAULT)
+        spec = sub.get(INFERENCE_SPECULATIVE, {}) or {}
+        self._speculative_raw = spec
+        self._speculative_given_keys = tuple(spec) \
+            if isinstance(spec, dict) else ()
+        self.speculative_enabled = get_scalar_param(
+            spec, INFERENCE_SPECULATIVE_ENABLED,
+            INFERENCE_SPECULATIVE_ENABLED_DEFAULT) \
+            if isinstance(spec, dict) else None
+        self.speculative_k = get_scalar_param(
+            spec, INFERENCE_SPECULATIVE_K,
+            INFERENCE_SPECULATIVE_K_DEFAULT) \
+            if isinstance(spec, dict) else None
+        self.speculative_draft_layers = get_scalar_param(
+            spec, INFERENCE_SPECULATIVE_DRAFT_LAYERS,
+            INFERENCE_SPECULATIVE_DRAFT_LAYERS_DEFAULT) \
+            if isinstance(spec, dict) else None
+        self.speculative_min_accept_to_grow = get_scalar_param(
+            spec, INFERENCE_SPECULATIVE_MIN_ACCEPT_TO_GROW,
+            INFERENCE_SPECULATIVE_MIN_ACCEPT_TO_GROW_DEFAULT) \
+            if isinstance(spec, dict) else None
+
+    @property
+    def speculative(self):
+        """The validated block in the dict form the engine's
+        ``build_speculative`` consumes (None when disabled)."""
+        if not self.speculative_enabled:
+            return None
+        return {
+            INFERENCE_SPECULATIVE_ENABLED: True,
+            INFERENCE_SPECULATIVE_K: self.speculative_k,
+            INFERENCE_SPECULATIVE_DRAFT_LAYERS:
+                self.speculative_draft_layers,
+            INFERENCE_SPECULATIVE_MIN_ACCEPT_TO_GROW:
+                self.speculative_min_accept_to_grow,
+        }
 
     def __repr__(self):
         return (f"InferenceConfig(max_batch={self.max_batch}, "
@@ -1146,6 +1186,57 @@ class DeepSpeedConfig:
                 raise ValueError(
                     f"inference: {name} must be a number >= 0 "
                     f"(0 = disabled), got {val!r}")
+        if not isinstance(inf._speculative_raw, dict):
+            raise ValueError(
+                f"inference: speculative must be a dict block, "
+                f"got {inf._speculative_raw!r}")
+        spec_unknown = sorted(set(inf._speculative_given_keys)
+                              - set(inf.SPECULATIVE_KEYS))
+        if spec_unknown:
+            raise ValueError(
+                f"inference: speculative: unknown key(s) {spec_unknown};"
+                f" allowed: {sorted(inf.SPECULATIVE_KEYS)}")
+        if not isinstance(inf.speculative_enabled, bool):
+            raise ValueError(
+                f"inference: speculative.enabled must be a bool, "
+                f"got {inf.speculative_enabled!r}")
+        sk = inf.speculative_k
+        if isinstance(sk, bool) or not isinstance(sk, int) or sk < 1:
+            # the validated config is strict (k >= 1: a 0-token draft
+            # is a misconfiguration, not a mode); only the ENGINE's
+            # dict path treats k=0 as a degenerate disable
+            raise ValueError(
+                f"inference: speculative.k must be an int >= 1, "
+                f"got {sk!r}")
+        sd = inf.speculative_draft_layers
+        if isinstance(sd, bool) or not isinstance(sd, int) or sd < 0:
+            raise ValueError(
+                f"inference: speculative.draft_layers must be an int "
+                f">= 0 (0 = auto: n_layer // 2), got {sd!r}")
+        sg = inf.speculative_min_accept_to_grow
+        if isinstance(sg, bool) or not isinstance(sg, (int, float)) \
+                or sg < 0:
+            raise ValueError(
+                f"inference: speculative.min_accept_to_grow must be a "
+                f"number >= 0, got {sg!r}")
+        if inf.speculative_enabled:
+            if sk + 1 >= max(buckets):
+                # the verify chunk writes k+1 slots per round; a k
+                # within one chunk of the largest bucket leaves no
+                # room to generate anything
+                raise ValueError(
+                    f"inference: speculative.k={sk} leaves no headroom "
+                    f"in the largest seq bucket {max(buckets)} (need "
+                    f"k + 1 < max bucket)")
+            if nr > 1:
+                # the fleet router's drain/redispatch bookkeeping is
+                # written against the 2-program engine; speculative
+                # serving is single-replica until the router learns
+                # the 3-program contract
+                raise ValueError(
+                    f"inference: speculative decoding is mutually "
+                    f"exclusive with replicas > 1 (got replicas={nr}); "
+                    f"run speculative engines single-replica")
 
     def _check_fp8(self):
         from deepspeed_tpu.runtime.comm.codecs import CODECS

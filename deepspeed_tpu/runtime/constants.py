@@ -555,3 +555,21 @@ INFERENCE_DEADLINE_S = "deadline_s"
 INFERENCE_DEADLINE_S_DEFAULT = 0.0
 INFERENCE_QUEUE_TIMEOUT_S = "queue_timeout_s"
 INFERENCE_QUEUE_TIMEOUT_S_DEFAULT = 0.0
+
+# Speculative decoding (inference.speculative sub-block): a
+# self-speculative draft of `k` tokens through the first `draft_layers`
+# blocks of the SAME model (truncated scan — no second weight set),
+# verified in one full-depth teacher-forced program. The serving
+# compile contract becomes 3 pinned programs (prefill, draft, verify).
+# draft_layers=0 auto-selects n_layer // 2; min_accept_to_grow > 0
+# turns on the adaptive draft-length controller (grow toward k while
+# mean acceptance clears the threshold, shrink otherwise).
+INFERENCE_SPECULATIVE = "speculative"
+INFERENCE_SPECULATIVE_ENABLED = "enabled"
+INFERENCE_SPECULATIVE_ENABLED_DEFAULT = False
+INFERENCE_SPECULATIVE_K = "k"
+INFERENCE_SPECULATIVE_K_DEFAULT = 4
+INFERENCE_SPECULATIVE_DRAFT_LAYERS = "draft_layers"
+INFERENCE_SPECULATIVE_DRAFT_LAYERS_DEFAULT = 0
+INFERENCE_SPECULATIVE_MIN_ACCEPT_TO_GROW = "min_accept_to_grow"
+INFERENCE_SPECULATIVE_MIN_ACCEPT_TO_GROW_DEFAULT = 0.0

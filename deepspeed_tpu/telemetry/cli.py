@@ -327,6 +327,35 @@ def _summarize_serve(decode, fleet=None):
                 "max": max(per_sess) if per_sess else None,
             },
         }
+    # Speculative extras: a speculative scheduler stamps each
+    # decode_step with the round's accept tallies and the draft/verify
+    # wall split, so the summary can report accepted tokens per round
+    # (the speedup lever) and where the wall went.
+    sp_events = [e for e in decode
+                 if e.get("accepted_tokens") is not None]
+    speculative = None
+    if sp_events:
+        acc = sum(int(e["accepted_tokens"]) for e in sp_events)
+        drafts = sum(int(e.get("accepted_drafts") or 0)
+                     for e in sp_events)
+        drafted = sum(int(e.get("draft_tokens") or 0)
+                      for e in sp_events)
+        row_rounds = sum(int(e.get("batch") or 0) for e in sp_events)
+        dw = sum(float(e.get("draft_wall_s") or 0) for e in sp_events)
+        vw = sum(float(e.get("verify_wall_s") or 0) for e in sp_events)
+        speculative = {
+            "rounds": len(sp_events),
+            "row_rounds": row_rounds,
+            "accepted_tokens": acc,
+            "mean_accepted": acc / row_rounds if row_rounds else None,
+            "draft_efficiency": drafts / drafted if drafted else None,
+            "draft_len_last": int(sp_events[-1].get("draft_len") or 0),
+            "wall_split": {
+                "draft_s": dw, "verify_s": vw,
+                "draft_frac": dw / (dw + vw) if (dw + vw) else None},
+            "effective_tokens_per_s": acc / (dw + vw)
+            if (dw + vw) else None,
+        }
     return {
         "schema": SCHEMA_VERSION,
         "mode": "serve",
@@ -359,6 +388,7 @@ def _summarize_serve(decode, fleet=None):
             "max": max(qd) if qd else None,
         },
         "paging": paging,
+        "speculative": speculative,
         "fleet": fleet,
         "mfu": None,
     }
@@ -409,6 +439,24 @@ def print_serve_summary(s, out=None):
               f"misses (hit rate {rate}), sessions admitted "
               f"{pg['sessions_admitted']}, parked to host "
               f"{pg['sessions_parked_host']}", file=out)
+    sp = s.get("speculative")
+    if sp:
+        mean = (f"{sp['mean_accepted']:.3f}"
+                if sp["mean_accepted"] is not None else "-")
+        eff = (f"{sp['draft_efficiency'] * 100:.1f}%"
+               if sp["draft_efficiency"] is not None else "-")
+        print(f"  speculative: {sp['accepted_tokens']} tokens over "
+              f"{sp['row_rounds']} row-round(s), mean accepted {mean} "
+              f"tokens/round, draft efficiency {eff}, draft window "
+              f"{sp['draft_len_last']}", file=out)
+        ws = sp["wall_split"]
+        frac = (f"{ws['draft_frac'] * 100:.0f}%"
+                if ws["draft_frac"] is not None else "-")
+        etps = (f"{sp['effective_tokens_per_s']:,.1f}"
+                if sp["effective_tokens_per_s"] is not None else "-")
+        print(f"  speculative wall: draft {_fmt_s(ws['draft_s'])} / "
+              f"verify {_fmt_s(ws['verify_s'])} ({frac} drafting), "
+              f"effective {etps} tokens/s", file=out)
     if s.get("fleet"):
         print_fleet_block(s["fleet"], out=out)
 
@@ -510,6 +558,14 @@ def _diff_rows(a, b):
         rows.append((f"phase.{name}.mean_s",
                      a["phases"].get(name, {}).get("mean_s"),
                      b["phases"].get(name, {}).get("mean_s"), True))
+    sa, sb = a.get("speculative"), b.get("speculative")
+    if sa or sb:
+        rows.append(("speculative.mean_accepted",
+                     (sa or {}).get("mean_accepted"),
+                     (sb or {}).get("mean_accepted"), False))
+        rows.append(("speculative.effective_tokens_per_s",
+                     (sa or {}).get("effective_tokens_per_s"),
+                     (sb or {}).get("effective_tokens_per_s"), False))
     return rows
 
 

@@ -346,3 +346,52 @@ def test_inference_fleet_config_validation():
     bad({"deadline_s": -1.0}, "deadline_s")
     bad({"deadline_s": True}, "deadline_s")
     bad({"queue_timeout_s": -0.5}, "queue_timeout_s")
+
+
+def test_speculative_config_defaults_and_block():
+    cfg = make_config({"train_batch_size": 16})
+    inf = cfg.inference
+    assert inf.speculative_enabled is False
+    assert inf.speculative_k == 4
+    assert inf.speculative_draft_layers == 0      # 0 = auto: n_layer//2
+    assert inf.speculative_min_accept_to_grow == 0.0
+    assert inf.speculative is None                # disabled -> None
+
+    cfg = make_config({
+        "train_batch_size": 16,
+        "inference": {"speculative": {
+            "enabled": True, "k": 3, "draft_layers": 2,
+            "min_accept_to_grow": 0.8}}})
+    inf = cfg.inference
+    assert inf.speculative == {
+        "enabled": True, "k": 3, "draft_layers": 2,
+        "min_accept_to_grow": 0.8}
+
+    # an explicitly disabled block validates but resolves to None
+    cfg = make_config({
+        "train_batch_size": 16,
+        "inference": {"speculative": {"enabled": False, "k": 7}}})
+    assert cfg.inference.speculative is None
+
+
+def test_speculative_config_validation():
+    def bad(block, match):
+        with pytest.raises(ValueError, match=match):
+            make_config({"train_batch_size": 16, "inference": block})
+
+    bad({"speculative": 3}, "dict block")
+    bad({"speculative": {"kk": 3}}, "unknown key")
+    bad({"speculative": {"enabled": 1}}, "enabled must be a bool")
+    # the validated config is strict: k >= 1 (only the engine's raw
+    # dict path treats k=0 as a degenerate disable)
+    bad({"speculative": {"k": 0}}, "speculative.k")
+    bad({"speculative": {"k": True}}, "speculative.k")
+    bad({"speculative": {"draft_layers": -1}}, "draft_layers")
+    bad({"speculative": {"min_accept_to_grow": -0.1}},
+        "min_accept_to_grow")
+    # k+1 verify slots must leave headroom in the largest bucket
+    bad({"seq_buckets": [8], "prefill_chunk": 8,
+         "speculative": {"enabled": True, "k": 7}}, "headroom")
+    # fleet router doesn't know the 3-program contract yet
+    bad({"replicas": 2, "speculative": {"enabled": True, "k": 3}},
+        "mutually")

@@ -377,3 +377,72 @@ def test_fleet_aggregate_torn_heartbeat_fixture(tmp_path):
                    "--expect-hosts", "2")
     assert "NO HEARTBEAT" in proc.stdout
     assert "unparseable" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# speculative block in the serve summary (PR 18)
+# ---------------------------------------------------------------------------
+
+def write_spec_serve_log(path, rounds=5, batch=2, accepted_per_row=1,
+                         draft_len=3, draft_wall=0.004,
+                         verify_wall=0.006):
+    """A speculative serving log: decode_step events carrying the
+    scheduler's spec_stats fields (accepted_tokens etc merged into the
+    event, exactly like `_emit(spec_stats=...)` writes them)."""
+    session = TelemetrySession(exporters=[JsonlExporter(str(path))])
+    emitted = batch * (accepted_per_row + 1)     # + correction/bonus
+    for i in range(rounds):
+        session.emit("decode_step", step=i + 1, tokens=emitted,
+                     batch=batch, occupancy=1.0, queue_depth=0,
+                     wall_s=draft_wall + verify_wall,
+                     accepted_tokens=emitted,
+                     accepted_drafts=batch * accepted_per_row,
+                     draft_tokens=batch * draft_len,
+                     draft_len=draft_len,
+                     draft_wall_s=draft_wall,
+                     verify_wall_s=verify_wall)
+    session.close()
+    return path
+
+
+def test_speculative_summary_json_math(tmp_path):
+    log = write_spec_serve_log(tmp_path / "spec.jsonl", rounds=5,
+                               batch=2, accepted_per_row=1, draft_len=3)
+    proc = run_cli("summary", str(log), "--json")
+    s = json.loads(proc.stdout)
+    sp = s["speculative"]
+    assert sp["rounds"] == 5
+    assert sp["row_rounds"] == 10                # 2 rows x 5 rounds
+    assert sp["accepted_tokens"] == 20           # (1 draft + 1) x 10
+    assert sp["mean_accepted"] == pytest.approx(2.0)
+    # 1 accepted draft out of 3 drafted per row
+    assert sp["draft_efficiency"] == pytest.approx(1 / 3)
+    assert sp["draft_len_last"] == 3
+    assert sp["wall_split"]["draft_frac"] == pytest.approx(0.4)
+    assert sp["effective_tokens_per_s"] == pytest.approx(
+        20 / (5 * 0.010), rel=1e-6)
+
+
+def test_speculative_summary_text_lines(tmp_path):
+    log = write_spec_serve_log(tmp_path / "spec.jsonl")
+    out = run_cli("summary", str(log)).stdout
+    assert "speculative:" in out
+    assert "mean accepted" in out
+    assert "speculative wall:" in out
+    assert "drafting" in out
+
+
+def test_speculative_diff_rows(tmp_path):
+    fast = write_spec_serve_log(tmp_path / "a.jsonl",
+                                accepted_per_row=2, draft_len=3)
+    slow = write_spec_serve_log(tmp_path / "b.jsonl",
+                                accepted_per_row=1, draft_len=3)
+    out = run_cli("diff", str(fast), str(slow), check=False).stdout
+    assert "speculative.mean_accepted" in out
+    assert "speculative.effective_tokens_per_s" in out
+
+
+def test_plain_serve_summary_has_no_speculative_block(tmp_path):
+    log = write_serve_log(tmp_path / "serve.jsonl")
+    s = json.loads(run_cli("summary", str(log), "--json").stdout)
+    assert s.get("speculative") is None

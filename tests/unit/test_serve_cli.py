@@ -235,3 +235,133 @@ def test_paged_config_file_with_sessions(tmp_path, capsys):
     parked = result["paging"]["sessions_parked_device"] + \
         result["paging"]["sessions_parked_host"]
     assert parked == 1
+
+
+class TestSpeculativeUsageErrors:
+    def test_min_accepted_requires_speculative(self):
+        with pytest.raises(SystemExit) as e:
+            main(["--synthetic", "2", "--expect-min-accepted", "1.0"])
+        assert e.value.code == 2
+
+    def test_speculative_is_single_replica(self):
+        with pytest.raises(SystemExit) as e:
+            main(["--synthetic", "2", "--speculative", "--replicas", "2"])
+        assert e.value.code == 2
+
+    def test_checkpoint_is_single_replica(self, tmp_path):
+        with pytest.raises(SystemExit) as e:
+            main(["--synthetic", "2", "--checkpoint", str(tmp_path),
+                  "--replicas", "2"])
+        assert e.value.code == 2
+
+    def test_spec_k_positive(self):
+        with pytest.raises(SystemExit) as e:
+            main(["--synthetic", "2", "--speculative", "--spec-k", "0"])
+        assert e.value.code == 2
+
+
+def test_speculative_serve_end_to_end(tmp_path, capsys):
+    """The CI smoke in miniature: 3 compiled programs (decode never
+    entered), the speculative facts block lands in the result, and the
+    mean-accepted gate passes with the calibrated block scale."""
+    log = tmp_path / "spec.jsonl"
+    rc = main(["--synthetic", "4", "--max-new", "4",
+               "--speculative", "--spec-k", "3", "--draft-layers", "1",
+               "--block-scale", "0.1",
+               "--expect-compiles", "3", "--expect-min-accepted", "1.0",
+               "--jsonl", str(log), "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["ok"] is True
+    assert len(result["completions"]) == 4
+    assert result["compile_counts"] == \
+        {"prefill": 1, "decode": 0, "draft": 1, "verify": 1}
+    sp = result["speculative"]
+    assert sp["k"] == 3 and sp["draft_layers"] == 1
+    assert sp["mean_accepted"] >= 1.0
+    assert 0.0 <= sp["draft_efficiency"] <= 1.0
+
+    s = summarize(read_events(str(log)))
+    assert s["speculative"]["accepted_tokens"] >= 4
+    assert s["speculative"]["mean_accepted"] >= 1.0
+
+
+def test_speculative_text_output_and_gate_failure(capsys):
+    """Human-readable compiles line names all four programs; an
+    unreachable acceptance gate exits 1 with the why."""
+    rc = main(["--synthetic", "2", "--max-new", "3",
+               "--speculative", "--spec-k", "2", "--draft-layers", "1",
+               "--expect-min-accepted", "3.5"])
+    assert rc == 1
+    captured = capsys.readouterr()
+    assert "draft=1 verify=1" in captured.out
+    assert "speculative:" in captured.out
+    assert "FAIL" in captured.err
+    assert "mean accepted" in captured.err
+
+
+def _save_tiny_checkpoint(tmp_path, scan_layers=False):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
+    from deepspeed_tpu.runtime.resilience.checkpoint import (
+        CheckpointManager)
+
+    cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32,
+                    scan_layers=scan_layers)
+    model = GPT2LMHead(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    host = jax.tree_util.tree_map(np.asarray, params)
+    meta = {"global_steps": 7,
+            "topology": {"mesh_shape": {"data": 1, "model": 1},
+                         "param_layout":
+                             "stacked" if scan_layers else "per_layer"}}
+    mgr = CheckpointManager(save_dir=str(tmp_path),
+                            io_retry_base_s=0.001)
+    mgr.save(str(tmp_path), "step7", {"params": host}, meta)
+    return str(tmp_path)
+
+
+def test_checkpoint_serve_end_to_end(tmp_path, capsys):
+    """Training→serving handoff: a per-layer checkpoint serves
+    unrolled with the plain 2-program contract and the checkpoint
+    block reports the inferred geometry."""
+    ckpt_dir = _save_tiny_checkpoint(tmp_path / "ckpt")
+    rc = main(["--checkpoint", ckpt_dir, "--n-head", "4",
+               "--synthetic", "3", "--max-new", "3",
+               "--expect-compiles", "2", "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["ok"] is True
+    assert len(result["completions"]) == 3
+    ck = result["checkpoint"]
+    assert ck["tag"] == "step7"
+    assert ck["n_layer"] == 2 and ck["n_embd"] == 32
+    assert ck["param_layout"] == "per_layer"
+
+
+def test_checkpoint_layout_conversion_with_speculative(tmp_path,
+                                                       capsys):
+    """A per-layer training checkpoint served as scan_layers (the
+    stack round trip) AND speculatively: 3 programs, outputs complete."""
+    ckpt_dir = _save_tiny_checkpoint(tmp_path / "ckpt")
+    rc = main(["--checkpoint", ckpt_dir, "--n-head", "4",
+               "--scan-layers",
+               "--speculative", "--spec-k", "2", "--draft-layers", "1",
+               "--synthetic", "3", "--max-new", "3",
+               "--expect-compiles", "3", "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["ok"] is True
+    assert result["compile_counts"]["decode"] == 0
+    assert result["checkpoint"]["param_layout"] == "per_layer"
+
+
+def test_checkpoint_missing_dir_exits(tmp_path):
+    with pytest.raises(SystemExit) as e:
+        main(["--checkpoint", str(tmp_path / "nope"),
+              "--synthetic", "2"])
+    assert "no valid checkpoint" in str(e.value)
