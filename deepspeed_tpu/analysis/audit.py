@@ -144,6 +144,23 @@ class AuditReport:
             lines.append(f"  recompiles: cache size "
                          f"{self.stats['compile_cache_size']} after "
                          f"{self.stats.get('steps_run', 0)} step(s)")
+        kernel_blocks = []
+        if self.stats.get("kernels"):
+            kernel_blocks.append((None, self.stats["kernels"]))
+        for layout, lstats in (self.stats.get("layouts") or {}).items():
+            if lstats.get("kernels"):
+                kernel_blocks.append((layout, lstats["kernels"]))
+        for layout, ks in kernel_blocks:
+            tag = f" [{layout}]" if layout else ""
+            for kname, kd in (ks.get("kernels") or {}).items():
+                lines.append(
+                    f"  kernel{tag} {kname}: grid {tuple(kd['grid'])}, "
+                    f"VMEM {kd['vmem_bytes'] / 1024:.1f}KB / "
+                    f"{ks.get('vmem_budget_bytes', 0) / (1 << 20):.0f}MB, "
+                    f"elided DMA {kd['elided_dma_fraction']:.1%}")
+            if ks.get("expected_elision") is not None:
+                lines.append(f"  elision contract{tag}: >= "
+                             f"{ks['expected_elision']:.1%} proven")
         for f in self.findings:
             lines.append(f"  - [{f.severity}] {f.rule}: {f.message}")
         return "\n".join(lines)
@@ -618,8 +635,48 @@ def build_flavor_engine(flavor, config_overrides=None):
     return engine, _toy_batch()
 
 
+# The sub-pallas_call rule subset (`analysis/kernels.py` facts); the
+# kernel-only flavors run exactly these.
+KERNEL_RULES = ("kernel_vmem", "kernel_tiling", "kernel_dma")
+
+
+def _kernel_analysis_for(fn, args, engine):
+    """Kernel analysis of a serving program at representative occupancy.
+
+    ``decode_lowering_args()`` carries all-zero positions (and, paged,
+    all-trash page tables) — correct avals for lowering, but degenerate
+    for a DMA-elision proof (everything clamps to block 0). Replace
+    them with a half-full scenario: row ``b`` at position
+    ``(b+1) * max_seq / (2 * max_batch)`` and, for the paged layout,
+    distinct live page-table entries (no cross-row physical sharing, so
+    elision is attributable to the clamp alone). Returns
+    ``(KernelAnalysis, expected_elision)`` where the expectation is the
+    scenario's dead-block fraction (`kernels.ring_dead_block_fraction`)
+    — the contract `rules.rule_kernel_dma` enforces.
+    """
+    from deepspeed_tpu.analysis.kernels import (
+        analyze_kernels, ring_dead_block_fraction)
+
+    args = list(args)
+    B = engine.spec.max_batch
+    max_seq = engine.max_seq
+    pos = np.array([(b + 1) * max_seq // (2 * B) for b in range(B)],
+                   np.int32)
+    args[3] = jnp.asarray(pos)                 # positions operand
+    if engine.kv_layout == "paged":
+        ppr = engine.pages_per_row
+        pt = (np.arange(B * ppr).reshape(B, ppr)
+              % (engine.n_pages - 1)) + 1     # live, distinct, non-trash
+        args[4] = jnp.asarray(pt.astype(np.int32))
+    ana = analyze_kernels(fn, tuple(args))
+    expected = ring_dead_block_fraction(
+        pos, max_seq, engine.attention_block_k) if ana.kernels else None
+    return ana, expected
+
+
 def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
-                 attention_impl="flash", kv_layout="ring"):
+                 attention_impl="flash", kv_layout="ring",
+                 kernels=False):
     """Audit the serving engine's compiled decode program.
 
     Builds a tiny :class:`~deepspeed_tpu.inference.engine.
@@ -642,6 +699,13 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
     `decode` rule pins that the post-churn program still lowered zero
     host transfers and the jit caches never grew past the 2-compile
     contract.
+
+    ``kernels=True`` additionally runs the sub-``pallas_call`` analyzer
+    (`analysis/kernels.py`) over the decode program at a representative
+    half-full occupancy and arms the ``kernel_vmem`` /
+    ``kernel_tiling`` / ``kernel_dma`` rules — including the
+    DMA-elision proof that the clamped index maps turn the scenario's
+    dead cache blocks into elided fetches.
     """
     import jax.numpy as jnp
     from deepspeed_tpu.inference.cache import cache_dtype_census
@@ -714,6 +778,10 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
         completions = sched.run(stream)
     hlo_text, expected, pinfo = _lower_step(engine._decode,
                                             engine.decode_lowering_args())
+    kernel_ana = kernel_expected = None
+    if kernels:
+        kernel_ana, kernel_expected = _kernel_analysis_for(
+            engine._decode, engine.decode_lowering_args(), engine)
     census = cache_dtype_census(engine.cache)
     if paged:
         payload_shape = (engine.spec.n_pages, engine.spec.page_size,
@@ -740,6 +808,8 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
         decode_platform=jax.devices()[0].platform,
         decode_kv_layout=engine.kv_layout,
         decode_page_facts=page_facts,
+        kernel_analysis=kernel_ana,
+        kernel_expected_elision=kernel_expected,
         skip_rules={"recompile"})
     findings = run_rules(ctx, rules)
     findings.extend(engine.recompile_findings())
@@ -755,6 +825,9 @@ def audit_decode(rules=None, config_overrides=None, kv_cache_dtype=None,
                                  "block_k": engine.attention_block_k}
     if paged:
         report.stats["paging"] = sched.paging.facts()
+    if kernel_ana is not None:
+        report.stats["kernels"] = kernel_ana.to_dict()
+        report.stats["kernels"]["expected_elision"] = kernel_expected
     report.stats["audit_wall_s"] = round(time.perf_counter() - t0, 3)
     return report
 
@@ -773,7 +846,8 @@ def _xla_flops(fn, args):
 
 def audit_speculative(rules=None, config_overrides=None,
                       kv_cache_dtype=None, attention_impl="flash",
-                      kv_layout=None, k=3, draft_layers=1, n_layer=4):
+                      kv_layout=None, k=3, draft_layers=1, n_layer=4,
+                      kernels=False):
     """Audit the self-speculative serving engine end to end.
 
     Runs :func:`audit_decode`'s scripted churn streams (slot recycling
@@ -863,6 +937,10 @@ def audit_speculative(rules=None, config_overrides=None,
         compile_counts = engine.compile_counts()
         draft_args = spec.draft_lowering_args()
         draft_hlo, expected, pinfo = _lower_step(spec._draft, draft_args)
+        kernel_ana = kernel_expected = None
+        if kernels:
+            kernel_ana, kernel_expected = _kernel_analysis_for(
+                spec._draft, draft_args, engine)
         verify_hlo, v_expected, v_pinfo = _lower_step(
             spec._verify, spec.verify_lowering_args())
         draft_flops = _xla_flops(spec._draft, draft_args)
@@ -897,6 +975,8 @@ def audit_speculative(rules=None, config_overrides=None,
             spec_compile_counts=compile_counts,
             spec_draft_hlo=draft_hlo, spec_verify_hlo=verify_hlo,
             spec_draft_flops=draft_flops, spec_full_flops=full_flops,
+            kernel_analysis=kernel_ana,
+            kernel_expected_elision=kernel_expected,
             skip_rules={"recompile"})
         layout_findings = run_rules(ctx, rules)
         # verify program: full-depth dense by design (the flash kernel
@@ -927,6 +1007,10 @@ def audit_speculative(rules=None, config_overrides=None,
         }
         if layout == "paged":
             stats["layouts"][layout]["paging"] = sched.paging.facts()
+        if kernel_ana is not None:
+            stats["layouts"][layout]["kernels"] = kernel_ana.to_dict()
+            stats["layouts"][layout]["kernels"]["expected_elision"] = \
+                kernel_expected
         hlo_text = draft_hlo
     report = AuditReport(flavor="speculative", findings=findings)
     report.stats = _hlo_stats(hlo_text, StepContext(
@@ -935,6 +1019,73 @@ def audit_speculative(rules=None, config_overrides=None,
     report.hlo_text = hlo_text
     report.stats["audit_wall_s"] = round(time.perf_counter() - t0, 3)
     return report
+
+
+def audit_flash_train(rules=None, batch=1, seq=128, n_head=2,
+                      head_dim=128, block_q=64, block_k=64):
+    """Audit the training flash-attention kernels (forward + both
+    backward passes) with the sub-``pallas_call`` analyzer.
+
+    Traces ``value_and_grad`` of a causal `ops/pallas/flash_attention.
+    flash_attention` sum at a representative geometry, extracts the
+    three Pallas kernels (fwd, dQ, dKV) and runs the kernel rule subset
+    (:data:`KERNEL_RULES`) — there is no engine and no HLO here, so the
+    step-level catalog doesn't apply. Stock blocks must come back
+    zero-findings: lane dims 128-aligned, sublane dims 8-aligned for
+    f32, VMEM working sets far under budget, and every output map
+    constant in the innermost grid dim (the carried-accumulator idiom,
+    not a grid-write race).
+    """
+    from deepspeed_tpu.analysis.kernels import analyze_kernels
+    from deepspeed_tpu.ops.pallas import flash_attention
+
+    t0 = time.perf_counter()
+    shape = (batch, seq, n_head, head_dim)
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in keys)
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=block_q,
+                               block_k=block_k,
+                               implementation="pallas").sum()
+
+    fn = jax.value_and_grad(loss, argnums=(0, 1, 2))
+    ana = analyze_kernels(fn, (q, k, v))
+    ctx = StepContext(hlo_text="", flavor="flash_train",
+                      kernel_analysis=ana,
+                      skip_rules={"recompile"})
+    findings = run_rules(ctx, set(rules) if rules is not None
+                         else set(KERNEL_RULES))
+    report = AuditReport(flavor="flash_train", findings=findings)
+    report.stats = {"kernels": ana.to_dict(),
+                    "geometry": {"batch": batch, "seq": seq,
+                                 "n_head": n_head, "head_dim": head_dim,
+                                 "block_q": block_q, "block_k": block_k},
+                    "audit_wall_s": round(time.perf_counter() - t0, 3)}
+    return report
+
+
+def audit_kernel_flavors(rules=None):
+    """The ``ds_tpu_audit --kernels`` sweep: every stock Pallas kernel
+    path under the sub-``pallas_call`` analyzer.
+
+    Covers the train flash-attention kernels (fwd/dQ/dKV), the decode
+    flavor on BOTH kv layouts (ring clamp and paged clamp+gather index
+    maps, each with its DMA-elision proof), and the speculative flavor
+    (draft program, both layouts). Returns ``{name: AuditReport}``;
+    stock kernels must come back zero-findings everywhere.
+    """
+    reports = {
+        "flash_train": audit_flash_train(rules=rules),
+        "decode_ring": audit_decode(rules=rules, kv_layout="ring",
+                                    kernels=True),
+        "decode_paged": audit_decode(rules=rules, kv_layout="paged",
+                                     kernels=True),
+        "speculative": audit_speculative(rules=rules, kernels=True),
+    }
+    for name, rep in reports.items():
+        rep.flavor = name
+    return reports
 
 
 def audit_flavors(flavors=None, rules=None, steps=0,

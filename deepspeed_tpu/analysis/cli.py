@@ -73,6 +73,14 @@ def main(argv=None):
                              "(default: all seven); extra flavors like "
                              "pipeline_tp (TP overlap) must be named "
                              "explicitly; ignored with --config")
+    parser.add_argument("--kernels", action="store_true",
+                        help="run the sub-pallas_call kernel analyzer "
+                             "sweep (analysis/kernels.py) instead of "
+                             "the train-step flavors: VMEM budgets, "
+                             "tile-alignment lint, DMA-elision proofs "
+                             "and grid-write races over flash_train, "
+                             "decode_ring, decode_paged, speculative; "
+                             "--flavors selects a subset of those")
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule ids to run "
                              "(default: full catalog)")
@@ -131,11 +139,39 @@ def main(argv=None):
                          f"known: {list(RULE_IDS)}")
 
     from deepspeed_tpu.analysis.audit import (EXTRA_FLAVORS, STEP_FLAVORS,
-                                              audit_engine, audit_flavors,
-                                              audit_hlo)
+                                              audit_decode, audit_engine,
+                                              audit_flash_train,
+                                              audit_flavors, audit_hlo,
+                                              audit_kernel_flavors,
+                                              audit_speculative)
     if args.hlo and args.config:
         parser.error("--hlo and --config are mutually exclusive")
-    if args.hlo:
+    if args.kernels and (args.hlo or args.config):
+        parser.error("--kernels audits the stock kernel flavors; it "
+                     "does not combine with --hlo/--config")
+    if args.kernels:
+        kernel_sweep = {
+            "flash_train": lambda: audit_flash_train(rules=rules),
+            "decode_ring": lambda: audit_decode(
+                rules=rules, kv_layout="ring", kernels=True),
+            "decode_paged": lambda: audit_decode(
+                rules=rules, kv_layout="paged", kernels=True),
+            "speculative": lambda: audit_speculative(
+                rules=rules, kernels=True),
+        }
+        if args.flavors:
+            names = [f.strip() for f in args.flavors.split(",")
+                     if f.strip()]
+            unknown = sorted(set(names) - set(kernel_sweep))
+            if unknown:
+                parser.error(f"unknown kernel flavor(s) {unknown}; "
+                             f"known: {list(kernel_sweep)}")
+            reports = {name: kernel_sweep[name]() for name in names}
+            for name, rep in reports.items():
+                rep.flavor = name
+        else:
+            reports = audit_kernel_flavors(rules=rules)
+    elif args.hlo:
         try:
             with open(args.hlo) as f:
                 hlo_text = f.read()

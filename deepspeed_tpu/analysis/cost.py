@@ -55,7 +55,10 @@ class Platform:
     ``ici_bytes_per_second`` is per-link, per-direction interconnect
     bandwidth; ``ici_latency_seconds`` is the per-hop launch latency
     (the alpha in alpha-beta); ``hbm_bytes`` is device memory capacity
-    (the default peak budget when the config sets none).
+    (the default peak budget when the config sets none); ``vmem_bytes``
+    is the per-core vector-memory budget a single Pallas grid step's
+    working set must fit in (`analysis/kernels.py`'s ``kernel_vmem``
+    rule checks against it).
     """
     name: str
     flops_per_second: float
@@ -63,18 +66,21 @@ class Platform:
     ici_bytes_per_second: float
     ici_latency_seconds: float
     hbm_bytes: int
+    vmem_bytes: int = 16 * 2 ** 20
 
 
 # Datasheet-order constants (see docs/analysis.md). The "cpu" row is a
-# deterministic stand-in so ranking tests run anywhere.
+# deterministic stand-in so ranking tests run anywhere; its VMEM budget
+# mirrors tpu_v5e so interpret-mode kernel audits gate like hardware.
 PLATFORMS = {
     "tpu_v5e": Platform("tpu_v5e", 197e12, 819e9, 45e9, 1e-6,
-                        16 * 2 ** 30),
+                        16 * 2 ** 30, 16 * 2 ** 20),
     "tpu_v5p": Platform("tpu_v5p", 459e12, 2765e9, 100e9, 1e-6,
-                        95 * 2 ** 30),
+                        95 * 2 ** 30, 16 * 2 ** 20),
     "tpu_v4": Platform("tpu_v4", 275e12, 1228e9, 50e9, 1e-6,
-                       32 * 2 ** 30),
-    "cpu": Platform("cpu", 1e12, 100e9, 10e9, 1e-6, 16 * 2 ** 30),
+                       32 * 2 ** 30, 16 * 2 ** 20),
+    "cpu": Platform("cpu", 1e12, 100e9, 10e9, 1e-6, 16 * 2 ** 30,
+                    16 * 2 ** 20),
 }
 
 
@@ -202,7 +208,10 @@ class StepCost:
     overlap_chunks: int                  # effective chunk count (1 = none)
     peak_bytes: int
     peak_budget_bytes: Optional[int]
-    step_seconds: float                  # compute + exposed interconnect
+    step_seconds: float                  # compute + exposed + kernel HBM
+    kernel_dma_bytes: int = 0            # elision-aware Pallas traffic
+    kernel_dense_bytes: int = 0          # every-grid-step-pays baseline
+    kernel_hbm_seconds: float = 0.0
     reject_reason: Optional[str] = None
 
     @property
@@ -236,8 +245,22 @@ def _site_chunks(collective_sites):
     return min(chunked) if chunked else 1
 
 
+def _kernel_traffic_bytes(kernel_facts):
+    """(dma, dense) byte totals from kernel-analysis fact dicts (the
+    `kernels.KernelAnalysis.kernel_cost_facts` form, or any mapping
+    with ``dma_bytes`` / ``dense_bytes``)."""
+    dma = dense = 0
+    for rec in kernel_facts or ():
+        get = rec.get if isinstance(rec, dict) else \
+            lambda k, d=0, r=rec: getattr(r, k, d)
+        dma += int(get("dma_bytes", 0))
+        dense += int(get("dense_bytes", 0))
+    return dma, dense
+
+
 def estimate_step_cost(hlo_text, *, n_devices, platform="tpu_v5e",
-                       collective_sites=(), peak_budget_bytes=None):
+                       collective_sites=(), peak_budget_bytes=None,
+                       kernel_facts=(), kernel_traffic="dma"):
     """Roofline cost of one compiled step (see module docstring).
 
     ``collective_sites`` is the trace-time `SiteRecord` list (the audit
@@ -245,7 +268,18 @@ def estimate_step_cost(hlo_text, *, n_devices, platform="tpu_v5e",
     overlap credit. ``peak_budget_bytes`` (None = no gate) rejects the
     candidate with :data:`REJECT_PEAK_MEMORY` when the static peak
     exceeds it.
+
+    ``kernel_facts`` carries per-Pallas-kernel traffic from
+    `kernels.KernelAnalysis.kernel_cost_facts`; their HBM time is
+    added to the step. ``kernel_traffic`` selects which byte count is
+    priced: ``"dma"`` (default) uses the elision-aware distinct-block
+    DMA bytes the analyzer proved, ``"dense"`` prices every grid step's
+    block as if nothing were elided — the pre-analysis assumption, kept
+    for A/B-ing what elision-aware ranking changes.
     """
+    if kernel_traffic not in ("dma", "dense"):
+        raise ValueError(f"kernel_traffic must be 'dma' or 'dense', "
+                         f"got {kernel_traffic!r}")
     p = resolve_platform(platform)
     n = max(2, int(n_devices))
 
@@ -272,6 +306,10 @@ def estimate_step_cost(hlo_text, *, n_devices, platform="tpu_v5e",
     credit_s = permute_s * (1.0 - 1.0 / chunks) if chunks > 1 else 0.0
     exposed_s = blocking_s - credit_s
 
+    kdma, kdense = _kernel_traffic_bytes(kernel_facts)
+    kernel_bytes = kdma if kernel_traffic == "dma" else kdense
+    kernel_hbm_s = kernel_bytes / p.hbm_bytes_per_second
+
     peak = hlo_lib.estimate_peak_memory(hlo_text)["peak_bytes"]
     reject = None
     if peak_budget_bytes is not None and peak > peak_budget_bytes:
@@ -290,6 +328,9 @@ def estimate_step_cost(hlo_text, *, n_devices, platform="tpu_v5e",
         overlap_chunks=chunks,
         peak_bytes=peak,
         peak_budget_bytes=peak_budget_bytes,
-        step_seconds=compute_s + exposed_s,
+        step_seconds=compute_s + exposed_s + kernel_hbm_s,
+        kernel_dma_bytes=kdma,
+        kernel_dense_bytes=kdense,
+        kernel_hbm_seconds=kernel_hbm_s,
         reject_reason=reject,
     )

@@ -1,0 +1,636 @@
+"""Static analysis below the ``pallas_call`` boundary.
+
+The audit subsystem verifies compiled XLA programs (collectives,
+donation, peak memory, deadlocks) but its HLO/jaxpr rules stop at the
+``pallas_call`` primitive — exactly where the performance-critical
+serving and attention code lives (`ops/pallas/flash_attention.py`,
+`ops/pallas/flash_decode.py`, `ops/pallas/fused_adam.py`). This module
+walks a traced step (or serving program), extracts every
+``pallas_call`` equation from the jaxpr, and checks four per-kernel
+properties the Mosaic compiler will not check for us:
+
+1. **VMEM footprint** — the per-grid-step working set: every
+   BlockSpec's block shape x dtype width for inputs and outputs
+   (doubled for Pallas's pipelined double buffering) plus the declared
+   scratch shapes, against the per-platform VMEM budget in
+   `analysis/cost.py`'s constants table (:data:`cost.PLATFORMS`,
+   ``vmem_bytes``). A block configuration that cannot fit is a
+   compile-time failure on real hardware that interpret-mode CI would
+   never see.
+
+2. **Tile-alignment lint** — block trailing dims vs the TPU native
+   tile for the operand dtype (8x128 f32, 16x128 bf16, 32x128
+   int8/fp8). A block whose lane (last) dim is not a multiple of 128,
+   or whose sublane (second-minor) dim is not a multiple of the
+   dtype's sublane count, wastes register tiles on every touch.
+   Geometry-forced shapes are exempt: a block dim that covers the full
+   array dim was never a choice, and singleton dims are indexed, not
+   tiled.
+
+3. **DMA-elision proofs** — each operand's index map is evaluated
+   CONCRETELY over the full grid (index maps are pure functions of the
+   grid indices and the scalar-prefetch operands, which the analyzer
+   captures as live values by interpreting the traced jaxpr). Pallas
+   skips the copy when consecutive grid steps map to the same block,
+   so counting distinct-vs-total physical blocks per operand *proves*
+   the flash-decode clamp trick (`flash_decode.py` ``kv_map`` /
+   ``_physical``: clamp the logical block to the row's occupancy, then
+   look up the page) actually dedupes dead blocks — and prices the
+   kernel's real HBM traffic for the cost model.
+
+4. **Grid-write races** — an output block revisited at NON-consecutive
+   grid steps is undefined behavior in Pallas's grid semantics (the
+   block is flushed when the grid moves away and re-fetched stale).
+   Consecutive revisits are the legitimate accumulator idiom (the
+   flash kernels' ``(bh, qi, 0)`` output maps) and pass.
+
+`analysis/rules.py` turns these facts into ``kernel_vmem`` /
+``kernel_tiling`` / ``kernel_dma`` findings; `analysis/audit.py` runs
+them over the serving flavors and the train flash-attention path
+(``ds_tpu_audit --kernels``).
+"""
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.analysis.cost import resolve_platform
+
+# TPU native register tile: (sublane, lane) per element width. The lane
+# dim is 128 for every dtype; sublanes scale inversely with width.
+LANE = 128
+SUBLANES = {4: 8, 2: 16, 1: 32}
+
+# Pallas pipelines block copies: while the grid computes on one block
+# the next one streams in, so each input/output block is resident twice.
+DOUBLE_BUFFER = 2
+
+# Grids bigger than this skip the concrete index-map sweep (the static
+# checks still run); every stock kernel's toy audit grid is far below.
+DEFAULT_GRID_POINT_CAP = 65536
+
+
+def sublane_tile(dtype) -> int:
+    """Native sublane count for ``dtype`` (8 f32, 16 bf16, 32 int8/fp8)."""
+    return SUBLANES.get(np.dtype(dtype).itemsize, 8)
+
+
+@dataclasses.dataclass
+class OperandFacts:
+    """One block-mapped operand (input or output) of a pallas_call."""
+    name: str
+    kind: str                    # "input" | "output"
+    block_shape: tuple
+    array_shape: tuple
+    dtype: str
+    block_bytes: int
+    total_fetches: int           # grid points (one block touch each)
+    distinct_blocks: int         # unique block indices over the grid
+    dma_fetches: int             # after consecutive-step elision
+    elided_fraction: float       # 1 - dma_fetches / total_fetches
+    index_map_evaluated: bool
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class KernelFacts:
+    """Everything the kernel rules check about one pallas_call."""
+    name: str
+    grid: tuple
+    operands: list               # [OperandFacts]
+    scratch_bytes: int
+    block_bytes_per_step: int    # single-buffered in+out working set
+    vmem_bytes: int              # double-buffered blocks + scratch
+    dense_bytes: int             # every grid step pays its block DMA
+    dma_bytes: int               # after consecutive-step elision
+    races: list                  # [{operand, block, steps}]
+    tiling: list                 # [{operand, axis, block_dim, ...}]
+    notes: list
+
+    @property
+    def elided_fraction(self):
+        if not self.dense_bytes:
+            return 0.0
+        return 1.0 - self.dma_bytes / self.dense_bytes
+
+    def to_dict(self):
+        return {
+            "name": self.name,
+            "grid": list(self.grid),
+            "scratch_bytes": self.scratch_bytes,
+            "block_bytes_per_step": self.block_bytes_per_step,
+            "vmem_bytes": self.vmem_bytes,
+            "dense_bytes": self.dense_bytes,
+            "dma_bytes": self.dma_bytes,
+            "elided_dma_fraction": round(self.elided_fraction, 6),
+            "races": list(self.races),
+            "tiling": list(self.tiling),
+            "notes": list(self.notes),
+            "operands": {op.name: op.to_dict() for op in self.operands},
+        }
+
+
+@dataclasses.dataclass
+class KernelAnalysis:
+    """All kernels of one traced program + the platform budget."""
+    kernels: list                # [KernelFacts]
+    platform: str
+    vmem_budget_bytes: int
+    wall_s: float
+    notes: list
+
+    @property
+    def dma_bytes(self):
+        return sum(k.dma_bytes for k in self.kernels)
+
+    @property
+    def dense_bytes(self):
+        return sum(k.dense_bytes for k in self.kernels)
+
+    def to_dict(self):
+        return {
+            "platform": self.platform,
+            "vmem_budget_bytes": self.vmem_budget_bytes,
+            "wall_s": round(self.wall_s, 3),
+            "notes": list(self.notes),
+            "dma_bytes": self.dma_bytes,
+            "dense_bytes": self.dense_bytes,
+            "kernels": {k.name: k.to_dict() for k in self.kernels},
+        }
+
+    def kernel_cost_facts(self):
+        """Per-kernel traffic facts in the shape
+        `cost.estimate_step_cost(kernel_facts=...)` prices."""
+        return [{"name": k.name, "dma_bytes": k.dma_bytes,
+                 "dense_bytes": k.dense_bytes} for k in self.kernels]
+
+
+# ---------------------------------------------------------------------------
+# pallas_call extraction: concrete jaxpr interpretation
+# ---------------------------------------------------------------------------
+
+# Call-like primitives worth recursing through when (and only when) a
+# pallas_call hides inside; everything else executes via plain bind.
+_CALL_JAXPR_KEYS = {
+    "pjit": "jaxpr",
+    "closed_call": "call_jaxpr",
+    "core_call": "call_jaxpr",
+    "custom_jvp_call": "call_jaxpr",
+    "custom_vjp_call": "call_jaxpr",
+    "custom_vjp_call_jaxpr": "fun_jaxpr",
+    "remat": "jaxpr",
+    "checkpoint": "jaxpr",
+}
+
+
+def _as_closed(obj):
+    import jax
+
+    if isinstance(obj, jax.core.ClosedJaxpr):
+        return obj
+    return jax.core.ClosedJaxpr(obj, ())
+
+
+def _param_jaxprs(params):
+    import jax
+
+    out = []
+    for v in params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vals:
+            if isinstance(item, jax.core.ClosedJaxpr):
+                out.append(item.jaxpr)
+            elif isinstance(item, jax.core.Jaxpr):
+                out.append(item)
+    return out
+
+
+_HAS_PALLAS_CACHE = {}
+
+
+def _jaxpr_has_pallas(jaxpr):
+    key = id(jaxpr)
+    hit = _HAS_PALLAS_CACHE.get(key)
+    if hit is not None:
+        return hit
+    _HAS_PALLAS_CACHE[key] = False      # cycle guard
+    found = False
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            found = True
+            break
+        if any(_jaxpr_has_pallas(j) for j in _param_jaxprs(eqn.params)):
+            found = True
+            break
+    _HAS_PALLAS_CACHE[key] = found
+    return found
+
+
+def _eqn_has_pallas(eqn):
+    if eqn.primitive.name == "pallas_call":
+        return True
+    return any(_jaxpr_has_pallas(j) for j in _param_jaxprs(eqn.params))
+
+
+def _bind(eqn, invals):
+    subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+    return eqn.primitive.bind(*subfuns, *invals, **bind_params)
+
+
+def _interp_jaxpr(jaxpr, consts, args, hits):
+    """Forward-evaluate ``jaxpr`` with concrete ``args``, recording
+    ``(eqn, concrete_invals)`` for every pallas_call reached (first
+    occurrence per equation — scan iterations share one). Sub-jaxprs
+    are only interpreted when a pallas_call hides inside; everything
+    else runs as one compiled bind."""
+    import jax
+
+    env = {}
+
+    def read(v):
+        return v.val if isinstance(v, jax.core.Literal) else env[v]
+
+    for var, val in zip(jaxpr.constvars, consts):
+        env[var] = val
+    for var, val in zip(jaxpr.invars, args):
+        env[var] = val
+
+    for eqn in jaxpr.eqns:
+        invals = [read(v) for v in eqn.invars]
+        name = eqn.primitive.name
+        outvals = None
+        if name == "pallas_call":
+            if not any(rec[0] is eqn for rec in hits):
+                hits.append((eqn, invals))
+            outvals = _bind(eqn, invals)
+        elif _eqn_has_pallas(eqn):
+            try:
+                if name == "scan":
+                    outvals = _interp_scan(eqn, invals, hits)
+                elif name == "while":
+                    outvals = _interp_while(eqn, invals, hits)
+                elif name == "cond":
+                    branches = eqn.params["branches"]
+                    br = branches[int(np.asarray(invals[0]))]
+                    outvals = _interp_jaxpr(br.jaxpr, br.consts,
+                                            invals[1:], hits)
+                elif name in _CALL_JAXPR_KEYS:
+                    closed = _as_closed(eqn.params[_CALL_JAXPR_KEYS[name]])
+                    outvals = _interp_jaxpr(closed.jaxpr, closed.consts,
+                                            invals, hits)
+            except Exception:
+                outvals = None      # fall through to plain bind
+        if outvals is None:
+            outvals = _bind(eqn, invals)
+        if not eqn.primitive.multiple_results:
+            outvals = [outvals]
+        for var, val in zip(eqn.outvars, outvals):
+            env[var] = val
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _interp_scan(eqn, invals, hits):
+    import jax.numpy as jnp
+
+    p = eqn.params
+    closed = p["jaxpr"]
+    nc, ncar = int(p["num_consts"]), int(p["num_carry"])
+    length = int(p["length"])
+    consts = invals[:nc]
+    carry = list(invals[nc:nc + ncar])
+    xs = invals[nc + ncar:]
+    order = range(length - 1, -1, -1) if p.get("reverse") else range(length)
+    ys_by_i = {}
+    for i in order:
+        xi = [x[i] for x in xs]
+        outs = _interp_jaxpr(closed.jaxpr, closed.consts,
+                             [*consts, *carry, *xi], hits)
+        carry = list(outs[:ncar])
+        ys_by_i[i] = outs[ncar:]
+    n_ys = len(next(iter(ys_by_i.values()))) if ys_by_i else 0
+    ys = [jnp.stack([ys_by_i[i][j] for i in range(length)])
+          for j in range(n_ys)]
+    return carry + ys
+
+
+def _interp_while(eqn, invals, hits, max_iters=100000):
+    p = eqn.params
+    cond, body = p["cond_jaxpr"], p["body_jaxpr"]
+    cn, bn = int(p["cond_nconsts"]), int(p["body_nconsts"])
+    cond_consts = invals[:cn]
+    body_consts = invals[cn:cn + bn]
+    carry = list(invals[cn + bn:])
+    for _ in range(max_iters):
+        pred = _interp_jaxpr(cond.jaxpr, cond.consts,
+                             [*cond_consts, *carry], hits)[0]
+        if not bool(np.asarray(pred)):
+            return carry
+        carry = list(_interp_jaxpr(body.jaxpr, body.consts,
+                                   [*body_consts, *carry], hits))
+    raise RuntimeError("while loop exceeded the interpreter's iteration "
+                       "cap")
+
+
+def _walk_static(jaxpr, hits, seen):
+    """Structural pallas_call sweep (no concrete values) — the fallback
+    when the concrete pass is unavailable or fails."""
+    if id(jaxpr) in seen:
+        return
+    seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            if not any(rec[0] is eqn for rec in hits):
+                hits.append((eqn, None))
+            continue
+        for sub in _param_jaxprs(eqn.params):
+            _walk_static(sub, hits, seen)
+
+
+def extract_pallas_calls(fn, args=None):
+    """``[(eqn, concrete_invals | None)]`` for every pallas_call in
+    ``fn`` traced at ``args``' avals.
+
+    With concrete ``args`` the traced jaxpr is interpreted forward so
+    each equation's scalar-prefetch operands are captured as live
+    values (what the index-map evaluation needs); tracing alone covers
+    programs whose index maps are pure grid functions. Returns the
+    extraction plus a note string ("" when the concrete pass ran)."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten(args if args is not None
+                                               else ())
+
+    def flat_fn(*leaves):
+        return fn(*jax.tree_util.tree_unflatten(treedef, leaves))
+
+    closed = jax.make_jaxpr(flat_fn)(*flat)
+    hits = []
+    if args is not None:
+        try:
+            _interp_jaxpr(closed.jaxpr, closed.consts, list(flat), hits)
+            return hits, ""
+        except Exception as exc:
+            hits = []
+            note = (f"concrete pass failed ({type(exc).__name__}: "
+                    f"{exc}); index maps with scalar operands not "
+                    f"evaluated")
+            _walk_static(closed.jaxpr, hits, set())
+            return hits, note
+    _walk_static(closed.jaxpr, hits, set())
+    return hits, ""
+
+
+# ---------------------------------------------------------------------------
+# per-kernel facts
+# ---------------------------------------------------------------------------
+
+def _block_dims(block_shape):
+    """Block shape with Pallas's squeezed-dim sentinel mapped to 1."""
+    return tuple(int(d) if isinstance(d, (int, np.integer)) else 1
+                 for d in block_shape)
+
+
+def _block_bytes(block_shape, dtype):
+    n = 1
+    for d in _block_dims(block_shape):
+        n *= d
+    return n * np.dtype(dtype).itemsize
+
+
+def _scratch_bytes(eqn):
+    """Declared scratch bytes: the kernel jaxpr's trailing refs."""
+    gm = eqn.params["grid_mapping"]
+    n = int(getattr(gm, "num_scratch_operands", 0))
+    if not n:
+        return 0
+    body = eqn.params["jaxpr"]
+    total = 0
+    for var in body.invars[len(body.invars) - n:]:
+        aval = var.aval
+        shape = getattr(aval, "shape", ())
+        dtype = getattr(aval, "dtype", np.float32)
+        size = 1
+        for d in shape:
+            size *= int(d)
+        total += size * np.dtype(dtype).itemsize
+    return total
+
+
+def _tiling_lint(name, block, array):
+    """Misaligned / sublane-wasting block dims (see module docstring
+    for the exemptions)."""
+    out = []
+    bdims = _block_dims(block.block_shape)
+    adims = tuple(int(d) for d in block.array_shape)
+    if len(bdims) < 2:
+        return out
+    sub = sublane_tile(block.dtype)
+    lane_b, lane_a = bdims[-1], adims[-1]
+    if lane_b % LANE and lane_b != lane_a:
+        out.append({"operand": name, "axis": "lane",
+                    "block_dim": lane_b, "tile": LANE,
+                    "array_dim": lane_a, "dtype": block.dtype})
+    sub_b, sub_a = bdims[-2], adims[-2]
+    if sub_b > 1 and sub_b % sub and sub_b != sub_a:
+        out.append({"operand": name, "axis": "sublane",
+                    "block_dim": sub_b, "tile": sub,
+                    "array_dim": sub_a, "dtype": block.dtype})
+    return out
+
+
+@dataclasses.dataclass
+class _Block:
+    """One BlockMapping, flattened to plain data."""
+    block_shape: tuple
+    array_shape: tuple
+    dtype: str
+    index_map: object            # ClosedJaxpr | None
+
+
+def _block_of(bm):
+    sd = bm.array_shape_dtype
+    return _Block(block_shape=tuple(bm.block_shape),
+                  array_shape=tuple(int(d) for d in sd.shape),
+                  dtype=str(np.dtype(sd.dtype)),
+                  index_map=getattr(bm, "index_map_jaxpr", None))
+
+
+def _eval_index_map(index_map, grid, scalar_vals, rank):
+    """Block index tuples over the full grid, in Pallas's iteration
+    order (row-major, last grid dim fastest): an int array
+    ``[n_points, rank]``. Scalar-prefetch refs in the map are
+    discharged to plain array reads fed with the captured values."""
+    import jax
+    import jax.numpy as jnp
+    from jax._src.state import discharge as state_discharge
+
+    discharged, dconsts = state_discharge.discharge_state(
+        index_map.jaxpr, index_map.consts)
+    f = jax.core.jaxpr_as_fun(jax.core.ClosedJaxpr(discharged, dconsts))
+    n = int(np.prod(grid))
+    idx = np.unravel_index(np.arange(n), grid)   # C order = last fastest
+
+    def one(*gi):
+        outs = f(*gi, *scalar_vals)
+        return tuple(outs[:rank])
+
+    cols = jax.vmap(one)(*[jnp.asarray(ix, jnp.int32) for ix in idx])
+    return np.stack([np.asarray(c) for c in cols], axis=1)
+
+
+def _fetch_stats(blocks):
+    """(distinct, dma_fetches) over row-major grid order. A fetch is
+    elided when the block equals the immediately preceding step's."""
+    distinct = len({tuple(b) for b in blocks})
+    dma = 1
+    for i in range(1, len(blocks)):
+        if tuple(blocks[i]) != tuple(blocks[i - 1]):
+            dma += 1
+    return distinct, dma
+
+
+def _race_scan(blocks):
+    """Non-consecutive output-block revisits: ``[{block, steps}]``."""
+    last_seen = {}
+    flagged = {}
+    for i, b in enumerate(map(tuple, blocks)):
+        prev = last_seen.get(b)
+        if prev is not None and prev != i - 1:
+            rec = flagged.setdefault(b, {"block": list(b), "steps": []})
+            if prev not in rec["steps"]:
+                rec["steps"].append(prev)
+            rec["steps"].append(i)
+        last_seen[b] = i
+    return list(flagged.values())
+
+
+def kernel_facts(eqn, invals=None, grid_point_cap=DEFAULT_GRID_POINT_CAP):
+    """:class:`KernelFacts` for one captured pallas_call equation."""
+    gm = eqn.params["grid_mapping"]
+    grid = tuple(int(g) for g in gm.grid)
+    n_scalars = int(getattr(gm, "num_index_operands", 0))
+    n_in = int(gm.num_inputs)
+    n_out = int(gm.num_outputs)
+    name = getattr(eqn.params.get("name_and_src_info"), "name", None) \
+        or "pallas_kernel"
+    scalar_vals = None
+    if invals is not None:
+        scalar_vals = [np.asarray(v) for v in invals[:n_scalars]]
+    elif n_scalars == 0:
+        scalar_vals = []
+
+    notes = []
+    n_points = int(np.prod(grid)) if grid else 1
+    sweep = n_points <= grid_point_cap
+    if not sweep:
+        notes.append(f"grid has {n_points} points (> cap "
+                     f"{grid_point_cap}); index maps not evaluated")
+
+    operands, tiling, races = [], [], []
+    block_bytes_total = dense_total = dma_total = 0
+    mappings = list(gm.block_mappings)
+    for i, bm in enumerate(mappings):
+        kind = "input" if i < n_in else "output"
+        opname = f"in{i}" if i < n_in else f"out{i - n_in}"
+        block = _block_of(bm)
+        bbytes = _block_bytes(block.block_shape, block.dtype)
+        block_bytes_total += bbytes
+        tiling.extend(_tiling_lint(opname, block, block))
+        distinct = dma = n_points
+        evaluated = False
+        if sweep and block.index_map is not None and scalar_vals is not None:
+            try:
+                blocks = _eval_index_map(
+                    block.index_map, grid, scalar_vals,
+                    len(block.block_shape))
+                distinct, dma = _fetch_stats(blocks)
+                evaluated = True
+                if kind == "output":
+                    for rec in _race_scan(blocks):
+                        rec["operand"] = opname
+                        races.append(rec)
+            except Exception as exc:
+                notes.append(f"{opname}: index map evaluation failed "
+                             f"({type(exc).__name__}: {exc})")
+        elif block.index_map is not None and scalar_vals is None:
+            notes.append(f"{opname}: index map reads scalar-prefetch "
+                         f"operands but no concrete values were "
+                         f"captured")
+        dense_total += n_points * bbytes
+        dma_total += dma * bbytes
+        operands.append(OperandFacts(
+            name=opname, kind=kind,
+            block_shape=_block_dims(block.block_shape),
+            array_shape=block.array_shape, dtype=block.dtype,
+            block_bytes=bbytes, total_fetches=n_points,
+            distinct_blocks=distinct, dma_fetches=dma,
+            elided_fraction=round(1.0 - dma / n_points, 6)
+            if n_points else 0.0,
+            index_map_evaluated=evaluated))
+
+    scratch = _scratch_bytes(eqn)
+    return KernelFacts(
+        name=name, grid=grid, operands=operands, scratch_bytes=scratch,
+        block_bytes_per_step=block_bytes_total,
+        vmem_bytes=DOUBLE_BUFFER * block_bytes_total + scratch,
+        dense_bytes=dense_total, dma_bytes=dma_total,
+        races=races, tiling=tiling, notes=notes)
+
+
+def _tiling_lint_block(bdims, adims, dtype):
+    """Lint arbitrary (block, array, dtype) dims — test seam."""
+    blk = _Block(block_shape=bdims, array_shape=adims,
+                 dtype=str(np.dtype(dtype)), index_map=None)
+    return _tiling_lint("block", blk, blk)
+
+
+# keep _tiling_lint's signature simple for kernel_facts: it takes the
+# operand name and the same _Block twice (block + array live together)
+def analyze_kernels(fn, args=None, *, platform="tpu_v5e",
+                    grid_point_cap=DEFAULT_GRID_POINT_CAP):
+    """Extract and analyze every pallas_call in ``fn`` at ``args``.
+
+    ``fn`` may be jitted or plain; ``args`` concrete arrays (their
+    values feed the scalar-prefetch index maps — pass the live call
+    args for a DMA-elision proof) or None for a purely structural
+    sweep. ``platform`` picks the VMEM budget row from
+    `cost.PLATFORMS`. Returns a :class:`KernelAnalysis`.
+    """
+    t0 = time.perf_counter()
+    p = resolve_platform(platform)
+    hits, note = extract_pallas_calls(fn, args)
+    notes = [note] if note else []
+    kernels = []
+    seen_names = {}
+    for eqn, invals in hits:
+        facts = kernel_facts(eqn, invals, grid_point_cap=grid_point_cap)
+        n = seen_names.get(facts.name, 0)
+        seen_names[facts.name] = n + 1
+        if n:
+            facts.name = f"{facts.name}#{n}"
+        kernels.append(facts)
+    return KernelAnalysis(
+        kernels=kernels, platform=p.name,
+        vmem_budget_bytes=p.vmem_bytes,
+        wall_s=time.perf_counter() - t0, notes=notes)
+
+
+# ---------------------------------------------------------------------------
+# elision expectations (the audit's decode proof)
+# ---------------------------------------------------------------------------
+
+def ring_dead_block_fraction(positions, max_seq, block_k):
+    """The fraction of KV-block grid steps past the rows' occupancy —
+    what the flash-decode clamp must elide. Heads multiply live and
+    total blocks alike, so the per-row fraction is the per-(row, head)
+    fraction."""
+    n_kb = max(1, int(max_seq) // int(block_k))
+    rows = [int(p) for p in np.asarray(positions).reshape(-1)]
+    if not rows:
+        return 0.0
+    live = sum(min(p // int(block_k) + 1, n_kb) for p in rows)
+    return 1.0 - live / (len(rows) * n_kb)

@@ -167,6 +167,16 @@ class StepContext:
     spec_verify_hlo: str = None
     spec_draft_flops: float = 0.0
     spec_full_flops: float = 0.0
+    # Pallas kernel analysis (`analysis/kernels.py`): kernel_analysis is
+    # the step's `KernelAnalysis` (None = the sub-pallas_call pass did
+    # not run; the kernel_* rules are inert). kernel_expected_elision is
+    # the audit's *proof obligation* for the DMA-elision trick: the
+    # dead-block fraction the clamped index maps MUST elide, computed
+    # from the analysis scenario's positions
+    # (`kernels.ring_dead_block_fraction`). None = no elision contract
+    # (train kernels have no occupancy clamp to prove).
+    kernel_analysis: object = None
+    kernel_expected_elision: float = None
     skip_rules: set = field(default_factory=set)
 
 
@@ -1019,6 +1029,133 @@ def rule_speculative(ctx):
     return findings
 
 
+def rule_kernel_vmem(ctx):
+    """Every pallas_call's per-grid-step working set fits in VMEM.
+
+    The working set is the double-buffered input+output block bytes
+    plus declared scratch (`kernels.KernelFacts.vmem_bytes`) against
+    the platform budget (`cost.Platform.vmem_bytes`). Interpret-mode CI
+    executes any block shape happily; on hardware an over-budget config
+    is a Mosaic compile failure — this rule is the only place the
+    constraint is checked before a TPU sees the program.
+    """
+    ana = ctx.kernel_analysis
+    if ana is None:
+        return []
+    findings = []
+    budget = ana.vmem_budget_bytes
+    for k in ana.kernels:
+        if k.vmem_bytes > budget:
+            findings.append(Finding(
+                "kernel_vmem", SEV_ERROR,
+                f"kernel '{k.name}': per-grid-step VMEM working set "
+                f"{_fmt_bytes(k.vmem_bytes)} exceeds the "
+                f"{ana.platform} budget {_fmt_bytes(budget)} "
+                f"(blocks {_fmt_bytes(k.block_bytes_per_step)} "
+                f"double-buffered + scratch "
+                f"{_fmt_bytes(k.scratch_bytes)})",
+                {"kernel": k.name, "vmem_bytes": k.vmem_bytes,
+                 "budget_bytes": budget,
+                 "block_bytes_per_step": k.block_bytes_per_step,
+                 "scratch_bytes": k.scratch_bytes,
+                 "grid": list(k.grid)}))
+    return findings
+
+
+def rule_kernel_tiling(ctx):
+    """Block trailing dims respect the dtype's native TPU tile.
+
+    Native register tiles are (8, 128) f32, (16, 128) bf16, (32, 128)
+    int8/fp8 (`kernels.SUBLANES`). A block whose lane dim is not a
+    multiple of 128, or whose sublane dim is not a multiple of the
+    dtype's sublane count, pads to full tiles on every load — silently
+    wasting VMEM and bandwidth. Geometry-forced dims (block == array
+    extent, singleton indexed dims) are exempt; see
+    `kernels._tiling_lint`.
+    """
+    ana = ctx.kernel_analysis
+    if ana is None:
+        return []
+    findings = []
+    for k in ana.kernels:
+        for t in k.tiling:
+            findings.append(Finding(
+                "kernel_tiling", SEV_WARNING,
+                f"kernel '{k.name}' operand {t['operand']}: "
+                f"{t['axis']} block dim {t['block_dim']} is not a "
+                f"multiple of the {t['dtype']} native tile "
+                f"{t['tile']} (array dim {t['array_dim']}) — every "
+                f"touch pads to full tiles",
+                {"kernel": k.name, **t}))
+    return findings
+
+
+def rule_kernel_dma(ctx):
+    """Grid-write safety and the DMA-elision proof.
+
+    An output block revisited at NON-consecutive grid steps is a race
+    under Pallas's grid semantics: the block is flushed when the grid
+    moves away, so the revisit reads back stale data (consecutive
+    revisits are the legitimate carried-accumulator idiom and pass).
+
+    When the audit declares an elision contract
+    (``kernel_expected_elision``, the dead-block fraction implied by
+    the analysis scenario's positions), the byte-weighted INPUT elided
+    fraction proved by the index-map sweep must reach it — this is the
+    static proof that the flash-decode clamp trick
+    (`ops/pallas/flash_decode.py` ``kv_map``/``_physical``) actually
+    turns dead cache blocks into elided DMAs, instead of asserting it
+    in prose.
+    """
+    ana = ctx.kernel_analysis
+    if ana is None:
+        return []
+    findings = []
+    for k in ana.kernels:
+        for race in k.races:
+            findings.append(Finding(
+                "kernel_dma", SEV_ERROR,
+                f"kernel '{k.name}' operand {race['operand']}: output "
+                f"block {tuple(race['block'])} is written at "
+                f"non-consecutive grid steps {race['steps'][:6]} — "
+                f"the block is flushed between visits and the revisit "
+                f"reads stale data",
+                {"kernel": k.name, **race}))
+    if ctx.kernel_expected_elision is not None:
+        in_dma = in_dense = 0
+        unevaluated = []
+        for k in ana.kernels:
+            for op in k.operands:
+                if op.kind != "input":
+                    continue
+                in_dma += op.dma_fetches * op.block_bytes
+                in_dense += op.total_fetches * op.block_bytes
+                if not op.index_map_evaluated:
+                    unevaluated.append(f"{k.name}/{op.name}")
+        proved = 1.0 - in_dma / in_dense if in_dense else 0.0
+        expected = float(ctx.kernel_expected_elision)
+        if unevaluated:
+            findings.append(Finding(
+                "kernel_dma", SEV_WARNING,
+                f"elision contract declared but "
+                f"{len(unevaluated)} operand index map(s) could not "
+                f"be evaluated ({', '.join(unevaluated[:4])}) — the "
+                f"DMA-elision proof is incomplete",
+                {"unevaluated": unevaluated}))
+        elif proved + 1e-6 < expected:
+            findings.append(Finding(
+                "kernel_dma", SEV_WARNING,
+                f"index maps elide only {proved:.1%} of input block "
+                f"DMAs; the scenario's occupancy requires "
+                f"{expected:.1%} — dead cache blocks are being "
+                f"fetched (unclamped index map?)",
+                {"proved_elision": round(proved, 6),
+                 "expected_elision": round(expected, 6),
+                 "input_dma_bytes": in_dma,
+                 "input_dense_bytes": in_dense}))
+    return findings
+
+
 # Rule catalog: id -> rule. `recompile` is listed for config validation
 # but runs in the orchestrator (it needs live step objects, not HLO).
 RULES = {
@@ -1035,6 +1172,9 @@ RULES = {
     "decode": rule_decode,
     "flash_decode": rule_flash_decode,
     "speculative": rule_speculative,
+    "kernel_vmem": rule_kernel_vmem,
+    "kernel_tiling": rule_kernel_tiling,
+    "kernel_dma": rule_kernel_dma,
 }
 RULE_IDS = tuple(RULES) + ("recompile",)
 

@@ -73,7 +73,7 @@ REJECT_BUILD_ERROR = "candidate_build_error"
 REJECT_RULE_FINDINGS = "audit_rule_findings"
 
 DIMENSION_NAMES = ("zero", "fp8", "overlap", "batch", "remat", "scan")
-SERVING_DIMENSION_NAMES = ("page", "park")
+SERVING_DIMENSION_NAMES = ("page", "park", "block")
 
 
 def deep_merge(base, overrides):
@@ -176,7 +176,13 @@ def serving_dimensions(base_config):
     it at build and the tuner reports the typed rejection instead of
     silently skipping the point). ``park`` sweeps the host-RAM
     evacuation threshold: 0 never parks to host, higher values trade
-    host-copy wall for device pages under session churn.
+    host-copy wall for device pages under session churn. ``block``
+    sweeps the flash-decode ``attention_block_k`` (the engine clamps it
+    to the page size and rejects non-divisors at build, so an
+    incompatible pairing comes back as a typed rejection): smaller
+    blocks elide dead-cache DMAs at finer granularity — visible to the
+    score only because `evaluate_serving_candidate` prices kernel HBM
+    traffic from the analyzer's elision-aware DMA bytes.
     """
     inf = base_config.get("inference") or {}
     pc = int(inf.get("prefill_chunk", 4))
@@ -188,7 +194,10 @@ def serving_dimensions(base_config):
     park = [Choice(f"park{int(t * 100)}",
                    {"inference": {"host_park_threshold": t}})
             for t in (0.0, 0.25, 0.5)]
-    dims = [("page", page), ("park", park)]
+    block = [Choice(f"blk{bk}",
+                    {"inference": {"attention_block_k": bk}})
+             for bk in (2, 4, 8) if bk <= max_seq]
+    dims = [("page", page), ("park", park), ("block", block)]
     return [(name, choices) for name, choices in dims if choices]
 
 
@@ -328,8 +337,13 @@ def evaluate_serving_candidate(config, model_overrides=None, *,
     (``kv_layout`` forced to "paged" — this mode tunes the paged
     knobs); the full rule catalog runs over the post-churn decode HLO,
     so a page_size that breaks the 2-compile contract or lowers a host
-    transfer comes back as a typed rejection, never a score. Drop-in
-    for :func:`evaluate_candidate` in the greedy driver
+    transfer comes back as a typed rejection, never a score. The audit
+    runs with ``kernels=True``, so the score includes the decode
+    kernel's HBM time priced from the analyzer's elision-aware DMA
+    bytes — which is what lets the ``block`` dimension rank
+    ``attention_block_k`` on real traffic (dense operand sizes are
+    identical across block sizes). Drop-in for
+    :func:`evaluate_candidate` in the greedy driver
     (``model_overrides``/``build`` are accepted and ignored).
     """
     from deepspeed_tpu.analysis.audit import audit_decode
@@ -342,7 +356,7 @@ def evaluate_serving_candidate(config, model_overrides=None, *,
     t0 = time.perf_counter()
     try:
         report = audit_decode(config_overrides=inf, rules=rules,
-                              kv_layout="paged")
+                              kv_layout="paged", kernels=True)
     except Exception as exc:
         res.reject_reason = REJECT_BUILD_ERROR
         res.reject_detail = f"{type(exc).__name__}: {exc}"
@@ -363,9 +377,15 @@ def evaluate_serving_candidate(config, model_overrides=None, *,
         res.reject_detail = "; ".join(
             f"{f.rule}: {f.message}" for f in errors[:4])
         return res
+    kstats = report.stats.get("kernels") or {}
+    kernel_facts = [
+        {"name": name, "dma_bytes": kd.get("dma_bytes", 0),
+         "dense_bytes": kd.get("dense_bytes", 0)}
+        for name, kd in (kstats.get("kernels") or {}).items()]
     cost = estimate_step_cost(
         report.hlo_text, n_devices=1, platform=platform,
-        peak_budget_bytes=peak_budget_bytes)
+        peak_budget_bytes=peak_budget_bytes,
+        kernel_facts=kernel_facts)
     res.cost = cost
     if cost.reject_reason:
         res.reject_reason = cost.reject_reason
@@ -509,6 +529,8 @@ def expected_events(result, steps=8):
         "collective_bytes_by_dtype": best.collective_bytes_by_dtype,
         "static_peak_bytes": cost.peak_bytes,
         "expected_step_s": cost.step_seconds,
+        "kernel_dma_bytes": cost.kernel_dma_bytes,
+        "kernel_dense_bytes": cost.kernel_dense_bytes,
     }]
     for i in range(steps):
         events.append({

@@ -389,7 +389,7 @@ def _flash_attend(q, layer_cache, positions, block_k, mesh):
     under ``shard_map`` over the head axis, matching
     :func:`kv_partition_specs` — each shard's kernel sees only its
     local heads, collective-free."""
-    from deepspeed_tpu.ops.pallas.flash_decode import flash_decode
+    from deepspeed_tpu.ops.pallas import flash_decode
 
     pos = positions[:, 0]
     scales = ()
@@ -420,7 +420,7 @@ def _flash_attend_paged(q, layer_cache, positions, page_table, block_k,
     pool-sized gather/copy ever materializes. The pool's head axis
     shards exactly like the ring's, so the TP ``shard_map`` only swaps
     in the replicated page-table spec."""
-    from deepspeed_tpu.ops.pallas.flash_decode import flash_decode_paged
+    from deepspeed_tpu.ops.pallas import flash_decode_paged
 
     pos = positions[:, 0]
     scales = ()

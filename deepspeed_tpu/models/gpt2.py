@@ -145,7 +145,7 @@ class CausalSelfAttention(nn.Module):
                                             mask=attn_mask,
                                             page_table=kv_page_table)
         elif cfg.use_flash_attention:
-            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+            from deepspeed_tpu.ops.pallas import flash_attention
             # Attention-prob dropout runs inside the kernels (counter-based
             # mask regenerated in the backward), so the flash path stays on
             # in training configs — the round-3 gate that forced dense

@@ -56,6 +56,53 @@ from deepspeed_tpu.ops.pallas.flash_attention import DEFAULT_MASK_VALUE
 
 DEFAULT_BLOCK_K = 128
 
+# TPU native sublane tile per element width (lane dim is always 128):
+# a compiled block whose second-minor dim doesn't tile to this pads to
+# full register tiles on every touch.
+_SUBLANE_TILES = {4: 8, 2: 16, 1: 32}
+
+
+class KernelGeometryError(ValueError):
+    """Invalid flash-decode block geometry, raised at call time.
+
+    Subclasses ``ValueError`` so existing call sites (and tests)
+    catching the untyped validation keep working; the distinct type
+    lets the static analyzer (`analysis/kernels.py`) and the serving
+    engine report geometry problems as what they are instead of a
+    silently mis-lowered kernel (or, for ``block_k <= 0``, an opaque
+    ``ZeroDivisionError`` from the grid arithmetic).
+    """
+
+
+def _validate_block_k(block_k, extent, extent_name, kv_dtype, interpret):
+    """Clamp and validate ``block_k`` against the KV extent it tiles.
+
+    ``extent`` is ``max_seq`` for the ring layout and ``page_size``
+    for the paged one (a KV block never straddles a page). The
+    sublane-tile check only gates the COMPILED path (``interpret``
+    False, i.e. a real TPU lowering where Mosaic's tiling constraints
+    bite on sub-tile quantized blocks); interpret-mode CPU runs accept
+    any divisor so CI toys stay small.
+    """
+    block_k = int(block_k)
+    if block_k < 1:
+        raise KernelGeometryError(
+            f"attention block_k must be >= 1, got {block_k}")
+    block_k = min(block_k, int(extent))
+    if extent % block_k:
+        raise KernelGeometryError(
+            f"{extent_name} {extent} must be a multiple of attention "
+            f"block_k {block_k}")
+    tile = _SUBLANE_TILES.get(jnp.dtype(kv_dtype).itemsize, 8)
+    if not interpret and block_k % tile and block_k != extent:
+        raise KernelGeometryError(
+            f"attention block_k {block_k} is not a multiple of the "
+            f"{jnp.dtype(kv_dtype).name} sublane tile {tile} — the "
+            f"compiled kernel would pad every KV block to full "
+            f"register tiles; pick a multiple of {tile} (or cover the "
+            f"whole {extent_name})")
+    return block_k
+
 
 def _fold_heads(x):
     """[B, S, H, D] → [B*H, S, D] (heads into the grid's leading dim)."""
@@ -166,17 +213,13 @@ def flash_decode(q, k, v, positions, k_scale=None, v_scale=None,
         raise ValueError(
             f"flash_decode takes one query token per row: q shape "
             f"{q.shape} != {(B, 1, H, D)}")
-    block_k = min(int(block_k), S)
-    if S % block_k:
-        raise ValueError(
-            f"max_seq {S} must be a multiple of attention block_k "
-            f"{block_k}")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    block_k = _validate_block_k(block_k, S, "max_seq", k.dtype, interpret)
     if (k_scale is None) != (v_scale is None):
         raise ValueError("pass both k_scale and v_scale or neither")
     quant = k_scale is not None
     n_kb = S // block_k
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
 
     qh = q.transpose(0, 2, 1, 3).reshape(B * H, 1, D)
     kh = _fold_heads(k)
@@ -256,18 +299,15 @@ def flash_decode_paged(q, k, v, positions, page_tables, k_scale=None,
             f"page_tables rows {page_tables.shape[0]} != batch {B}")
     n_pt = page_tables.shape[1]
     S = n_pt * page_size
-    block_k = min(int(block_k), page_size)
-    if page_size % block_k:
-        raise ValueError(
-            f"page_size {page_size} must be a multiple of attention "
-            f"block_k {block_k}")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    block_k = _validate_block_k(block_k, page_size, "page_size",
+                                k.dtype, interpret)
     if (k_scale is None) != (v_scale is None):
         raise ValueError("pass both k_scale and v_scale or neither")
     quant = k_scale is not None
     n_kb = S // block_k
     bpp = page_size // block_k          # kv-blocks per page
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
 
     qh = q.transpose(0, 2, 1, 3).reshape(B * H, 1, D)
 

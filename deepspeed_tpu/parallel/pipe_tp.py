@@ -41,7 +41,7 @@ from deepspeed_tpu.parallel.collectives import (axis_is_manual,
                                                 psum_grad)
 from deepspeed_tpu.ops.fp8 import (fp8_dot_general, fp8_plan,
                                    in_qdq_current, out_qdq_current)
-from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+from deepspeed_tpu.ops.pallas import flash_attention
 
 
 # ---------------------------------------------------------------------------

@@ -838,7 +838,7 @@ class DeepSpeedEngine:
                          "ZeRO stage 0); using the XLA fused update",
                          ranks=[0])
             if use_pallas:
-                from deepspeed_tpu.ops.pallas.fused_adam import (
+                from deepspeed_tpu.ops.pallas import (
                     pallas_adam_update)
                 self._opt_update = \
                     lambda p, g, s, lr_, beta1: pallas_adam_update(
@@ -2504,6 +2504,11 @@ class DeepSpeedEngine:
             # parameter-buffer accounting
             facts["param_bytes"] = int(stats.get("param_bytes") or
                                        pm.get("parameter_bytes") or 0)
+            # sub-pallas_call kernel analysis (analysis/kernels.py),
+            # present when the audit ran with kernels=True — the
+            # per-kernel VMEM/DMA facts ds_tpu_metrics summary renders
+            if stats.get("kernels"):
+                facts["kernels"] = stats["kernels"]
         self.telemetry.emit("compile", **facts)
 
     # ------------------------------------------------------------------

@@ -113,6 +113,34 @@ def _wire_bytes_per_step(events):
     return None
 
 
+def _kernel_summary(events):
+    """Pallas kernel facts from the latest ``compile`` event carrying
+    the sub-``pallas_call`` analysis (`analysis/kernels.py`
+    ``KernelAnalysis.to_dict`` form, stamped by the engine's compile
+    audit or a ``--kernels`` serve audit): per-kernel VMEM working set
+    and elided-DMA fraction, plus the VMEM high-water across kernels
+    and the byte-weighted elision rollup."""
+    for evt in reversed(events):
+        ks = evt.get("kernels") if evt.get("event") == "compile" else None
+        if not ks or not ks.get("kernels"):
+            continue
+        per = {
+            name: {"vmem_bytes": int(kd.get("vmem_bytes") or 0),
+                   "elided_dma_fraction": kd.get("elided_dma_fraction")}
+            for name, kd in ks["kernels"].items()}
+        dense = int(ks.get("dense_bytes") or 0)
+        dma = int(ks.get("dma_bytes") or 0)
+        return {
+            "per_kernel": per,
+            "vmem_high_water_bytes": max(
+                (k["vmem_bytes"] for k in per.values()), default=0),
+            "vmem_budget_bytes": int(ks.get("vmem_budget_bytes") or 0),
+            "elided_dma_fraction": (1.0 - dma / dense) if dense else None,
+            "expected_elision": ks.get("expected_elision"),
+        }
+    return None
+
+
 def _summarize_fleet(events):
     """Fleet block: router-level serving events (`inference/router.py`
     — replica deaths, drains/redispatches, aborts, shed/defer
@@ -178,7 +206,10 @@ def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
     decode = [e for e in events if e.get("event") == "decode_step"]
     fleet = _summarize_fleet(events)
     if not steps and (decode or fleet):
-        return _summarize_serve(decode, fleet=fleet)
+        serve = _summarize_serve(decode, fleet=fleet)
+        if serve is not None:
+            serve["kernels"] = _kernel_summary(events)
+        return serve
     if not steps and not any(
             e.get("event") in ("restart", "recovery_ladder",
                                "checkpoint_fallback", "supervisor_done")
@@ -246,6 +277,7 @@ def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
         "tokens_per_s": tokens_per_s,
         "mfu": mfu,
         "collective_wire": _wire_bytes_per_step(events),
+        "kernels": _kernel_summary(events),
         "last_loss": losses[-1] if losses else None,
         "events": {
             "recompile": sum(1 for e in events
@@ -457,8 +489,29 @@ def print_serve_summary(s, out=None):
         print(f"  speculative wall: draft {_fmt_s(ws['draft_s'])} / "
               f"verify {_fmt_s(ws['verify_s'])} ({frac} drafting), "
               f"effective {etps} tokens/s", file=out)
+    if s.get("kernels"):
+        print_kernel_block(s["kernels"], out=out)
     if s.get("fleet"):
         print_fleet_block(s["fleet"], out=out)
+
+
+def print_kernel_block(kn, out=None):
+    budget = kn.get("vmem_budget_bytes") or 0
+    frac = kn.get("elided_dma_fraction")
+    frac_s = f"{frac * 100:.1f}%" if frac is not None else "-"
+    line = (f"  kernels: VMEM high-water "
+            f"{kn['vmem_high_water_bytes'] / 1024:,.1f}KB")
+    if budget:
+        line += f" / {budget / (1 << 20):.0f}MB budget"
+    line += f", elided DMA {frac_s}"
+    if kn.get("expected_elision") is not None:
+        line += f" (contract >= {kn['expected_elision'] * 100:.1f}%)"
+    print(line, file=out)
+    for name, kd in kn["per_kernel"].items():
+        ef = kd.get("elided_dma_fraction")
+        ef_s = f"{ef * 100:5.1f}%" if ef is not None else "    -"
+        print(f"    {name:<14s} VMEM {kd['vmem_bytes'] / 1024:>9,.1f}KB  "
+              f"elided DMA {ef_s}", file=out)
 
 
 def print_fleet_block(fl, out=None):
@@ -513,6 +566,8 @@ def print_summary(s, out=None):
               f"{w['quantized_bytes'] / 1024:,.1f}KB "
               f"({w['quantized_share'] * 100:.1f}%) in 1-byte quantized "
               f"form", file=out)
+    if s.get("kernels"):
+        print_kernel_block(s["kernels"], out=out)
     ev = s["events"]
     guards = ", ".join(f"{k}={v}" for k, v in
                        sorted(ev["health_guard"].items())) or "none"

@@ -1,0 +1,380 @@
+"""Sub-``pallas_call`` static analyzer (`analysis/kernels.py`) and the
+kernel rule family (`analysis/rules.py` kernel_vmem / kernel_tiling /
+kernel_dma).
+
+Two halves:
+
+- seeded violations — four deliberately broken toy kernels, each
+  surfacing as EXACTLY its expected finding (over-VMEM block, tile
+  misalignment, unclamped index map failing the elision contract,
+  grid-write race);
+- stock kernels — the real decode (ring + paged) and train
+  flash-attention programs come back zero-findings, and the proven
+  KV elided-DMA fraction equals the scenario's dead-block occupancy
+  (the static proof of the flash-decode clamp trick).
+
+Everything runs interpret-mode on CPU; the analyzer never executes a
+kernel on hardware.
+"""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+
+from deepspeed_tpu.analysis.audit import (
+    audit_decode,
+    audit_flash_train,
+)
+from deepspeed_tpu.analysis.cost import estimate_step_cost
+from deepspeed_tpu.analysis.kernels import (
+    analyze_kernels,
+    ring_dead_block_fraction,
+)
+from deepspeed_tpu.analysis.rules import (
+    SEV_ERROR,
+    SEV_WARNING,
+    StepContext,
+    run_rules,
+)
+
+KERNEL_RULES = {"kernel_vmem", "kernel_tiling", "kernel_dma"}
+
+# The audit toys' kernel-analysis scenario: positions [8, 16] over
+# max_seq 32 at block_k 8 (see audit._kernel_analysis_for).
+TOY_EXPECTED_ELISION = ring_dead_block_fraction([8, 16], 32, 8)
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def _kernel_rule_findings(ana, expected_elision=None):
+    ctx = StepContext(hlo_text="", flavor="kernel_test",
+                      kernel_analysis=ana,
+                      kernel_expected_elision=expected_elision)
+    return run_rules(ctx, KERNEL_RULES)
+
+
+# ---------------------------------------------------------------------------
+# seeded violations — each one yields exactly its finding
+# ---------------------------------------------------------------------------
+
+def test_seeded_vmem_violation():
+    # (2048, 1024) f32 blocks: 8MB in + 8MB out, double-buffered =
+    # 32MB against the 16MB v5e budget. Interpret mode runs it
+    # happily — only the analyzer knows it can never compile on TPU.
+    x = jnp.zeros((2048, 1024), jnp.float32)
+
+    def fn(x):
+        return pl.pallas_call(
+            _copy_kernel,
+            grid=(1,),
+            in_specs=[pl.BlockSpec((2048, 1024), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((2048, 1024), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((2048, 1024), jnp.float32),
+            interpret=True,
+        )(x)
+
+    ana = analyze_kernels(fn, (x,))
+    assert len(ana.kernels) == 1
+    assert ana.kernels[0].vmem_bytes > ana.vmem_budget_bytes
+
+    findings = _kernel_rule_findings(ana)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "kernel_vmem"
+    assert f.severity == SEV_ERROR
+    assert "exceeds" in f.message
+
+
+def test_seeded_tiling_violation():
+    # Sublane block dim 12 is neither a multiple of the f32 tile (8)
+    # nor the full array extent (24) — every touch pads. The output
+    # block is tile-aligned (8, 128) and passes.
+    x = jnp.zeros((24, 128), jnp.float32)
+
+    def head_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[0:8, :]
+
+    def fn(x):
+        return pl.pallas_call(
+            head_kernel,
+            grid=(2,),
+            in_specs=[pl.BlockSpec((12, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+            interpret=True,
+        )(x)
+
+    ana = analyze_kernels(fn, (x,))
+    findings = _kernel_rule_findings(ana)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "kernel_tiling"
+    assert f.severity == SEV_WARNING
+    assert f.details["block_dim"] == 12
+    assert f.details["tile"] == 8
+
+
+def test_seeded_grid_write_race():
+    # Output map i -> (i % 2, 0) over grid 4 revisits block 0 at steps
+    # 0 and 2: the block is flushed when the grid moves to step 1, so
+    # step 2 reads back stale data.
+    x = jnp.zeros((16, 128), jnp.float32)
+
+    def fn(x):
+        return pl.pallas_call(
+            _copy_kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (i % 2, 0)),
+            out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+            interpret=True,
+        )(x)
+
+    ana = analyze_kernels(fn, (x,))
+    findings = _kernel_rule_findings(ana)
+    # both physical blocks are revisited non-consecutively (0 at steps
+    # 0/2, 1 at steps 1/3) — one race finding each
+    assert len(findings) == 2
+    for f in findings:
+        assert f.rule == "kernel_dma"
+        assert f.severity == SEV_ERROR
+        assert "stale" in f.message
+    assert sorted(tuple(f.details["steps"]) for f in findings) == \
+        [(0, 2), (1, 3)]
+
+
+def _elision_fn(clamped):
+    # A flash-decode-shaped sweep: grid 8 over a (64, 128) "cache",
+    # occupancy says only the first 5 blocks are live. The clamped map
+    # parks the grid on block 4 for the dead tail (consecutive
+    # revisits -> elided DMAs); the unclamped map fetches every dead
+    # block.
+    def fn(x):
+        if clamped:
+            in_map = lambda i: (jnp.minimum(i, 4), 0)
+        else:
+            in_map = lambda i: (i, 0)
+        return pl.pallas_call(
+            _copy_kernel,
+            grid=(8,),
+            in_specs=[pl.BlockSpec((8, 128), in_map)],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((64, 128), jnp.float32),
+            interpret=True,
+        )(x)
+    return fn
+
+
+def test_seeded_unclamped_elision_shortfall():
+    x = jnp.zeros((64, 128), jnp.float32)
+    expected = 3.0 / 8.0  # 3 of 8 grid steps sit past the clamp
+
+    ana = analyze_kernels(_elision_fn(clamped=False), (x,))
+    findings = _kernel_rule_findings(ana, expected_elision=expected)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "kernel_dma"
+    assert f.severity == SEV_WARNING
+    assert "elide only" in f.message
+    assert f.details["proved_elision"] == 0.0
+
+    # The clamped twin proves exactly the contract and passes clean.
+    ana = analyze_kernels(_elision_fn(clamped=True), (x,))
+    (op,) = [op for k in ana.kernels for op in k.operands
+             if op.kind == "input"]
+    assert op.index_map_evaluated
+    assert op.elided_fraction == pytest.approx(expected)
+    assert _kernel_rule_findings(ana, expected_elision=expected) == []
+
+
+# ---------------------------------------------------------------------------
+# stock kernels — zero findings, pinned elision
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ring_report():
+    return audit_decode(kernels=True, kv_layout="ring")
+
+
+@pytest.fixture(scope="module")
+def paged_report():
+    return audit_decode(kernels=True, kv_layout="paged")
+
+
+def _kv_elided_fractions(report):
+    ks = report.stats["kernels"]
+    fracs = []
+    for kd in ks["kernels"].values():
+        for op in kd["operands"].values():
+            if op["kind"] == "input" and \
+                    op["elided_fraction"] == pytest.approx(
+                        TOY_EXPECTED_ELISION):
+                fracs.append(op["elided_fraction"])
+    return fracs
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("layout", ["ring", "paged"])
+def test_stock_decode_zero_findings(layout, ring_report, paged_report):
+    report = ring_report if layout == "ring" else paged_report
+    assert report.findings == []
+    ks = report.stats["kernels"]
+    assert ks["kernels"], "decode program lost its Pallas kernels"
+    assert ks["expected_elision"] == pytest.approx(TOY_EXPECTED_ELISION)
+    for kd in ks["kernels"].values():
+        assert kd["vmem_bytes"] <= ks["vmem_budget_bytes"]
+        assert kd["races"] == []
+        assert kd["tiling"] == []
+        # the proven per-kernel elision beats the contract (q/out
+        # operands elide MORE than the KV floor)
+        assert kd["elided_dma_fraction"] >= TOY_EXPECTED_ELISION
+
+
+@pytest.mark.slow
+def test_clamp_trick_pins_dead_block_fraction(ring_report, paged_report):
+    # The KV operands' proven elided fraction equals the scenario's
+    # dead-block occupancy on BOTH layouts — the ring clamp and the
+    # paged clamp+gather dedupe exactly the dead cache blocks, no more
+    # and no fewer.
+    assert TOY_EXPECTED_ELISION == pytest.approx(0.375)
+    assert len(_kv_elided_fractions(ring_report)) >= 2   # k and v
+    assert len(_kv_elided_fractions(paged_report)) >= 2
+
+
+@pytest.mark.slow
+def test_stock_flash_train_zero_findings():
+    report = audit_flash_train()
+    assert report.findings == []
+    ks = report.stats["kernels"]
+    assert set(ks["kernels"]) == {"kernel", "dq_kernel", "dkv_kernel"}
+    for kd in ks["kernels"].values():
+        # the backward accumulators revisit output blocks ONLY at
+        # consecutive grid steps (carried-accumulator idiom) — no race
+        assert kd["races"] == []
+        assert kd["tiling"] == []
+
+
+# ---------------------------------------------------------------------------
+# cost pricing — elision-aware traffic flips the block_k ranking
+# ---------------------------------------------------------------------------
+
+def _cost_facts(report):
+    ks = report.stats["kernels"]
+    return [{"name": n, "dma_bytes": kd["dma_bytes"],
+             "dense_bytes": kd["dense_bytes"]}
+            for n, kd in ks["kernels"].items()]
+
+
+@pytest.mark.slow
+def test_kernel_traffic_flips_block_k_ranking(paged_report):
+    # Pinned scenario (ISSUE 19): at the toy occupancy, block_k=4
+    # fetches FEWER live bytes (finer blocks track the ragged fill)
+    # but MORE dense bytes (more grid steps re-touch q/out). Dense
+    # pricing therefore prefers block_k=8; the elision-aware DMA
+    # pricing flips the ranking to block_k=4.
+    bk4 = audit_decode(kernels=True, kv_layout="paged",
+                       config_overrides={"attention_block_k": 4})
+    assert bk4.findings == []
+    f4, f8 = _cost_facts(bk4), _cost_facts(paged_report)
+
+    def step_s(facts, traffic):
+        return estimate_step_cost("", n_devices=2, kernel_facts=facts,
+                                  kernel_traffic=traffic).step_seconds
+
+    assert step_s(f4, "dma") < step_s(f8, "dma")
+    assert step_s(f8, "dense") < step_s(f4, "dense")
+
+    with pytest.raises(ValueError, match="kernel_traffic"):
+        estimate_step_cost("", n_devices=2, kernel_facts=f4,
+                           kernel_traffic="bogus")
+
+
+def test_serving_search_space_has_block_dimension():
+    from deepspeed_tpu.analysis.tune import serving_dimensions
+    dims = dict(serving_dimensions({}))
+    assert "block" in dims
+    labels = {c.label for c in dims["block"]}
+    assert {"blk2", "blk4", "blk8"} <= labels
+
+
+# ---------------------------------------------------------------------------
+# flash_decode geometry validation (typed errors at call time)
+# ---------------------------------------------------------------------------
+
+def test_flash_decode_geometry_errors():
+    from deepspeed_tpu.ops.pallas import (
+        KernelGeometryError,
+        flash_decode,
+        flash_decode_paged,
+    )
+    rng = np.random.default_rng(0)
+    B, S, H, D = 1, 16, 2, 8
+    q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    pos = jnp.zeros((B,), jnp.int32)
+
+    assert issubclass(KernelGeometryError, ValueError)
+    # block_k < 1 is a typed geometry error, not a ZeroDivisionError
+    with pytest.raises(KernelGeometryError, match=">= 1"):
+        flash_decode(q, k, v, pos, block_k=0)
+    with pytest.raises(KernelGeometryError, match="multiple"):
+        flash_decode(q, k, v, pos, block_k=12)
+
+    # paged: block_k must divide page_size, validated before lowering
+    n_pages, page_size, ppr = 5, 8, 2
+    pool_k = jnp.zeros((n_pages, page_size, H, D), jnp.float32)
+    pool_v = jnp.zeros((n_pages, page_size, H, D), jnp.float32)
+    tables = jnp.zeros((B, ppr), jnp.int32)
+    with pytest.raises(KernelGeometryError, match="multiple"):
+        flash_decode_paged(q, pool_k, pool_v, pos, tables, block_k=3)
+
+
+def test_pallas_package_exports():
+    import deepspeed_tpu.ops.pallas as ops
+    for name in ("flash_attention", "flash_decode", "flash_decode_paged",
+                 "dense_attention", "pallas_adam_update",
+                 "KernelGeometryError", "DEFAULT_BLOCK_K",
+                 "DEFAULT_MASK_VALUE"):
+        assert name in ops.__all__
+        assert getattr(ops, name) is not None
+
+
+# ---------------------------------------------------------------------------
+# telemetry summary — kernel block from compile-event stats
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_metrics_summary_kernel_block(ring_report):
+    from deepspeed_tpu.telemetry.cli import print_serve_summary, summarize
+
+    events = [
+        {"event": "compile", "step": 0,
+         "kernels": ring_report.stats["kernels"]},
+        {"event": "decode_step", "step": 1, "wall_s": 0.01,
+         "new_tokens": 2},
+        {"event": "decode_step", "step": 2, "wall_s": 0.01,
+         "new_tokens": 2},
+    ]
+    s = summarize(events)
+    kn = s["kernels"]
+    assert kn["vmem_high_water_bytes"] == max(
+        kd["vmem_bytes"]
+        for kd in ring_report.stats["kernels"]["kernels"].values())
+    assert kn["elided_dma_fraction"] == pytest.approx(
+        1.0 - ring_report.stats["kernels"]["dma_bytes"]
+        / ring_report.stats["kernels"]["dense_bytes"])
+    assert kn["expected_elision"] == pytest.approx(TOY_EXPECTED_ELISION)
+
+    out = io.StringIO()
+    print_serve_summary(s, out=out)
+    text = out.getvalue()
+    assert "VMEM high-water" in text
+    assert "elided DMA" in text
+    assert "contract >= 37.5%" in text
