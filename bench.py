@@ -824,6 +824,60 @@ def speculative_ab():
             all(plain_by_rid[c.rid] == c.tokens for c in comps)}
 
 
+def disagg_ab():
+    # disaggregated-vs-colocated serving A/B over the SAME greedy
+    # stream: each tier pins exactly ONE compiled program (2
+    # fleet-wide — the same total the colocated engine carries), the
+    # paged-KV handoff cost is explicit (bytes/session for the page
+    # snapshot that crosses tiers), and greedy outputs must match
+    # bit-for-bit — the split moves work between tiers, never tokens.
+    from deepspeed_tpu.inference.disagg import DisaggCoordinator
+
+    cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32)
+    model = GPT2LMHead(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def stream():
+        r = np.random.default_rng(2)
+        return [Request(f"r{i}",
+                        r.integers(0, cfg.vocab_size,
+                                   int(r.integers(2, 20))).tolist(),
+                        max_new_tokens=6)
+                for i in range(6)]
+
+    def build(tier=None):
+        c = {"max_batch": 2, "seq_buckets": (16, 32),
+             "prefill_chunk": 4, "kv_layout": "paged"}
+        if tier is not None:
+            c["tier"] = tier
+        return InferenceEngine(model, params, config=c)
+
+    colo = build()
+    colo_comps = ContinuousBatchingScheduler(colo).run(stream())
+    coord = DisaggCoordinator([build("prefill")], [build("decode")])
+    comps = coord.run(stream())
+    st = coord.tier_stats()
+    pre_cc = st["prefill"]["compile_counts"]
+    dec_cc = st["decode"]["compile_counts"]
+    colo_by_rid = {c.rid: c.tokens for c in colo_comps}
+    return {
+        "prefill_tier_compile_counts": pre_cc,
+        "decode_tier_compile_counts": dec_cc,
+        "fleet_total_compiles":
+            sum(v for v in pre_cc.values() if v)
+            + sum(v for v in dec_cc.values() if v),
+        "colocated_compile_counts": colo.compile_counts(),
+        "handoffs": st["handoffs"],
+        "handoff_bytes": st["handoff_bytes"],
+        "handoff_bytes_per_session": st["handoff_bytes_per_session"],
+        "reprefills": st["reprefills"],
+        "completions_on_decode_tier":
+            sum(1 for c in comps if c["tier"] == "decode"),
+        "greedy_outputs_match":
+            all(colo_by_rid[c["rid"]] == c["tokens"] for c in comps)}
+
+
 plain = facts(None)
 quant = facts("int8")
 tp = facts(None, mesh=build_mesh({"model": 4},
@@ -839,6 +893,7 @@ out = {"n_devices": len(jax.devices()),
        "flash_ab": [flash_ab(512), flash_ab(4096)],
        "paged_ab": paged_ab(),
        "speculative_ab": speculative_ab(),
+       "disagg_ab": disagg_ab(),
        "kv_bytes_ratio_int8":
            quant["cache_bytes"] / max(plain["cache_bytes"], 1)}
 print(json.dumps(out))
@@ -921,6 +976,123 @@ def run_once_inference(jax, max_batch, n_requests,
             "occupancy": sum(occ) / max(len(occ), 1),
             "completions": len(completions),
             "compiles": engine.compile_counts()}
+
+
+def run_once_disagg(jax, max_batch, n_requests):
+    """GPT-2 125M decode inter-token p95 under concurrent long-prompt
+    prefill load, disaggregated vs colocated — the tentpole's live
+    number. Colocated, every long admission's chunk train runs between
+    decode steps on the one engine, so a live stream's next token
+    waits behind ~7 prefill chunks; the inter-token gap is measured as
+    the host wall between consecutive ``decode_step`` events.
+    Disaggregated, the decode tier runs ONLY the decode program —
+    prefill chunks happen on the other tier's engine — so its
+    inter-token time is the decode step wall itself. Same model, same
+    paged layout, same greedy request mix; outputs must match
+    bit-for-bit."""
+    import time as _time
+
+    from deepspeed_tpu.inference.disagg import DisaggCoordinator
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.scheduler import (
+        ContinuousBatchingScheduler, Request)
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2LMHead, gpt2_125m, init_gpt2_params)
+    from deepspeed_tpu.telemetry.cli import _percentile
+    from deepspeed_tpu.telemetry.session import TelemetrySession
+
+    cfg = gpt2_125m()
+    model = GPT2LMHead(cfg)
+    hb(f"disagg A/B init (125M paged, max_batch={max_batch})")
+    params = init_gpt2_params(model, jax.random.PRNGKey(0))
+    base = {"max_batch": max_batch, "seq_buckets": (128, 512),
+            "prefill_chunk": 64, "kv_layout": "paged"}
+
+    def mix():
+        # decode-heavy foreground plus long-prompt arrivals landing
+        # mid-stream: each arrival costs ~7 prefill chunks before its
+        # first token — the decode-latency hazard the A/B isolates.
+        r = np.random.default_rng(1)
+        reqs = [Request(f"d{i}",
+                        r.integers(0, cfg.vocab_size,
+                                   int(r.integers(8, 48))).tolist(),
+                        max_new_tokens=48, arrival_step=0)
+                for i in range(n_requests)]
+        for j in range(max(n_requests // 4, 2)):
+            reqs.append(Request(
+                f"long{j}",
+                r.integers(0, cfg.vocab_size, 460).tolist(),
+                max_new_tokens=4, arrival_step=6 * (j + 1)))
+        return reqs
+
+    def warmup_req(rid):
+        r = np.random.default_rng(9)
+        return Request(rid, r.integers(0, cfg.vocab_size, 8).tolist(),
+                       max_new_tokens=4)
+
+    def colocated():
+        session = TelemetrySession(history=1_000_000)
+        eng = InferenceEngine(model, params, config=dict(base),
+                              session=session)
+        sched = ContinuousBatchingScheduler(eng)
+        hb("disagg A/B: colocated warmup (compile both programs)")
+        sched.run([warmup_req("warmup-colo")])
+        stamps = []
+        orig = session.emit
+
+        def emit(event, **fields):
+            if event == "decode_step":
+                stamps.append(_time.perf_counter())
+            return orig(event, **fields)
+
+        session.emit = emit
+        hb("disagg A/B: colocated measured stream")
+        # run() returns the cumulative list — drop the warmup entry
+        comps = [c for c in sched.run(mix())
+                 if not c.rid.startswith("warmup")]
+        gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+        return comps, gaps
+
+    def disagg():
+        session = TelemetrySession(history=1_000_000)
+        coord = DisaggCoordinator(
+            [InferenceEngine(model, params,
+                             config=dict(base, tier="prefill"))],
+            [InferenceEngine(model, params,
+                             config=dict(base, tier="decode"))],
+            session=session)
+        hb("disagg A/B: tiered warmup (one compile per tier)")
+        coord.run([warmup_req("warmup-disagg")])
+        n0 = len(session.events.recent(event="decode_step"))
+        hb("disagg A/B: tiered measured stream")
+        comps = [c for c in coord.run(mix())
+                 if not c["rid"].startswith("warmup")]
+        evts = session.events.recent(event="decode_step")[n0:]
+        walls = [float(e["wall_s"]) for e in evts]
+        return comps, walls, coord.tier_stats()
+
+    colo_comps, colo_gaps = colocated()
+    dis_comps, dis_walls, st = disagg()
+    cp50, cp95 = (_percentile(sorted(colo_gaps), 0.50),
+                  _percentile(sorted(colo_gaps), 0.95))
+    dp50, dp95 = (_percentile(sorted(dis_walls), 0.50),
+                  _percentile(sorted(dis_walls), 0.95))
+    colo_by_rid = {c.rid: c.tokens for c in colo_comps}
+    return {
+        "colocated_intertoken_p50_s": cp50,
+        "colocated_intertoken_p95_s": cp95,
+        "disagg_intertoken_p50_s": dp50,
+        "disagg_intertoken_p95_s": dp95,
+        "p95_speedup": (cp95 / max(dp95, 1e-9)
+                        if cp95 is not None and dp95 is not None
+                        else None),
+        "requests": len(dis_comps),
+        "prefill_tier_compile_counts": st["prefill"]["compile_counts"],
+        "decode_tier_compile_counts": st["decode"]["compile_counts"],
+        "handoff_bytes_per_session": st["handoff_bytes_per_session"],
+        "greedy_outputs_match":
+            all(colo_by_rid[c["rid"]] == c["tokens"]
+                for c in dis_comps)}
 
 
 def run_once_fp8(jax, fp8_on, batch_size, seq_len, steps):
@@ -1861,6 +2033,7 @@ def main():
         ratio_4096 = (ab.get("4096") or {}).get("flash_bytes_ratio")
         pab = facts.get("paged_ab") or {}
         sab = facts.get("speculative_ab") or {}
+        dab = facts.get("disagg_ab") or {}
         if not on_tpu:
             cc = (facts.get("plain") or {}).get("compile_counts") or {}
             total = sum(v for v in cc.values() if v)
@@ -1893,6 +2066,17 @@ def main():
                        else None,
                    "speculative_greedy_outputs_match":
                        sab.get("greedy_outputs_match"),
+                   "disagg_ab": {
+                       "prefill_tier_compile_counts":
+                           dab.get("prefill_tier_compile_counts"),
+                       "decode_tier_compile_counts":
+                           dab.get("decode_tier_compile_counts"),
+                       "fleet_total_compiles":
+                           dab.get("fleet_total_compiles"),
+                       "handoff_bytes_per_session":
+                           dab.get("handoff_bytes_per_session"),
+                       "greedy_outputs_match":
+                           dab.get("greedy_outputs_match")},
                    "static_facts": facts, "live": False,
                    "note": "tokens/sec + latency percentiles require a "
                            f"TPU; backend is {platform!r} — "
@@ -1908,6 +2092,11 @@ def main():
                                        n_requests=nreq,
                                        kv_cache_dtype="int8",
                                        attention_impl="flash")
+            try:
+                disagg = run_once_disagg(jax, max_batch=mb,
+                                         n_requests=max(nreq // 4, 4))
+            except Exception as e:
+                disagg = {"error": f"{type(e).__name__}: {e}"}
             ndev = len(jax.devices())
             out = {"metric": "GPT-2 125M serving decode tokens/sec "
                              f"(greedy, continuous batching, max_batch "
@@ -1938,6 +2127,11 @@ def main():
                    "speculative_mean_accepted":
                        round(sab["mean_accepted"], 4)
                        if sab.get("mean_accepted") is not None
+                       else None,
+                   "disagg_ab": disagg,
+                   "disagg_intertoken_p95_speedup":
+                       round(disagg["p95_speedup"], 3)
+                       if disagg.get("p95_speedup") is not None
                        else None,
                    "static_facts": facts, "live": True}
             save_tpu_result(out)

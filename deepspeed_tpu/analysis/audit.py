@@ -69,7 +69,13 @@ STEP_FLAVORS = ("dense", "zero1", "zero2", "zero3", "offload", "quantized",
 # draft / verify, plain decode at zero entries), the draft-truncation
 # flop ratio, accept-loop invariants, and host-transfer hygiene of the
 # draft and verify programs.
-EXTRA_FLAVORS = ("pipeline_tp", "fp8", "decode", "speculative")
+# `disagg` builds one prefill-tier and one decode-tier engine
+# (heterogeneous max_batch), streams requests through the synchronous
+# disaggregation coordinator, and audits the ONE-program-per-tier
+# compile pins, the cross-tier handoff geometry, and host-transfer
+# hygiene of the decode tier's steady-state program.
+EXTRA_FLAVORS = ("pipeline_tp", "fp8", "decode", "speculative",
+                 "disagg")
 
 
 class AuditError(RuntimeError):
@@ -1021,6 +1027,112 @@ def audit_speculative(rules=None, config_overrides=None,
     return report
 
 
+def audit_disagg(rules=None, config_overrides=None):
+    """Audit the disaggregated prefill/decode tiers (ISSUE 20).
+
+    Builds one prefill-tier and one decode-tier engine over the SAME
+    tiny model params but deliberately heterogeneous ``max_batch``
+    (2 vs 3 — tiers size independently; the handoff contract pins only
+    the paged geometry), drives a scripted mixed-length stream through
+    the synchronous `inference/disagg.py:DisaggCoordinator`, then runs
+    the rule catalog over the decode tier's post-stream program:
+
+    - one-program-per-tier pins (``disagg_tier_counts``): after the
+      whole stream the prefill tier's jit census must read
+      ``{prefill: 1, decode: 0}`` and the decode tier's the inverse —
+      the warmup-to-drain contract that makes tier capacity planning
+      a pure host-side concern;
+    - handoff geometry (``disagg_page_facts``): ``page_size`` /
+      ``pages_per_row`` equal across tiers, because the handoff is a
+      raw page copy keyed by the page table;
+    - zero host-transfer ops in the decode tier's steady-state HLO
+      (the handoff itself rides the store OUTSIDE the compiled
+      programs) plus the standard paged-decode hygiene: donation of
+      the paged pool, cache-dtype census, pool-geometry consistency.
+    """
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.cache import cache_dtype_census
+    from deepspeed_tpu.inference.disagg import DisaggCoordinator
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.scheduler import Request
+    from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
+
+    t0 = time.perf_counter()
+    cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32)
+    model = GPT2LMHead(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    base = {"seq_buckets": (16, 32), "prefill_chunk": 4,
+            "attention_block_k": 8, "kv_layout": "paged"}
+    base.update(config_overrides or {})
+    pre_engine = InferenceEngine(model, params, config=dict(
+        base, max_batch=2, tier="prefill"))
+    dec_engine = InferenceEngine(model, params, config=dict(
+        base, max_batch=3, tier="decode"))
+    coord = DisaggCoordinator([pre_engine], [dec_engine])
+    rng = np.random.default_rng(0)
+    # mixed-length stream across both buckets: short prompts, a
+    # long-bucket prompt, and a mid-length one — every request crosses
+    # the handoff (max_new_tokens > 1 keeps them off the
+    # finish-at-prefill fast path)
+    stream = [
+        Request("r0", rng.integers(0, cfg.vocab_size, 3).tolist(),
+                max_new_tokens=4),
+        Request("r1", rng.integers(0, cfg.vocab_size, 20).tolist(),
+                max_new_tokens=6),
+        Request("r2", rng.integers(0, cfg.vocab_size, 6).tolist(),
+                max_new_tokens=3),
+        Request("r3", rng.integers(0, cfg.vocab_size, 12).tolist(),
+                max_new_tokens=5),
+    ]
+    completions = coord.run(stream)
+    hlo_text, expected, pinfo = _lower_step(
+        dec_engine._decode, dec_engine.decode_lowering_args())
+    tier_counts = {"prefill": pre_engine.compile_counts(),
+                   "decode": dec_engine.compile_counts()}
+    page_facts = {t: {"page_size": e.page_size,
+                      "pages_per_row": e.pages_per_row,
+                      "n_pages": e.n_pages, "max_seq": e.max_seq}
+                  for t, e in (("prefill", pre_engine),
+                               ("decode", dec_engine))}
+    census = cache_dtype_census(dec_engine.cache)
+    payload_shape = (dec_engine.spec.n_pages,
+                     dec_engine.spec.page_size,
+                     dec_engine.spec.n_head, dec_engine.spec.head_dim)
+    ctx = StepContext(
+        hlo_text=hlo_text, flavor="disagg",
+        compute_dtype="f32",
+        expected_donated_params=expected, donated_param_info=pinfo,
+        declared_donate_argnums=getattr(
+            dec_engine._decode, "_ds_donate_argnums", None),
+        decode_compile_counts=dec_engine.compile_counts(),
+        decode_kv_cache_dtype=dec_engine.kv_cache_dtype,
+        decode_cache_census=census,
+        decode_attention_impl=dec_engine.attention_impl,
+        decode_cache_payload_shape=payload_shape,
+        decode_platform=jax.devices()[0].platform,
+        decode_kv_layout="paged",
+        decode_page_facts=page_facts["decode"],
+        disagg_tier_counts=tier_counts,
+        disagg_page_facts=page_facts,
+        skip_rules={"recompile"})
+    findings = run_rules(ctx, rules)
+    findings.extend(pre_engine.recompile_findings())
+    findings.extend(dec_engine.recompile_findings())
+    report = AuditReport(flavor="disagg", findings=findings)
+    report.stats = _hlo_stats(hlo_text, ctx)
+    report.hlo_text = hlo_text
+    report.stats["tier_compile_counts"] = tier_counts
+    report.stats["tier_page_facts"] = page_facts
+    report.stats["tiers"] = coord.tier_stats()
+    report.stats["completions"] = len(completions)
+    report.stats["finish_reasons"] = sorted(
+        c["finish_reason"] for c in completions)
+    report.stats["cache"] = dec_engine.cache_facts()
+    report.stats["audit_wall_s"] = round(time.perf_counter() - t0, 3)
+    return report
+
+
 def audit_flash_train(rules=None, batch=1, seq=128, n_head=2,
                       head_dim=128, block_q=64, block_k=64):
     """Audit the training flash-attention kernels (forward + both
@@ -1102,6 +1214,9 @@ def audit_flavors(flavors=None, rules=None, steps=0,
             continue
         if flavor == "speculative":
             out[flavor] = audit_speculative(rules=rules)
+            continue
+        if flavor == "disagg":
+            out[flavor] = audit_disagg(rules=rules)
             continue
         engine, batch = build_flavor_engine(
             flavor, config_overrides=config_overrides)

@@ -167,6 +167,17 @@ class StepContext:
     spec_verify_hlo: str = None
     spec_draft_flops: float = 0.0
     spec_full_flops: float = 0.0
+    # Disaggregated serving (`inference/disagg.py`):
+    # disagg_tier_counts is {tier: compile_counts} after a scripted
+    # stream ran through both tiers — the ONE-program-per-tier pin
+    # (prefill tier {prefill: 1, decode: 0}, decode tier inverted; any
+    # other census means a tier entered the other tier's program and
+    # the whole point of the split is gone). disagg_page_facts is
+    # {tier: cache_facts()} for the handoff-geometry pin: the KV
+    # handoff is a raw page copy keyed by the page table, so
+    # page_size/pages_per_row must match across tiers exactly.
+    disagg_tier_counts: dict = None
+    disagg_page_facts: dict = None
     # Pallas kernel analysis (`analysis/kernels.py`): kernel_analysis is
     # the step's `KernelAnalysis` (None = the sub-pallas_call pass did
     # not run; the kernel_* rules are inert). kernel_expected_elision is
@@ -754,10 +765,19 @@ def rule_decode(ctx):
     ``pages_per_row * page_size`` must cover ``max_seq`` exactly, else
     some row positions have no page-table entry and decode reads the
     trash page as live KV).
+
+    Disaggregated tiers (``disagg_tier_counts``): each tier pins
+    exactly ONE compiled program — its own — warmup-to-drain; an entry
+    in the other tier's jit cache means the tier boundary leaked (a
+    prefill worker decoding, or vice versa). And because the handoff
+    is a raw page copy keyed by the page table, both tiers must share
+    ``page_size``/``pages_per_row`` exactly (``disagg_page_facts``) —
+    a mismatch scatters prefilled KV into the wrong pool offsets.
     """
     if ctx.decode_compile_counts is None and \
             ctx.decode_cache_census is None and \
-            ctx.decode_kv_layout is None:
+            ctx.decode_kv_layout is None and \
+            ctx.disagg_tier_counts is None:
         return []
     findings = []
     if ctx.decode_kv_layout == "paged":
@@ -794,6 +814,38 @@ def rule_decode(ctx):
                     f"cover max_seq={max_seq} — positions past the "
                     f"table read the trash page as live KV",
                     {"page_facts": dict(pf)}))
+    if ctx.disagg_tier_counts:
+        pins = {"prefill": {"prefill": 1, "decode": 0},
+                "decode": {"prefill": 0, "decode": 1}}
+        for tier, counts in sorted(ctx.disagg_tier_counts.items()):
+            want = pins.get(tier)
+            if want is None:
+                continue
+            got = {p: int((counts or {}).get(p) or 0)
+                   for p in ("prefill", "decode")}
+            if got != want:
+                findings.append(Finding(
+                    "decode", SEV_ERROR,
+                    f"disaggregated {tier} tier holds compile counts "
+                    f"{got} (expected {want}) — each tier pins exactly "
+                    f"one compiled program, its own, warmup-to-drain; "
+                    f"any entry in the other tier's program means the "
+                    f"tier boundary leaked",
+                    {"tier": tier, "counts": got, "expected": want}))
+    dpf = ctx.disagg_page_facts
+    if dpf and "prefill" in dpf and "decode" in dpf:
+        for key in ("page_size", "pages_per_row"):
+            a = (dpf.get("prefill") or {}).get(key)
+            b = (dpf.get("decode") or {}).get(key)
+            if a != b:
+                findings.append(Finding(
+                    "decode", SEV_ERROR,
+                    f"handoff geometry mismatch: prefill tier {key}="
+                    f"{a} vs decode tier {key}={b} — the KV handoff "
+                    f"is a raw page copy keyed by the page table, so "
+                    f"both tiers must share the paged geometry "
+                    f"exactly",
+                    {"key": key, "prefill": a, "decode": b}))
     for prog, n in sorted((ctx.decode_compile_counts or {}).items()):
         if n is not None and n > ctx.decode_expected_compiles:
             findings.append(Finding(

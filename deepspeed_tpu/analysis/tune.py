@@ -73,7 +73,7 @@ REJECT_BUILD_ERROR = "candidate_build_error"
 REJECT_RULE_FINDINGS = "audit_rule_findings"
 
 DIMENSION_NAMES = ("zero", "fp8", "overlap", "batch", "remat", "scan")
-SERVING_DIMENSION_NAMES = ("page", "park", "block")
+SERVING_DIMENSION_NAMES = ("page", "chunk", "batch", "park", "block")
 
 
 def deep_merge(base, overrides):
@@ -183,6 +183,17 @@ def serving_dimensions(base_config):
     blocks elide dead-cache DMAs at finer granularity — visible to the
     score only because `evaluate_serving_candidate` prices kernel HBM
     traffic from the analyzer's elision-aware DMA bytes.
+
+    ``chunk`` sweeps ``prefill_chunk`` — the disaggregated prefill
+    tier's unit of work AND the page-size alignment quantum, so a
+    chunk that no longer divides the candidate's page size (or exceeds
+    a bucket) is engine-rejected and surfaces as a typed
+    ``candidate_build_error``, never a silent skip. ``batch`` sweeps
+    decode ``max_batch``: more concurrent rows amortize weight
+    streaming per token but multiply the KV pool pressure; with
+    disaggregated tiers (ISSUE 20) these two dimensions are exactly
+    the per-tier sizing knobs (``prefill_chunk`` for the prefill tier,
+    ``max_batch`` for the decode tier).
     """
     inf = base_config.get("inference") or {}
     pc = int(inf.get("prefill_chunk", 4))
@@ -191,13 +202,20 @@ def serving_dimensions(base_config):
     page = [Choice(f"page{pc * mult}",
                    {"inference": {"page_size": pc * mult}})
             for mult in (1, 2, 4) if pc * mult <= max_seq]
+    chunk = [Choice(f"chunk{c}",
+                    {"inference": {"prefill_chunk": c}})
+             for c in (2, 4, 8) if c <= max_seq]
+    batch = [Choice(f"batch{b}",
+                    {"inference": {"max_batch": b}})
+             for b in (1, 2, 4)]
     park = [Choice(f"park{int(t * 100)}",
                    {"inference": {"host_park_threshold": t}})
             for t in (0.0, 0.25, 0.5)]
     block = [Choice(f"blk{bk}",
                     {"inference": {"attention_block_k": bk}})
              for bk in (2, 4, 8) if bk <= max_seq]
-    dims = [("page", page), ("park", park), ("block", block)]
+    dims = [("page", page), ("chunk", chunk), ("batch", batch),
+            ("park", park), ("block", block)]
     return [(name, choices) for name, choices in dims if choices]
 
 

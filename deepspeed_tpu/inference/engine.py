@@ -111,6 +111,23 @@ class InferenceEngine:
         self.prefix_cache = bool(_cfg_get(config, "prefix_cache", True))
         self.host_park_threshold = float(_cfg_get(
             config, "host_park_threshold", DEFAULT_HOST_PARK_THRESHOLD))
+        # disaggregated serving (ISSUE 20): a tiered engine runs ONE of
+        # the two programs — "prefill" tier writes paged KV and never
+        # decodes, "decode" tier resumes handed-off pages and never
+        # prefills. The pin is host-side (calling the other program
+        # raises), so each tier's compile_counts() holds exactly one
+        # entry warmup-to-drain and the other stays at zero.
+        tier = _cfg_get(config, "tier", None)
+        self.tier = str(tier) if tier else None
+        if self.tier not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"inference tier must be 'prefill' or 'decode', got "
+                f"{self.tier!r}")
+        if self.tier is not None and \
+                str(_cfg_get(config, "kv_layout", "ring")) != "paged":
+            raise ValueError(
+                "tiered (disaggregated) engines require kv_layout="
+                "'paged' — the KV handoff is a page copy")
         if self.attention_impl not in ("dense", "flash"):
             raise ValueError(
                 f"inference.attention.impl must be 'dense' or 'flash', "
@@ -243,6 +260,11 @@ class InferenceEngine:
         # plain decode program must stay at 0 jit-cache entries.
         from deepspeed_tpu.inference.speculative import build_speculative
         self.speculative = build_speculative(self, config)
+        if self.tier is not None and self.speculative is not None:
+            raise ValueError(
+                "inference.speculative cannot combine with a tiered "
+                "(disaggregated) engine — the draft/verify pair would "
+                "break the one-program-per-tier contract")
 
     # -- compiled programs --------------------------------------------------
 
@@ -330,6 +352,10 @@ class InferenceEngine:
         session's frontier. The skipped span's KV is bit-identical by
         construction: prefill is deterministic, so re-running it would
         write the same bytes the shared pages already carry."""
+        if self.tier == "decode":
+            raise RuntimeError(
+                "decode-tier engine: the prefill program is pinned off "
+                "— prefill belongs to the prefill tier")
         n = len(prompt)
         if not 0 < n <= self.max_seq:
             raise ValueError(
@@ -352,8 +378,12 @@ class InferenceEngine:
             raise ValueError(
                 f"prefill start {start} must be chunk-aligned "
                 f"(chunk={chunk})")
+        from deepspeed_tpu.runtime.resilience import fault_injection
         last = None
         for ci in range(start // chunk, padded // chunk):
+            # disagg soak seam: an armed prefill_chunk kill dies HERE,
+            # mid-prompt, with pages allocated and partially written.
+            fault_injection.maybe_kill("prefill_chunk", ci)
             tc = jnp.asarray(toks[:, ci * chunk:(ci + 1) * chunk])
             pc = jnp.arange(ci * chunk, (ci + 1) * chunk,
                             dtype=jnp.int32)[None, :]
@@ -378,6 +408,10 @@ class InferenceEngine:
         extra device round trip. Paged layout additionally takes the
         ``[max_batch, pages_per_row]`` page tables (inactive rows all
         zeros — their garbage token lands on the trash page)."""
+        if self.tier == "prefill":
+            raise RuntimeError(
+                "prefill-tier engine: the decode program is pinned off "
+                "— decode belongs to the decode tier")
         t = jnp.asarray(np.asarray(tokens, np.int32))
         p = jnp.asarray(np.asarray(positions, np.int32))
         if self.kv_layout == "paged":
@@ -409,6 +443,22 @@ class InferenceEngine:
         gathered = jax.tree_util.tree_map(
             lambda leaf: jnp.take(leaf, ids, axis=axis), self.cache)
         return _snapshot_to_host(gathered)
+
+    def gather_pages_device(self, page_ids):
+        """Like :meth:`gather_pages` but the snapshot STAYS on device:
+        a pytree of fresh (immutable) device arrays, never a host round
+        trip. This is the in-process disaggregated handoff's source
+        half — the decode tier scatters these arrays straight into its
+        own pool (:meth:`scatter_pages` accepts device values), so the
+        prefill→decode page copy is device-to-device and keyed purely
+        by page ids. The copies are materialized eagerly so they can't
+        alias pool buffers a later donated prefill call invalidates."""
+        ids = jnp.asarray(np.asarray(page_ids, np.int32))
+        axis = 1 if self.spec.stacked else 0
+        gathered = jax.tree_util.tree_map(
+            lambda leaf: jnp.take(leaf, ids, axis=axis), self.cache)
+        jax.block_until_ready(gathered)
+        return gathered
 
     def scatter_pages(self, page_ids, host_pages):
         """Inverse of :meth:`gather_pages`: write a host page snapshot
@@ -523,6 +573,8 @@ class InferenceEngine:
             facts.update(page_size=self.page_size,
                          n_pages=self.n_pages,
                          pages_per_row=self.pages_per_row)
+        if self.tier is not None:
+            facts["tier"] = self.tier
         if self.speculative is not None:
             facts["speculative"] = self.speculative.facts()
         return facts

@@ -184,6 +184,50 @@ class ThreadReplica:
         """Post-death cleanup (pipes for processes; nothing here)."""
 
 
+class TierThreadReplica(ThreadReplica):
+    """In-process TIER replica for disaggregated serving: the factory
+    returns a `inference/disagg.py` ``PrefillWorker``/``DecodeWorker``
+    instead of an engine, and the loop drives its submit/step/
+    drain_outputs surface. Lifecycle semantics are ThreadReplica's
+    exactly — kill vanishes mid-flight, preempt finishes the step and
+    exits without the done flag, an unhandled exception is a crash —
+    so the DisaggRouter health-checks both backends identically."""
+
+    def submit(self, request, meta=None):
+        with self._lock:
+            self._inbox.append((request, meta))
+
+    def _run(self):
+        try:
+            worker = self.engine_factory()
+            while True:
+                if self._kill.is_set():
+                    return          # SIGKILL analogue: vanish mid-flight
+                with self._lock:
+                    while self._inbox:
+                        req, meta = self._inbox.popleft()
+                        worker.submit(req, meta)
+                has_work = worker.has_work
+                if has_work:
+                    self._busy = True
+                    worker.step()   # fault probes live inside
+                    self._last_progress = time.monotonic()
+                    self._busy = False
+                with self._lock:
+                    self._outbox.extend(worker.drain_outputs())
+                if self._preempt.is_set():
+                    self._preempted = True
+                    return
+                if not has_work:
+                    if self._stop.is_set():
+                        self._stats = worker.stats()
+                        self._done_flag = True
+                        return
+                    time.sleep(0.0005)
+        except BaseException as e:      # noqa: BLE001 - crash envelope
+            self._error = e
+
+
 class ProcessReplica:
     """Subprocess replica: `fleet_worker.py` over JSONL pipes.
 
@@ -269,6 +313,14 @@ class ProcessReplica:
                 if kind == "completion":
                     with self._lock:
                         self._outbox.append(msg["completion"])
+                elif kind in ("prefilled", "handoff_corrupt",
+                              "handoff_missing", "handoff_error"):
+                    # disaggregated tier outputs (ISSUE 20): the payload
+                    # travels as-is, tagged with its kind so the
+                    # DisaggRouter can route it.
+                    with self._lock:
+                        self._outbox.append(
+                            dict(msg["payload"], kind=kind))
                 elif kind == "ready":
                     self.ready.set()
                 elif kind in ("stats", "preempted"):
@@ -360,6 +412,22 @@ class ProcessReplica:
                     stream.close()
             except OSError:
                 pass
+
+
+class TierProcessReplica(ProcessReplica):
+    """Subprocess TIER replica: the worker boots with ``spec["tier"]``
+    set to ``"prefill"``/``"decode"`` and a shared ``handoff_dir``,
+    builds the matching tier engine + worker, and speaks the same JSONL
+    protocol plus the handoff kinds (``prefilled``/``handoff_corrupt``/
+    ``handoff_missing``/``handoff_error``). A decode-tier submit
+    carries the :class:`~deepspeed_tpu.inference.disagg.HandoffMeta`
+    dict alongside the request."""
+
+    def submit(self, request, meta=None):
+        msg = {"cmd": "submit", "request": request_dict(request)}
+        if meta is not None:
+            msg["handoff"] = dict(meta)
+        self._send(msg)
 
 
 def build_process_fleet(n, spec, workdir, inject=None, inject_replica=0,

@@ -101,6 +101,89 @@ class _CommandReader:
         return cmds
 
 
+def _request_from(d):
+    from deepspeed_tpu.inference.scheduler import Request
+    return Request(
+        rid=str(d["rid"]),
+        prompt=[int(t) for t in d["prompt"]],
+        max_new_tokens=int(d.get("max_new_tokens", 16)),
+        eos_id=d.get("eos_id"),
+        arrival_step=int(d.get("arrival_step", 0)),
+        session_id=d.get("session_id"),
+        deadline_s=d.get("deadline_s"),
+        queue_timeout_s=d.get("queue_timeout_s"),
+        redispatched=int(d.get("redispatched", 0)),
+        restarts=int(d.get("restarts", 0)))
+
+
+def _tier_loop(worker, engine, handler, reader, session, workdir,
+               index):
+    """Serve loop for a disaggregated TIER worker (``spec["tier"]``):
+    same lifecycle contract as the colocated loop below — streamed
+    outputs, heartbeats, preemption/stop semantics — but driving a
+    `inference/disagg.py` PrefillWorker/DecodeWorker instead of the
+    colocated scheduler. Handoff outputs travel as their own JSONL
+    kinds (``prefilled``/``handoff_corrupt``/...)."""
+    from deepspeed_tpu.runtime.resilience import fault_injection
+
+    def _steps():
+        return worker.sched.step_count if hasattr(worker, "sched") \
+            else worker.steps
+
+    reported = 0
+    stalled_until = 0.0
+    stopping = False
+    while True:
+        if not worker.has_work and not stopping:
+            time.sleep(0.002)
+        for cmd in reader.drain():
+            if cmd.get("cmd") == "submit":
+                worker.submit(_request_from(cmd["request"]),
+                              cmd.get("handoff"))
+            elif cmd.get("cmd") == "stop":
+                stopping = True
+
+        has_work = worker.has_work
+        if has_work:
+            worker.step()       # kill/decode fault probes fire inside
+
+        for out in worker.drain_outputs():
+            kind = out.pop("kind", "completion")
+            if kind == "completion":
+                reported += 1
+                _out({"type": "completion", "completion": out})
+            else:
+                _out({"type": kind, "payload": out})
+
+        now = time.time()
+        stall = fault_injection.heartbeat_stall_seconds(_steps())
+        if stall:
+            stalled_until = now + stall
+        if now >= stalled_until:
+            _write_heartbeat(workdir, index, _steps(), has_work)
+
+        if handler.preempted:
+            if session is not None:
+                session.emit("preemption", step=_steps(),
+                             completed=reported, replica=index,
+                             tier=worker.tier)
+                session.close()
+            _out({"type": "preempted", "completed": reported,
+                  "steps": _steps(), "tier": worker.tier})
+            return 0            # exit 0, NO done marker -> preemption
+
+        if stopping and not has_work:
+            break
+
+    _out(dict(worker.stats(), type="stats", replica=index))
+    if session is not None:
+        session.close()
+    from deepspeed_tpu.runtime.supervisor.supervisor import done_path
+    with open(done_path(workdir, index), "w") as f:
+        f.write("done\n")
+    return 0
+
+
 def main():
     index = int(os.environ.get("DS_TPU_RUN_PROCESS_INDEX", "0"))
     workdir = os.environ.get("DS_TPU_RUN_WORKDIR", os.getcwd())
@@ -116,7 +199,7 @@ def main():
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.inference.fleet import completion_dict
     from deepspeed_tpu.inference.scheduler import (
-        ContinuousBatchingScheduler, Request)
+        ContinuousBatchingScheduler)
     from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
     from deepspeed_tpu.runtime.resilience.preemption import (
         PreemptionHandler)
@@ -134,9 +217,26 @@ def main():
     model = GPT2LMHead(cfg)
     toks = jnp.zeros((1, 8), jnp.int32)
     params = model.init(jax.random.PRNGKey(seed), toks)["params"]
-    engine = InferenceEngine(model, params,
-                             config=spec.get("inf_cfg") or {},
+    tier = spec.get("tier")
+    inf_cfg = dict(spec.get("inf_cfg") or {})
+    if tier:
+        inf_cfg["tier"] = tier
+    engine = InferenceEngine(model, params, config=inf_cfg,
                              session=session)
+
+    if tier:
+        from deepspeed_tpu.inference.disagg import (
+            DecodeWorker, FileHandoffStore, PrefillWorker)
+        store = FileHandoffStore(spec["handoff_dir"])
+        worker = (PrefillWorker if tier == "prefill"
+                  else DecodeWorker)(engine, store, session=session)
+        handler = PreemptionHandler().install()
+        reader = _CommandReader()
+        _out({"type": "ready", "pid": os.getpid(), "replica": index,
+              "tier": tier})
+        return _tier_loop(worker, engine, handler, reader, session,
+                          workdir, index)
+
     sched = ContinuousBatchingScheduler(engine)
 
     handler = PreemptionHandler().install()
@@ -153,18 +253,7 @@ def main():
             time.sleep(0.002)
         for cmd in reader.drain():
             if cmd.get("cmd") == "submit":
-                d = cmd["request"]
-                sched.submit(Request(
-                    rid=str(d["rid"]),
-                    prompt=[int(t) for t in d["prompt"]],
-                    max_new_tokens=int(d.get("max_new_tokens", 16)),
-                    eos_id=d.get("eos_id"),
-                    arrival_step=int(d.get("arrival_step", 0)),
-                    session_id=d.get("session_id"),
-                    deadline_s=d.get("deadline_s"),
-                    queue_timeout_s=d.get("queue_timeout_s"),
-                    redispatched=int(d.get("redispatched", 0)),
-                    restarts=int(d.get("restarts", 0))))
+                sched.submit(_request_from(cmd["request"]))
             elif cmd.get("cmd") == "stop":
                 stopping = True
 

@@ -130,6 +130,31 @@ class ContinuousBatchingScheduler:
             request.submit_t = time.monotonic()
         self.queue.append(request)
 
+    def admit_prefilled(self, request, row, first_token):
+        """Seed a slot from ANOTHER tier's finished prefill
+        (disaggregated serving, ISSUE 20): the prompt's KV already sits
+        in this engine's pool on ``row``'s pages (installed by the KV
+        handoff) and ``first_token`` was sampled from the prefill-tier
+        logits, so admission here runs NO prefill call — the decode
+        tier's prefill program stays at zero jit-cache entries. Returns
+        False when no slot is free (the caller keeps the handoff
+        queued)."""
+        for i in range(len(self.slots)):
+            if self.slots[i] is not None:
+                continue
+            if request.submit_t is None:
+                request.submit_t = time.monotonic()
+            self.slots[i] = _Slot(
+                request=request, bucket=self._bucket_for(request),
+                next_pos=len(request.prompt), pending=first_token,
+                generated=[first_token],
+                admitted_step=self.step_count, paging=row)
+            # eos / single-token budgets can finish right here, exactly
+            # where the colocated loop's post-admission check fires.
+            self._check_finished(i)
+            return True
+        return False
+
     def _bucket_for(self, request):
         need = len(request.prompt) + request.max_new_tokens
         for b in self.engine.seq_buckets:

@@ -10,6 +10,9 @@
     ds_tpu_serve --synthetic 8 --replicas 2 \
                  --kill-replica 0 --kill-at-step 3 \
                  --expect-redispatch 1    # fleet resilience smoke
+    ds_tpu_serve --synthetic 8 --kv-layout paged --disaggregate \
+                 --prefill-workers 1 --decode-workers 1 \
+                 --expect-compiles 2      # tiered prefill/decode smoke
     ds_tpu_serve --synthetic 8 --speculative --spec-k 4 \
                  --draft-layers 1 --block-scale 0.1 \
                  --expect-compiles 3 --expect-min-accepted 1.0
@@ -28,7 +31,11 @@ with ``--speculative``) jit-cache entries must total exactly N (2 for
 any single-engine serve — one prefill, one decode — and exactly 3
 speculative: prefill, draft, verify, with the plain decode program
 never entered). With ``--replicas`` the gate applies PER SURVIVING
-REPLICA.
+REPLICA. With ``--disaggregate`` it counts DISTINCT compiled programs
+across the whole fleet (2: the prefill tier's one program plus the
+decode tier's), not per-worker jit entries — each worker holds its own
+cache entry for its tier's single program, so entries scale with
+worker count while the program count must not.
 ``--jsonl`` writes telemetry events for ``ds_tpu_metrics summary``
 serve mode (``decode_step`` single-engine; fleet events with
 ``--replicas``).
@@ -328,6 +335,247 @@ def _run_fleet(args, inf_cfg, session):
     return 0 if ok else 1
 
 
+def _run_disagg(args, inf_cfg, session):
+    """Serve through disaggregated prefill/decode tiers (ISSUE 20).
+
+    Each tier pins exactly ONE compiled program warmup-to-drain — the
+    prefill tier never enters the decode jit and vice versa — so the
+    fleet-wide compile total is 2 regardless of worker counts. The
+    process backend hands KV off through a durable
+    ``FileHandoffStore`` under ``workdir/handoff`` (CRC-verified, park/
+    resume survives a dead decode worker); the thread backend uses the
+    consume-once device-to-device ``DeviceHandoffStore``."""
+    import os
+    import tempfile
+
+    from deepspeed_tpu.inference import fleet as fleet_mod
+    from deepspeed_tpu.inference.router import DisaggRouter
+
+    workdir = os.path.abspath(
+        args.workdir or tempfile.mkdtemp(prefix="ds-tpu-disagg-"))
+    max_seq = max(inf_cfg.get("seq_buckets", (16, 32)))
+    requests = _build_requests(args, _TINY_VOCAB, max_seq)
+
+    n_pre, n_dec = args.prefill_workers, args.decode_workers
+    total = n_pre + n_dec
+
+    def tier_inf(tier):
+        # per-tier engine config: tiers scale max_batch independently
+        # (0 / unset falls back to the shared max_batch)
+        cfg = {k: v for k, v in inf_cfg.items()
+               if k not in ("disaggregated", "prefill_workers",
+                            "decode_workers", "prefill_max_batch",
+                            "decode_max_batch")}
+        mb = (args.prefill_max_batch if tier == "prefill"
+              else args.decode_max_batch)
+        if mb is None:
+            mb = int(inf_cfg.get(f"{tier}_max_batch", 0) or 0)
+        if mb:
+            cfg["max_batch"] = mb
+        return cfg
+
+    inject = None
+    if args.kill_prefill_worker is not None:
+        inject = {"kill": {"op": "prefill_chunk",
+                           "at_step": args.kill_at_step}}
+
+    if args.replica_backend == "process":
+        from deepspeed_tpu.inference.disagg import FileHandoffStore
+        handoff_dir = os.path.join(workdir, "handoff")
+        # the router shares the workers' durable store: parked()/drop()
+        # are plain file probes, so tier-aware recovery works from the
+        # parent without touching any device state
+        store = FileHandoffStore(handoff_dir)
+
+        def spawn(i, tier, tag, inj):
+            cfg = {k: (list(v) if isinstance(v, tuple) else v)
+                   for k, v in tier_inf(tier).items()}
+            rspec = {"inf_cfg": cfg, "seed": args.seed,
+                     "scan_layers": args.scan_layers, "tier": tier,
+                     "handoff_dir": handoff_dir,
+                     "jsonl": os.path.join(workdir, f"{tag}.jsonl")}
+            return fleet_mod.TierProcessReplica(
+                i, rspec, workdir, num_replicas=total, inject=inj,
+                hang_timeout_s=args.hang_timeout_s,
+                heartbeat_stale_s=args.heartbeat_stale_s).start()
+
+        # globally-unique indices across tiers: prefill 0..N-1,
+        # decode N..N+M-1 (heartbeats/done markers share the workdir)
+        prefill = [spawn(i, "prefill", f"prefill{i}",
+                         inject if i == args.kill_prefill_worker
+                         else None)
+                   for i in range(n_pre)]
+        decode = [spawn(n_pre + j, "decode", f"decode{j}", None)
+                  for j in range(n_dec)]
+        for r in prefill + decode:
+            r.wait_ready()
+    else:
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.inference.disagg import (
+            DecodeWorker, DeviceHandoffStore, PrefillWorker)
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.models.gpt2 import GPT2LMHead, gpt2_tiny
+
+        store = DeviceHandoffStore()
+
+        def make_factory(tier):
+            cfg_t = dict(tier_inf(tier), tier=tier)
+
+            def factory():
+                cfg = gpt2_tiny(n_embd=32, dtype=jnp.float32,
+                                scan_layers=args.scan_layers)
+                model = GPT2LMHead(cfg)
+                params = model.init(
+                    jax.random.PRNGKey(args.seed),
+                    jnp.zeros((1, 8), jnp.int32))["params"]
+                engine = InferenceEngine(model, params, config=cfg_t)
+                cls = (PrefillWorker if tier == "prefill"
+                       else DecodeWorker)
+                return cls(engine, store)
+            return factory
+
+        prefill = [fleet_mod.TierThreadReplica(
+            i, make_factory("prefill")).start() for i in range(n_pre)]
+        decode = [fleet_mod.TierThreadReplica(
+            n_pre + j, make_factory("decode")).start()
+            for j in range(n_dec)]
+
+    router = DisaggRouter(
+        prefill, decode, store, session=session,
+        max_redispatch=(args.max_redispatch if args.max_redispatch
+                        is not None
+                        else int(inf_cfg.get("max_redispatch", 2))),
+        max_queue_depth=(args.max_queue_depth if args.max_queue_depth
+                         is not None
+                         else int(inf_cfg.get("max_queue_depth", 8))),
+        max_pending=args.max_pending)
+    fr = router.run(requests, timeout_s=args.fleet_timeout)
+
+    # the one-program-per-tier pin is intrinsic to disaggregation:
+    # every surviving worker must hold exactly its own tier's program
+    # and never have entered the other one
+    pins = {"prefill": {"prefill": 1, "decode": 0},
+            "decode": {"prefill": 0, "decode": 1}}
+    tier_bad = []
+    programs = set()
+    for st in fr.stats:
+        cc = st.get("compile_counts") or {}
+        got = {"prefill": cc.get("prefill") or 0,
+               "decode": cc.get("decode") or 0}
+        programs.update(k for k, v in got.items() if v)
+        if got != pins[st["tier"]]:
+            tier_bad.append((st["replica"], st["tier"], got))
+    # the fleet census counts DISTINCT programs, not jit entries:
+    # every worker necessarily holds its own cache entry for its
+    # tier's one program, so entries scale with worker count while the
+    # program count stays 2 — and the pin check above already fails
+    # any worker holding more than its single program
+    total_compiles = len(programs)
+    ok = fr.ok and not tier_bad
+    compiles_ok = True
+    if args.expect_compiles is not None:
+        compiles_ok = total_compiles == args.expect_compiles
+        ok = ok and compiles_ok
+    redisp_ok = True
+    if args.expect_redispatch is not None:
+        redisp_ok = fr.redispatched_total >= args.expect_redispatch
+        ok = ok and redisp_ok
+
+    result = {
+        "requests": len(requests),
+        "completions": fr.completions,
+        "disagg": {
+            "backend": args.replica_backend,
+            "prefill_workers": fr.prefill_replicas,
+            "decode_workers": fr.decode_replicas,
+            "replicas_dead": fr.replicas_dead,
+            "dead_by_tier": fr.dead_by_tier,
+            "dead_causes": dict(router.dead),
+            "redispatched_total": fr.redispatched_total,
+            "aborted": fr.aborted, "shed": fr.shed,
+            "defers": fr.defers, "timeouts": fr.timeouts,
+            "handoffs": fr.handoffs,
+            "handoff_bytes": fr.handoff_bytes,
+            "handoff_bytes_per_session": (
+                fr.handoff_bytes / fr.handoffs if fr.handoffs else 0.0),
+            "handoff_corrupt": fr.handoff_corrupt,
+            "resumed_from_park": fr.resumed_from_park,
+            "latency_s": fr.latency_s,
+            "ttft_s": fr.ttft_s,
+            "total_compiles": total_compiles,
+            "stats": fr.stats,
+            "workdir": workdir,
+        },
+        "ok": ok,
+    }
+    if args.expect_compiles is not None:
+        result["expect_compiles"] = args.expect_compiles
+    if args.expect_redispatch is not None:
+        result["expect_redispatch"] = args.expect_redispatch
+
+    if args.as_json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+    else:
+        for c in fr.completions:
+            extra = ""
+            if c.get("redispatched"):
+                extra += f", redispatched x{c['redispatched']}"
+            if c.get("restarts"):
+                extra += f", re-prefilled x{c['restarts']}"
+            print(f"{c['rid']}: prompt {c['prompt_len']} tokens -> "
+                  f"{len(c['tokens'])} generated "
+                  f"({c['finish_reason']}, replica {c['replica']}"
+                  f"{extra})")
+        dg = result["disagg"]
+        print(f"{len(fr.completions)}/{len(requests)} requests "
+              f"completed across {dg['prefill_workers']}+"
+              f"{dg['decode_workers']} tiered worker(s) "
+              f"({dg['replicas_dead']} died: {dg['dead_causes']}); "
+              f"redispatched={dg['redispatched_total']} "
+              f"aborted={dg['aborted']} timeouts={dg['timeouts']}")
+        for tier in ("prefill", "decode"):
+            sts = [s for s in fr.stats if s["tier"] == tier]
+            # distinct programs the tier's workers hold (1 each when
+            # the pins are honored, whatever the worker count)
+            tp = {"prefill": 0, "decode": 0}
+            for s in sts:
+                for k, v in (s.get("compile_counts") or {}).items():
+                    if v:
+                        tp[k] = 1
+            done = sum(int(s.get("completed", 0)) for s in sts)
+            print(f"{tier} tier: {len(sts)} surviving worker(s), "
+                  f"{done} completion(s); compiles: "
+                  f"prefill={tp['prefill']} decode={tp['decode']}")
+        def _ms(v):
+            return "n/a" if v is None else f"{v * 1e3:.1f}ms"
+        tt, lat = fr.ttft_s, fr.latency_s
+        print(f"handoff: {dg['handoffs']} session(s), "
+              f"{dg['handoff_bytes']} byte(s) "
+              f"({dg['handoff_bytes_per_session']:.0f}/session), "
+              f"corrupt={dg['handoff_corrupt']} "
+              f"resumed_from_park={dg['resumed_from_park']}; "
+              f"ttft p50={_ms(tt['p50'])} p95={_ms(tt['p95'])} "
+              f"p99={_ms(tt['p99'])}; latency p99={_ms(lat['p99'])}")
+        if not ok:
+            if tier_bad:
+                why = (f"per-tier compile pins violated: {tier_bad} "
+                       f"(each worker must hold exactly one program, "
+                       f"its own tier's)")
+            elif not compiles_ok:
+                why = (f"fleet compile total {total_compiles} != "
+                       f"expected {args.expect_compiles}")
+            elif not redisp_ok:
+                why = (f"redispatched {fr.redispatched_total} < "
+                       f"expected {args.expect_redispatch}")
+            else:
+                why = ("unfinished/aborted/shed/timed-out requests "
+                       "in the disaggregated result")
+            print(f"FAIL: {why}", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="ds_tpu_serve",
@@ -489,6 +737,28 @@ def main(argv=None):
     parser.add_argument("--expect-redispatch", type=int, default=None,
                         help="exit 1 unless the fleet redispatched at "
                              "least this many requests")
+    # -- disaggregated prefill/decode tiers (ISSUE 20) -------------------
+    parser.add_argument("--disaggregate", action="store_true",
+                        help="split serving into a prefill tier and a "
+                             "decode tier (one compiled program each; "
+                             "KV pages hand off through the paged "
+                             "store between tiers)")
+    parser.add_argument("--prefill-workers", type=int, default=None,
+                        help="disaggregated: prefill-tier worker count "
+                             "(default from config, else 1)")
+    parser.add_argument("--decode-workers", type=int, default=None,
+                        help="disaggregated: decode-tier worker count "
+                             "(default from config, else 1)")
+    parser.add_argument("--prefill-max-batch", type=int, default=None,
+                        help="disaggregated: prefill-tier max_batch "
+                             "override (0/unset = shared max_batch)")
+    parser.add_argument("--decode-max-batch", type=int, default=None,
+                        help="disaggregated: decode-tier max_batch "
+                             "override (0/unset = shared max_batch)")
+    parser.add_argument("--kill-prefill-worker", type=int, default=None,
+                        help="arm a SIGKILL mid-prefill-chunk in this "
+                             "prefill-tier worker index (process "
+                             "backend)")
     args = parser.parse_args(argv)
 
     if not args.requests and not args.synthetic:
@@ -497,10 +767,36 @@ def main(argv=None):
         parser.error("--requests and --synthetic are mutually exclusive")
     if args.replicas < 1:
         parser.error("--replicas must be >= 1")
-    if args.replicas == 1 and (args.kill_replica is not None or
-                               args.expect_redispatch is not None):
-        parser.error("--kill-replica/--expect-redispatch require "
-                     "--replicas >= 2")
+    if args.replicas == 1 and args.kill_replica is not None:
+        parser.error("--kill-replica requires --replicas >= 2 (use "
+                     "--kill-prefill-worker with --disaggregate)")
+    if args.replicas == 1 and args.expect_redispatch is not None \
+            and not args.disaggregate:
+        parser.error("--expect-redispatch requires --replicas >= 2 "
+                     "or --disaggregate")
+    if args.disaggregate:
+        if args.speculative:
+            parser.error("--disaggregate excludes --speculative (the "
+                         "draft/verify pair would break the one-"
+                         "program-per-tier contract)")
+        if args.replicas > 1:
+            parser.error("--disaggregate and --replicas are mutually "
+                         "exclusive; tiers scale via "
+                         "--prefill-workers/--decode-workers")
+        if args.checkpoint:
+            parser.error("--disaggregate serves the seeded test model "
+                         "only (no --checkpoint)")
+    if args.kill_prefill_worker is not None:
+        if not args.disaggregate:
+            parser.error("--kill-prefill-worker requires --disaggregate")
+        if args.replica_backend != "process":
+            parser.error("--kill-prefill-worker needs --replica-backend "
+                         "process (a thread cannot be SIGKILLed in "
+                         "isolation)")
+    for name in ("prefill_workers", "decode_workers"):
+        v = getattr(args, name)
+        if v is not None and v < 1:
+            parser.error(f"--{name.replace('_', '-')} must be >= 1")
     if args.kill_replica is not None and \
             not 0 <= args.kill_replica < args.replicas:
         parser.error(f"--kill-replica {args.kill_replica} outside "
@@ -566,7 +862,12 @@ def main(argv=None):
                    "max_queue_depth": inf.max_queue_depth,
                    "deadline_s": inf.deadline_s,
                    "queue_timeout_s": inf.queue_timeout_s,
-                   "speculative": inf.speculative}
+                   "speculative": inf.speculative,
+                   "disaggregated": inf.disaggregated,
+                   "prefill_workers": inf.prefill_workers,
+                   "decode_workers": inf.decode_workers,
+                   "prefill_max_batch": inf.prefill_max_batch,
+                   "decode_max_batch": inf.decode_max_batch}
     if args.max_batch is not None:
         inf_cfg["max_batch"] = args.max_batch
     if args.seq_buckets is not None:
@@ -623,6 +924,29 @@ def main(argv=None):
         args.deadline_s = inf_cfg.get("deadline_s") or None
     if args.queue_timeout_s is None:
         args.queue_timeout_s = inf_cfg.get("queue_timeout_s") or None
+    args.disaggregate = args.disaggregate or bool(
+        inf_cfg.get("disaggregated"))
+    if args.disaggregate:
+        if inf_cfg.get("kv_layout", "ring") != "paged":
+            parser.error("--disaggregate requires --kv-layout paged "
+                         "(the prefill->decode handoff is a KV page "
+                         "copy)")
+        if inf_cfg.get("speculative"):
+            parser.error("config enables speculative decoding but the "
+                         "serve is disaggregated; the tiers pin one "
+                         "program each")
+        if args.prefill_workers is None:
+            args.prefill_workers = int(
+                inf_cfg.get("prefill_workers", 1) or 1)
+        if args.decode_workers is None:
+            args.decode_workers = int(
+                inf_cfg.get("decode_workers", 1) or 1)
+        if args.kill_prefill_worker is not None and not \
+                0 <= args.kill_prefill_worker < args.prefill_workers:
+            parser.error(f"--kill-prefill-worker "
+                         f"{args.kill_prefill_worker} outside "
+                         f"0..{args.prefill_workers - 1}")
+        return _run_disagg(args, inf_cfg, session)
     if args.replicas > 1:
         if inf_cfg.get("speculative"):
             parser.error("config enables speculative decoding but the "

@@ -655,7 +655,10 @@ class InferenceConfig:
             INFERENCE_PREFIX_CACHE, INFERENCE_HOST_PARK_THRESHOLD,
             INFERENCE_REPLICAS, INFERENCE_MAX_REDISPATCH,
             INFERENCE_MAX_QUEUE_DEPTH, INFERENCE_DEADLINE_S,
-            INFERENCE_QUEUE_TIMEOUT_S, INFERENCE_SPECULATIVE)
+            INFERENCE_QUEUE_TIMEOUT_S, INFERENCE_SPECULATIVE,
+            INFERENCE_DISAGGREGATED, INFERENCE_PREFILL_WORKERS,
+            INFERENCE_DECODE_WORKERS, INFERENCE_PREFILL_MAX_BATCH,
+            INFERENCE_DECODE_MAX_BATCH)
 
     SPECULATIVE_KEYS = (INFERENCE_SPECULATIVE_ENABLED,
                         INFERENCE_SPECULATIVE_K,
@@ -711,6 +714,20 @@ class InferenceConfig:
             sub, INFERENCE_DEADLINE_S, INFERENCE_DEADLINE_S_DEFAULT)
         self.queue_timeout_s = get_scalar_param(
             sub, INFERENCE_QUEUE_TIMEOUT_S, INFERENCE_QUEUE_TIMEOUT_S_DEFAULT)
+        self.disaggregated = get_scalar_param(
+            sub, INFERENCE_DISAGGREGATED, INFERENCE_DISAGGREGATED_DEFAULT)
+        self.prefill_workers = get_scalar_param(
+            sub, INFERENCE_PREFILL_WORKERS,
+            INFERENCE_PREFILL_WORKERS_DEFAULT)
+        self.decode_workers = get_scalar_param(
+            sub, INFERENCE_DECODE_WORKERS,
+            INFERENCE_DECODE_WORKERS_DEFAULT)
+        self.prefill_max_batch = get_scalar_param(
+            sub, INFERENCE_PREFILL_MAX_BATCH,
+            INFERENCE_PREFILL_MAX_BATCH_DEFAULT)
+        self.decode_max_batch = get_scalar_param(
+            sub, INFERENCE_DECODE_MAX_BATCH,
+            INFERENCE_DECODE_MAX_BATCH_DEFAULT)
         spec = sub.get(INFERENCE_SPECULATIVE, {}) or {}
         self._speculative_raw = spec
         self._speculative_given_keys = tuple(spec) \
@@ -766,7 +783,12 @@ class InferenceConfig:
                 f"max_redispatch={self.max_redispatch}, "
                 f"max_queue_depth={self.max_queue_depth}, "
                 f"deadline_s={self.deadline_s}, "
-                f"queue_timeout_s={self.queue_timeout_s})")
+                f"queue_timeout_s={self.queue_timeout_s}, "
+                f"disaggregated={self.disaggregated}, "
+                f"prefill_workers={self.prefill_workers}, "
+                f"decode_workers={self.decode_workers}, "
+                f"prefill_max_batch={self.prefill_max_batch}, "
+                f"decode_max_batch={self.decode_max_batch})")
 
 
 class DeepSpeedConfig:
@@ -1186,6 +1208,40 @@ class DeepSpeedConfig:
                 raise ValueError(
                     f"inference: {name} must be a number >= 0 "
                     f"(0 = disabled), got {val!r}")
+        if not isinstance(inf.disaggregated, bool):
+            raise ValueError(
+                f"inference: disaggregated must be a bool, "
+                f"got {inf.disaggregated!r}")
+        for name, val in (("prefill_workers", inf.prefill_workers),
+                          ("decode_workers", inf.decode_workers)):
+            if isinstance(val, bool) or not isinstance(val, int) \
+                    or val < 1:
+                raise ValueError(
+                    f"inference: {name} must be an int >= 1, "
+                    f"got {val!r}")
+        for name, val in (("prefill_max_batch", inf.prefill_max_batch),
+                          ("decode_max_batch", inf.decode_max_batch)):
+            if isinstance(val, bool) or not isinstance(val, int) \
+                    or val < 0:
+                raise ValueError(
+                    f"inference: {name} must be an int >= 0 "
+                    f"(0 = max_batch), got {val!r}")
+        if inf.disaggregated:
+            if inf.kv_layout != "paged":
+                raise ValueError(
+                    "inference: disaggregated serving requires "
+                    "kv_layout='paged' — the prefill->decode handoff "
+                    "is a KV page copy")
+            if inf.replicas > 1:
+                raise ValueError(
+                    "inference: disaggregated and replicas > 1 are "
+                    "mutually exclusive — tiers scale via "
+                    "prefill_workers/decode_workers")
+            if inf.speculative_enabled:
+                raise ValueError(
+                    "inference: disaggregated and speculative are "
+                    "mutually exclusive — the draft/verify pair would "
+                    "break the one-program-per-tier contract")
         if not isinstance(inf._speculative_raw, dict):
             raise ValueError(
                 f"inference: speculative must be a dict block, "

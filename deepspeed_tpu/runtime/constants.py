@@ -556,6 +556,25 @@ INFERENCE_DEADLINE_S_DEFAULT = 0.0
 INFERENCE_QUEUE_TIMEOUT_S = "queue_timeout_s"
 INFERENCE_QUEUE_TIMEOUT_S_DEFAULT = 0.0
 
+# Disaggregated prefill/decode serving (inference.disaggregated): the
+# admission router splits the fleet into a PREFILL tier (workers that
+# only run the prefill program, writing paged KV) and a DECODE tier
+# (workers that only run the decode step), moving finished prompts
+# between them through an explicit KV-page handoff. Each tier pins
+# exactly one compiled program; tiers scale independently
+# (prefill_workers x decode_workers, each with its own max_batch —
+# 0 falls back to the shared max_batch). Requires kv_layout="paged".
+INFERENCE_DISAGGREGATED = "disaggregated"
+INFERENCE_DISAGGREGATED_DEFAULT = False
+INFERENCE_PREFILL_WORKERS = "prefill_workers"
+INFERENCE_PREFILL_WORKERS_DEFAULT = 1
+INFERENCE_DECODE_WORKERS = "decode_workers"
+INFERENCE_DECODE_WORKERS_DEFAULT = 1
+INFERENCE_PREFILL_MAX_BATCH = "prefill_max_batch"
+INFERENCE_PREFILL_MAX_BATCH_DEFAULT = 0
+INFERENCE_DECODE_MAX_BATCH = "decode_max_batch"
+INFERENCE_DECODE_MAX_BATCH_DEFAULT = 0
+
 # Speculative decoding (inference.speculative sub-block): a
 # self-speculative draft of `k` tokens through the first `draft_layers`
 # blocks of the SAME model (truncated scan — no second weight set),

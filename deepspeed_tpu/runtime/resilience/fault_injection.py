@@ -207,7 +207,7 @@ def hang_seconds(step):
 # Hard process death (SIGKILL mid-step / mid-checkpoint-save)
 # --------------------------------------------------------------------------
 
-KILL_OPS = ("step", "checkpoint_save", "decode_step")
+KILL_OPS = ("step", "checkpoint_save", "decode_step", "prefill_chunk")
 
 
 def inject_kill(op="step", at_step=None, signum=signal.SIGKILL):
@@ -219,7 +219,11 @@ def inject_kill(op="step", at_step=None, signum=signal.SIGKILL):
     the manifest seal + atomic rename (``at_step`` is ignored there —
     the next save dies); ``op="decode_step"`` fires inside a serving
     replica's decode loop at the first scheduler step >= ``at_step``,
-    with admitted sessions' KV still device-resident and un-drained.
+    with admitted sessions' KV still device-resident and un-drained;
+    ``op="prefill_chunk"`` fires inside the engine's chunked-prefill
+    host loop at the first chunk index >= ``at_step`` — mid-prompt,
+    with the row's pages allocated and partially written (the
+    disaggregated prefill-tier worst case).
     The default SIGKILL cannot be caught, so no preemption handler,
     atexit hook, or flight recorder runs: this is the ungraceful-exit
     seam the supervisor and fleet soak tests need.

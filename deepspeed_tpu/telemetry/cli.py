@@ -198,6 +198,99 @@ def _summarize_fleet(events):
     }
 
 
+def _summarize_disagg(events):
+    """Disaggregated-tier block: per-tier rows (prefill vs decode)
+    with the TTFT split and queue waits by tier, plus the handoff
+    ledger. Reads the router's ``request_prefilled`` /
+    ``request_complete`` / ``disagg_done`` events and the tier
+    workers' ``prefill_step`` / ``decode_step`` events — a merged
+    ``aggregate`` over router + per-tier worker JSONLs sees both
+    sides; a single worker log still gets its own tier's row. None
+    when the log carries no disaggregation events at all."""
+    kinds = {}
+    for e in events:
+        kinds.setdefault(e.get("event"), []).append(e)
+    done = (kinds.get("disagg_done") or [None])[-1] or {}
+    prefilled = kinds.get("request_prefilled", [])
+    if not done and not prefilled and not (
+            kinds.get("prefill_step") or kinds.get("disagg_reprefill")):
+        return None
+    completes = kinds.get("request_complete", [])
+
+    def _pct(vals):
+        vals = sorted(vals)
+        return {"p50": _percentile(vals, 0.50),
+                "p95": _percentile(vals, 0.95),
+                "p99": _percentile(vals, 0.99),
+                "max": vals[-1] if vals else None}
+
+    # TTFT: stamped on request_prefilled as the token leaves the
+    # prefill tier; completion records echo it for single-log reads
+    ttft = [float(e["ttft_s"]) for e in prefilled
+            if e.get("ttft_s") is not None]
+    if not ttft:
+        ttft = [float(e["ttft_s"]) for e in completes
+                if e.get("ttft_s") is not None]
+    qw_prefill = [float(e["queue_wait_s"]) for e in prefilled
+                  if e.get("queue_wait_s") is not None]
+    qw_decode = [float(e["decode_queue_wait_s"]) for e in completes
+                 if e.get("decode_queue_wait_s") is not None]
+    by_tier = {}
+    for kind in ("fleet_dispatch", "fleet_redispatch"):
+        for e in kinds.get(kind, ()):
+            t = e.get("tier")
+            if t:
+                row = by_tier.setdefault(
+                    t, {"dispatched": 0, "redispatched": 0})
+                row["dispatched" if kind == "fleet_dispatch"
+                    else "redispatched"] += 1
+    pre_steps = kinds.get("prefill_step", [])
+    dec_steps = kinds.get("decode_step", [])
+    pre_wall = [float(e["wall_s"]) for e in pre_steps
+                if e.get("wall_s") is not None]
+    dec_wall = [float(e["wall_s"]) for e in dec_steps
+                if e.get("wall_s") is not None]
+    handoffs = done.get("handoffs", len(prefilled))
+    handoff_bytes = done.get("handoff_bytes", sum(
+        int(e.get("handoff_bytes") or 0) for e in prefilled))
+    return {
+        "tiers": {
+            "prefill": {
+                "steps": len(pre_steps),
+                "step_s": _pct(pre_wall),
+                "wall_s": sum(pre_wall),
+                "dispatched": by_tier.get("prefill", {}).get(
+                    "dispatched", 0),
+                "redispatched": by_tier.get("prefill", {}).get(
+                    "redispatched", 0),
+                "queue_wait_s": _pct(qw_prefill),
+            },
+            "decode": {
+                "steps": len(dec_steps),
+                "step_s": _pct(dec_wall),
+                "wall_s": sum(dec_wall),
+                "dispatched": by_tier.get("decode", {}).get(
+                    "dispatched", 0),
+                "redispatched": by_tier.get("decode", {}).get(
+                    "redispatched", 0),
+                "queue_wait_s": _pct(qw_decode),
+            },
+        },
+        "ttft_s": _pct(ttft),
+        "handoffs": handoffs,
+        "handoff_bytes": handoff_bytes,
+        "handoff_bytes_per_session": (handoff_bytes / handoffs)
+        if handoffs else None,
+        "handoff_corrupt": done.get(
+            "handoff_corrupt", len(kinds.get("handoff_corrupt", ()))),
+        "reprefills": len(kinds.get("disagg_reprefill", ()))
+        or done.get("redispatched_total", 0),
+        "resumed_from_park": done.get("resumed_from_park", 0),
+        "dead_by_tier": done.get("dead_by_tier") or {},
+        "ok": done.get("ok"),
+    }
+
+
 def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
     """Aggregate a run's events into the summary dict. None when the
     log holds neither step events nor resilience events (a supervisor's
@@ -205,10 +298,12 @@ def summarize(events, flops_per_token=None, peak_tflops=DEFAULT_PEAK_TFLOPS):
     steps = [e for e in events if e.get("event") == "step"]
     decode = [e for e in events if e.get("event") == "decode_step"]
     fleet = _summarize_fleet(events)
-    if not steps and (decode or fleet):
+    disagg = _summarize_disagg(events)
+    if not steps and (decode or fleet or disagg):
         serve = _summarize_serve(decode, fleet=fleet)
         if serve is not None:
             serve["kernels"] = _kernel_summary(events)
+            serve["disagg"] = disagg
         return serve
     if not steps and not any(
             e.get("event") in ("restart", "recovery_ladder",
@@ -493,6 +588,36 @@ def print_serve_summary(s, out=None):
         print_kernel_block(s["kernels"], out=out)
     if s.get("fleet"):
         print_fleet_block(s["fleet"], out=out)
+    if s.get("disagg"):
+        print_disagg_block(s["disagg"], out=out)
+
+
+def print_disagg_block(dg, out=None):
+    bps = dg.get("handoff_bytes_per_session")
+    print(f"  disagg: {dg['handoffs']} handoff(s), "
+          f"{dg['handoff_bytes'] / 1024:,.1f}KB"
+          + (f" ({bps / 1024:.1f}KB/session)" if bps else "")
+          + f", {dg['handoff_corrupt']} corrupt, "
+          f"{dg['resumed_from_park']} resumed from park", file=out)
+    tt = dg["ttft_s"]
+    if tt["p50"] is not None:
+        print(f"  disagg ttft p50 {_fmt_s(tt['p50'])} "
+              f"p95 {_fmt_s(tt['p95'])} p99 {_fmt_s(tt['p99'])}",
+              file=out)
+    for tier in ("prefill", "decode"):
+        row = dg["tiers"][tier]
+        qs = row["queue_wait_s"]
+        dead = (dg.get("dead_by_tier") or {}).get(tier, 0)
+        line = (f"  {tier} tier: {row['steps']} step(s), "
+                f"wall {row['wall_s']:.3f}s, step p50 "
+                f"{_fmt_s(row['step_s']['p50'])} p95 "
+                f"{_fmt_s(row['step_s']['p95'])}, dispatched "
+                f"{row['dispatched']} (redispatched "
+                f"{row['redispatched']}, dead {dead})")
+        if qs["p50"] is not None:
+            line += (f", queue wait p50 {_fmt_s(qs['p50'])} "
+                     f"p95 {_fmt_s(qs['p95'])}")
+        print(line, file=out)
 
 
 def print_kernel_block(kn, out=None):
@@ -709,6 +834,8 @@ def aggregate(logs, no_heartbeat=()):
         all_events.extend(events)
         decode = [e for e in events if e.get("event") == "decode_step"
                   and e.get("wall_s") is not None]
+        prefill = [e for e in events if e.get("event") == "prefill_step"
+                   and e.get("wall_s") is not None]
         if decode:
             d_walls = [float(e["wall_s"]) for e in decode]
             toks = sum(int(e.get("tokens") or 0) for e in decode)
@@ -720,9 +847,24 @@ def aggregate(logs, no_heartbeat=()):
                 if sum(d_walls) and toks else None,
                 "last_step": decode[-1].get("step"),
             })
+        if prefill:
+            # a disaggregated prefill-tier worker log: no decode steps,
+            # one prefill_step per admission
+            p_walls = [float(e["wall_s"]) for e in prefill]
+            serve_hosts.append({
+                "host": label,
+                "tier": "prefill",
+                "decode_steps": 0,
+                "prefill_steps": len(prefill),
+                "tokens": None,
+                "tokens_per_s": None,
+                "prefills_per_s": (len(prefill) / sum(p_walls))
+                if sum(p_walls) else None,
+                "last_step": prefill[-1].get("step"),
+            })
         steps = [e for e in events if e.get("event") == "step"
                  and e.get("wall_s") is not None]
-        if steps or not decode:
+        if steps or (not decode and not prefill):
             walls = [float(e["wall_s"]) for e in steps]
             hosts.append({
                 "host": label,
@@ -734,9 +876,10 @@ def aggregate(logs, no_heartbeat=()):
             per_step.setdefault(int(e.get("step", -1)),
                                 {})[label] = float(e["wall_s"])
     fleet = _summarize_fleet(all_events)
+    disagg = _summarize_disagg(all_events)
     shared = {s: w for s, w in per_step.items() if len(w) >= 2}
     if not shared and not no_heartbeat and not serve_hosts \
-            and fleet is None:
+            and fleet is None and disagg is None:
         return None
     step_rows = []
     excess = {h["host"]: [] for h in hosts}
@@ -759,7 +902,8 @@ def aggregate(logs, no_heartbeat=()):
     ranking.sort(key=lambda r: -r["mean_excess_s"])
     return {"schema": SCHEMA_VERSION, "hosts": hosts,
             "steps": step_rows, "straggler_ranking": ranking,
-            "serve_hosts": serve_hosts, "fleet": fleet}
+            "serve_hosts": serve_hosts, "fleet": fleet,
+            "disagg": disagg}
 
 
 def print_aggregate(agg, n_steps=10, out=None):
@@ -797,6 +941,13 @@ def print_aggregate(agg, n_steps=10, out=None):
         if top and top["mean_excess_s"] > 0:
             print(f"  => straggler: {top['host']}", file=out)
     for h in agg.get("serve_hosts") or ():
+        if h.get("tier") == "prefill":
+            pps = (f"{h['prefills_per_s']:,.1f} prefills/s"
+                   if h.get("prefills_per_s") else "-")
+            print(f"  replica {h['host']:<22s} [prefill tier] "
+                  f"{h['prefill_steps']} prefill step(s), {pps}, "
+                  f"last step {h['last_step']}", file=out)
+            continue
         tps = (f"{h['tokens_per_s']:,.1f} tokens/s"
                if h["tokens_per_s"] else "-")
         print(f"  replica {h['host']:<22s} {h['decode_steps']} decode "
@@ -804,6 +955,8 @@ def print_aggregate(agg, n_steps=10, out=None):
               f"{h['last_step']}", file=out)
     if agg.get("fleet"):
         print_fleet_block(agg["fleet"], out=out)
+    if agg.get("disagg"):
+        print_disagg_block(agg["disagg"], out=out)
 
 
 # ---------------------------------------------------------------------------
